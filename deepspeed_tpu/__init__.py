@@ -1,0 +1,63 @@
+"""deepspeed_tpu — a TPU-native distributed training & inference framework
+with DeepSpeed-class capabilities (reference: dc3671/DeepSpeed), built on
+JAX/XLA/Pallas/pjit.
+
+Public surface mirrors the reference's ``deepspeed/__init__.py``:
+``initialize`` (:64), ``init_inference`` (:269), ``comm`` as the collective
+module, plus the accelerator registry.
+"""
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator, set_accelerator  # noqa: F401
+from .runtime.config import DeepSpeedTpuConfig, load_config  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=29500,
+               mesh=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               rng=None):
+    """Create the training engine (reference deepspeed/__init__.py:64).
+
+    ``model`` is a model description (see deepspeed_tpu.models) or any object
+    exposing ``init(rng, batch) -> params`` and ``apply(params, batch) ->
+    loss``; returns ``(engine, optimizer, dataloader, lr_scheduler)`` for
+    API parity — the engine owns all four.
+    """
+    from .runtime.engine import DeepSpeedTpuEngine
+
+    config = config if config is not None else config_params
+    engine = DeepSpeedTpuEngine(args=args,
+                                model=model,
+                                optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler,
+                                mesh=mesh,
+                                collate_fn=collate_fn,
+                                config=config,
+                                rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Create the inference engine (reference deepspeed/__init__.py:269)."""
+    from .inference.engine import InferenceEngine
+
+    return InferenceEngine(model, config=config, **kwargs)
+
+
+def init_distributed(dist_backend="xla", **kwargs):
+    from .comm import init_distributed as _init
+
+    return _init(dist_backend=dist_backend, **kwargs)

@@ -1,0 +1,4 @@
+from .abstract_accelerator import Accelerator
+from .real_accelerator import get_accelerator, set_accelerator, is_current_accelerator_supported
+
+__all__ = ["Accelerator", "get_accelerator", "set_accelerator", "is_current_accelerator_supported"]

@@ -1,0 +1,121 @@
+"""Abstract accelerator interface.
+
+TPU-native counterpart of the reference's accelerator abstraction
+(``DeepSpeedAccelerator``, reference accelerator/abstract_accelerator.py:10):
+a single indirection point for device discovery, memory statistics, dtype
+support, RNG, and synchronization so the runtime never touches a backend
+module directly. The JAX programming model removes the stream/event surface
+(XLA orders device work; ``block_until_ready`` is the sync primitive), so
+this interface is smaller but covers the same roles.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Sequence
+
+
+class Accelerator(abc.ABC):
+    _name: str = "abstract"
+    _communication_backend_name: str = "none"
+
+    # ------------------------------------------------------------------ device
+    @abc.abstractmethod
+    def devices(self) -> Sequence[Any]:
+        """All addressable devices visible to the whole job."""
+
+    @abc.abstractmethod
+    def local_devices(self) -> Sequence[Any]:
+        """Devices attached to this process."""
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def local_device_count(self) -> int:
+        return len(self.local_devices())
+
+    def device_name(self, index: int = 0) -> str:
+        devs = self.devices()
+        return str(devs[index]) if devs else "none"
+
+    @abc.abstractmethod
+    def current_platform(self) -> str:
+        """Platform string ('tpu', 'cpu', 'gpu')."""
+
+    def is_available(self) -> bool:
+        try:
+            return self.device_count() > 0
+        except Exception:
+            return False
+
+    # ------------------------------------------------------------------ sync
+    def synchronize(self, *arrays) -> None:
+        import jax
+
+        if arrays:
+            jax.block_until_ready(arrays)
+        else:
+            # Barrier-like device sync: materialize a trivial computation.
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jnp.zeros(()))
+
+    # ------------------------------------------------------------------ rng
+    def default_rng(self, seed: int):
+        import jax
+
+        return jax.random.key(seed)
+
+    # ------------------------------------------------------------------ memory
+    @abc.abstractmethod
+    def memory_stats(self, index: int = 0) -> dict:
+        """Per-device memory statistics (bytes_in_use, bytes_limit, ...)."""
+
+    def available_memory(self, index: int = 0) -> int:
+        stats = self.memory_stats(index)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    def total_memory(self, index: int = 0) -> int:
+        return int(self.memory_stats(index).get("bytes_limit", 0))
+
+    # ------------------------------------------------------------------ dtype
+    @abc.abstractmethod
+    def supported_dtypes(self) -> list:
+        ...
+
+    def is_bf16_supported(self) -> bool:
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 in self.supported_dtypes()
+
+    def is_fp16_supported(self) -> bool:
+        import jax.numpy as jnp
+
+        return jnp.float16 in self.supported_dtypes()
+
+    # ------------------------------------------------------------------ misc
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def name(self) -> str:
+        return self._name
+
+    def range_push(self, msg: str) -> None:
+        """Open a named profiler trace region (reference: nvtx range_push)."""
+        try:
+            import jax.profiler
+
+            tc = jax.profiler.TraceAnnotation(msg)
+            tc.__enter__()
+            self._trace_stack.append(tc)
+        except Exception:
+            pass
+
+    def range_pop(self) -> None:
+        try:
+            tc = self._trace_stack.pop()
+            tc.__exit__(None, None, None)
+        except Exception:
+            pass
+
+    _trace_stack: list = []

@@ -1,0 +1,53 @@
+"""CPU accelerator (host XLA backend).
+
+Counterpart of the reference's ``accelerator/cpu_accelerator.py``; used for
+tests (with ``--xla_force_host_platform_device_count`` simulating a mesh)
+and as the fallback when no TPU is attached.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from .abstract_accelerator import Accelerator
+
+
+class CpuAccelerator(Accelerator):
+    _name = "cpu"
+    _communication_backend_name = "xla"
+
+    def devices(self) -> Sequence[Any]:
+        import jax
+
+        return jax.devices("cpu")
+
+    def local_devices(self) -> Sequence[Any]:
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == "cpu"]
+
+    def current_platform(self) -> str:
+        return "cpu"
+
+    def memory_stats(self, index: int = 0) -> dict:
+        try:
+            import psutil  # pragma: no cover - optional
+
+            vm = psutil.virtual_memory()
+            return {"bytes_in_use": vm.used, "bytes_limit": vm.total}
+        except Exception:
+            import os
+
+            try:
+                pages = os.sysconf("SC_PHYS_PAGES")
+                page_size = os.sysconf("SC_PAGE_SIZE")
+                avail = os.sysconf("SC_AVPHYS_PAGES") * page_size
+                total = pages * page_size
+                return {"bytes_in_use": total - avail, "bytes_limit": total}
+            except (ValueError, OSError):
+                return {}
+
+    def supported_dtypes(self) -> list:
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]

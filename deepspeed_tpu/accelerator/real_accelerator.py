@@ -1,0 +1,48 @@
+"""Accelerator selection.
+
+Counterpart of reference ``accelerator/real_accelerator.py:45,162``
+(``get_accelerator`` / ``set_accelerator``): selection order is the
+``DSTPU_ACCELERATOR`` env var, then auto-detect (TPU if any TPU device is
+visible, else CPU). The selected instance is a process-wide singleton.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .abstract_accelerator import Accelerator
+
+_accelerator: Accelerator | None = None
+
+
+def _detect() -> Accelerator:
+    from .cpu_accelerator import CpuAccelerator
+    from .tpu_accelerator import TpuAccelerator
+
+    name = os.environ.get("DSTPU_ACCELERATOR", "").lower()
+    if name == "tpu":
+        return TpuAccelerator()
+    if name == "cpu":
+        return CpuAccelerator()
+    if name:
+        raise ValueError(f"Unknown DSTPU_ACCELERATOR: {name!r} (expected 'tpu' or 'cpu')")
+    tpu = TpuAccelerator()
+    if tpu.is_available():
+        return tpu
+    return CpuAccelerator()
+
+
+def get_accelerator() -> Accelerator:
+    global _accelerator
+    if _accelerator is None:
+        _accelerator = _detect()
+    return _accelerator
+
+
+def set_accelerator(accel: Accelerator) -> None:
+    global _accelerator
+    _accelerator = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return get_accelerator().is_available()

@@ -1,0 +1,169 @@
+"""Device-mesh topology: the TPU-native process-group registry.
+
+Counterpart of reference ``deepspeed/utils/groups.py`` (group creation
+:51,64,113) and ``runtime/pipe/topology.py:12`` (``ProcessTopology`` /
+``PipeModelDataParallelTopology``). Where the reference builds
+torch.distributed process groups out of rank lists, the TPU-native design is
+one :class:`jax.sharding.Mesh` with named axes — every parallelism form is an
+axis, every "process group" is an axis (or tuple of axes), and XLA inserts
+the collectives. Axis sizes come from the config's ``mesh`` block.
+
+Axes (ordered outermost→innermost by default so that tensor/sequence axes
+land on the fastest ICI links):
+
+- ``pipe``    — pipeline-parallel stages
+- ``data``    — pure data parallel (params replicated)
+- ``fsdp``    — ZeRO parameter/optimizer sharding axis
+- ``sequence``— Ulysses sequence parallelism
+- ``expert``  — MoE expert parallelism
+- ``tensor``  — megatron-style tensor parallelism
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+SEQUENCE_AXIS = "sequence"
+EXPERT_AXIS = "expert"
+TENSOR_AXIS = "tensor"
+
+ALL_AXES = (PIPE_AXIS, DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, EXPERT_AXIS, TENSOR_AXIS)
+
+# Axes over which gradients are averaged (data parallel replicas).
+GRAD_REDUCE_AXES = (DATA_AXIS, FSDP_AXIS)
+# Axes over which a batch is split.
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+
+class MeshTopology:
+    """Wraps a ``jax.sharding.Mesh`` with accessors mirroring the
+    reference's groups API (utils/groups.py:420-465 etc.)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    # -- factory ----------------------------------------------------------
+    @classmethod
+    def build(cls, mesh_config=None, devices: Optional[Sequence] = None,
+              **axis_sizes) -> "MeshTopology":
+        """Build from a MeshConfig (runtime/config.py) or explicit axis sizes.
+
+        One axis may be -1 ("all remaining devices"); by default that is the
+        data axis.
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+
+        sizes = {a: 1 for a in ALL_AXES}
+        sizes[DATA_AXIS] = -1  # default: data axis soaks up all devices
+        order = list(ALL_AXES)
+        if mesh_config is not None:
+            for a in ALL_AXES:
+                sizes[a] = getattr(mesh_config, a)
+            order = list(mesh_config.axis_order)
+        sizes.update(axis_sizes)
+
+        wildcard = [a for a in ALL_AXES if sizes[a] == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"Only one mesh axis may be -1, got {wildcard}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcard:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"Cannot infer {wildcard[0]} axis: {n} devices not divisible by {fixed}")
+            sizes[wildcard[0]] = n // fixed
+        elif fixed != n:
+            raise ValueError(f"Mesh sizes {sizes} product {fixed} != device count {n}")
+
+        shape = [sizes[a] for a in order]
+        dev_array = np.array(devices).reshape(shape)
+        return cls(Mesh(dev_array, tuple(order)))
+
+    # -- axis info --------------------------------------------------------
+    @property
+    def axis_names(self) -> tuple:
+        return tuple(self.mesh.axis_names)
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    @property
+    def world_size(self) -> int:
+        return math.prod(self.mesh.shape.values())
+
+    # -- reference-compatible accessors ----------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        """DP world size includes the fsdp (ZeRO) axis — batch is split over
+        both, matching the reference where ZeRO shards over the DP group."""
+        return self.axis_size(DATA_AXIS) * self.axis_size(FSDP_AXIS)
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.axis_size(TENSOR_AXIS)
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.axis_size(PIPE_AXIS)
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self.axis_size(EXPERT_AXIS)
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self.axis_size(SEQUENCE_AXIS)
+
+    def get_sequence_data_parallel_world_size(self) -> int:
+        return self.get_sequence_parallel_world_size() * self.get_data_parallel_world_size()
+
+    # -- sharding helpers -------------------------------------------------
+    def sharding(self, *spec_axes):
+        """NamedSharding for a PartitionSpec over this mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(*spec_axes))
+
+    def batch_sharding(self):
+        """Sharding for a [batch, ...] array: batch split over data+fsdp axes."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec(BATCH_AXES))
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __repr__(self) -> str:
+        return f"MeshTopology({dict(self.mesh.shape)})"
+
+
+# ------------------------------------------------------------------ registry
+_topology: Optional[MeshTopology] = None
+
+
+def set_topology(topo: MeshTopology) -> None:
+    global _topology
+    _topology = topo
+
+
+def get_topology() -> MeshTopology:
+    global _topology
+    if _topology is None:
+        _topology = MeshTopology.build()
+    return _topology
+
+
+def has_topology() -> bool:
+    return _topology is not None
+
+
+def reset_topology() -> None:
+    global _topology
+    _topology = None

@@ -1,0 +1,397 @@
+"""The framework's JSON config system.
+
+Counterpart of reference ``runtime/config.py:688`` (``DeepSpeedConfig``) and
+its ~40 typed sub-configs (``_initialize_params`` :781, zero config
+``runtime/zero/config.py:86``, offload config ``runtime/zero/offload_config.py``).
+The JSON surface keeps the reference's key names (``train_batch_size``,
+``zero_optimization``, ``fp16``/``bf16``, ``optimizer``/``scheduler`` blocks,
+``activation_checkpointing``, monitors, ``flops_profiler``, ``comms_logger``,
+``aio``...) so configs written for the reference work here, plus a TPU-native
+``mesh`` block describing the device-mesh axes
+(data/fsdp/tensor/pipe/sequence/expert) that all parallelism rides on.
+"""
+
+from __future__ import annotations
+
+import json
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field, model_validator
+
+from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
+from ..utils.logging import logger
+
+# ----------------------------------------------------------------- defaults
+TRAIN_BATCH_SIZE_DEFAULT = None
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
+STEPS_PER_PRINT_DEFAULT = 10
+
+
+class DtypeEnum(str, Enum):
+    fp32 = "fp32"
+    fp16 = "fp16"
+    bf16 = "bf16"
+
+    def to_jnp(self):
+        import jax.numpy as jnp
+
+        return {"fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16}[self.value]
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class FP16Config(DSConfigModel):
+    """Mirrors reference fp16 block (runtime/config.py get_fp16_enabled)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DSConfigModel):
+    enabled: bool = False
+    immediate_grad_update: bool = False
+
+
+class OptimizerConfig(DSConfigModel):
+    """{"type": "Adam"|"AdamW"|"Lamb"|"Lion"|"SGD"|..., "params": {...}}"""
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(DSConfigModel):
+    """{"type": "WarmupLR"|"WarmupDecayLR"|"WarmupCosineLR"|"OneCycle"|"LRRangeTest", "params": {...}}"""
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class OffloadParamConfig(DSConfigModel):
+    """Mirrors reference runtime/zero/offload_config.py DeepSpeedZeroOffloadParamConfig."""
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = int(1e8)
+    max_in_cpu: int = int(1e9)
+    pin_memory: bool = False
+
+
+class OffloadOptimizerConfig(DSConfigModel):
+    """Mirrors reference DeepSpeedZeroOffloadOptimizerConfig; ``ratio`` is the
+    ZeRO-Offload++ Twin-Flow partial-offload fraction (reference engine.py:703)."""
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+class ZeroConfig(DSConfigModel):
+    """Mirrors reference runtime/zero/config.py:86-291. On TPU the stages map
+    to sharding rules over the mesh's fsdp/data axes (see runtime/zero.py):
+    stage 1 shards optimizer state, stage 2 additionally reduce-scatters
+    gradients, stage 3 shards parameters; bucket/overlap knobs are accepted
+    for config compatibility (XLA's latency-hiding scheduler plays that role)."""
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = int(5e8)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = int(5e8)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = int(1e9)
+    cpu_offload_param: Optional[bool] = None
+    cpu_offload_use_pin_memory: Optional[bool] = None
+    cpu_offload: Optional[bool] = None
+    prefetch_bucket_size: int = int(5e7)
+    param_persistence_threshold: int = int(1e5)
+    model_persistence_threshold: int = int(1e9)
+    max_live_parameters: int = int(1e9)
+    max_reuse_distance: int = int(1e9)
+    gather_16bit_weights_on_model_save: bool = False
+    stage3_gather_fp16_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+    # ZeRO++ (reference zero/config.py:256-272)
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # MiCS
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+
+    @model_validator(mode="before")
+    @classmethod
+    def _legacy_cpu_offload(cls, values):
+        if isinstance(values, dict):
+            if values.get("cpu_offload") and not values.get("offload_optimizer"):
+                values["offload_optimizer"] = {"device": "cpu"}
+            if values.get("cpu_offload_param") and not values.get("offload_param"):
+                values["offload_param"] = {"device": "cpu"}
+        return values
+
+
+class ActivationCheckpointingConfig(DSConfigModel):
+    """Mirrors reference activation_checkpointing block
+    (activation_checkpointing/checkpointing.py:1065). On TPU this selects a
+    ``jax.checkpoint`` (remat) policy; partition_activations maps to
+    sequence/TP-sharded remat saves."""
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class CommsLoggerConfig(DSConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class MonitorBackendConfig(DSConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class WandbConfig(DSConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(DSConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class FlopsProfilerConfig(DSConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class AioConfig(DSConfigModel):
+    """Mirrors reference runtime/swap_tensor/aio_config.py; consumed by the
+    native async-IO module (csrc equivalent: deepspeed_tpu/csrc/aio.cpp)."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
+class PipelineConfig(DSConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+    micro_batches: Optional[int] = None
+
+
+class MeshConfig(DSConfigModel):
+    """TPU-native parallel topology: sizes of the named mesh axes. -1 on the
+    data axis means "all remaining devices". The ordering matters for ICI
+    locality: innermost axes (tensor/sequence) get the fastest links."""
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    pipe: int = 1
+    sequence: int = 1
+    expert: int = 1
+    axis_order: List[str] = Field(
+        default_factory=lambda: ["pipe", "data", "fsdp", "sequence", "expert", "tensor"])
+
+
+class CheckpointConfig(DSConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    async_save: bool = False
+
+
+class DataTypesConfig(DSConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class ElasticityConfig(DSConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class AutotuningConfig(DSConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = False
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    num_tuning_micro_batch_sizes: int = 3
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    arg_mappings: Dict[str, str] = Field(default_factory=dict)
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedTpuConfig(DSConfigModel):
+    """Top-level config. Mirrors reference ``DeepSpeedConfig``
+    (runtime/config.py:688) including the batch-size triple resolution:
+    train_batch_size = micro_batch_per_device × gradient_accumulation_steps ×
+    data-parallel world size."""
+
+    train_batch_size: Optional[Union[int, str]] = None
+    train_micro_batch_size_per_gpu: Optional[Union[int, str]] = None
+    gradient_accumulation_steps: Optional[Union[int, str]] = None
+    steps_per_print: int = STEPS_PER_PRINT_DEFAULT
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    sparse_gradients: bool = False
+    gradient_clipping: float = 0.0
+    communication_data_type: Optional[str] = None
+    seq_parallel_communication_data_type: str = "fp32"
+    disable_allgather: bool = False
+
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    tensorboard: MonitorBackendConfig = Field(default_factory=MonitorBackendConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    aio: AioConfig = Field(default_factory=AioConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    mesh: MeshConfig = Field(default_factory=MeshConfig)
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    seed: int = 1234
+    zero_allow_untested_optimizer: bool = True
+    zero_force_ds_cpu_optimizer: bool = True
+    compression_training: Dict[str, Any] = Field(default_factory=dict)
+    data_efficiency: Dict[str, Any] = Field(default_factory=dict)
+    curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
+
+    # ------------------------------------------------------------ dtype helpers
+    @property
+    def precision(self) -> DtypeEnum:
+        if self.bf16.enabled:
+            return DtypeEnum.bf16
+        if self.fp16.enabled:
+            return DtypeEnum.fp16
+        return DtypeEnum.fp32
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > 0
+
+    # ------------------------------------------------------- batch resolution
+    def resolve_batch_sizes(self, dp_world_size: int) -> None:
+        """Reference runtime/config.py _batch_assertion/_set_batch_related_parameters:
+        any two of (train_batch, micro_batch, gas) determine the third."""
+        train = self.train_batch_size if isinstance(self.train_batch_size, int) else None
+        micro = (self.train_micro_batch_size_per_gpu
+                 if isinstance(self.train_micro_batch_size_per_gpu, int) else None)
+        gas = (self.gradient_accumulation_steps
+               if isinstance(self.gradient_accumulation_steps, int) else None)
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp_world_size)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp_world_size
+        elif micro is not None:
+            gas = 1
+            train = micro * dp_world_size
+        elif train is not None:
+            gas = 1
+            micro = train // dp_world_size
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu must be set")
+
+        if train != micro * gas * dp_world_size:
+            raise DeepSpeedConfigError(
+                f"Inconsistent batch config: train_batch_size={train} != "
+                f"micro({micro}) * gas({gas}) * dp_world_size({dp_world_size})")
+        if train <= 0 or micro <= 0 or gas <= 0:
+            raise DeepSpeedConfigError(
+                f"Batch sizes must be positive: train={train} micro={micro} gas={gas}")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def print_config(self, name: str = "DeepSpeedTpuConfig") -> None:
+        logger.info(f"{name}:\n{json.dumps(self.model_dump(mode='json'), indent=2, default=str)}")
+
+
+def load_config(config: Union[str, dict, DeepSpeedTpuConfig, None]) -> DeepSpeedTpuConfig:
+    """Accepts a path to a JSON file, a dict, an existing config object, or
+    None (all defaults)."""
+    if config is None:
+        return DeepSpeedTpuConfig()
+    if isinstance(config, DeepSpeedTpuConfig):
+        return config
+    if isinstance(config, str):
+        with open(config) as fh:
+            config = json.load(fh, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+    if not isinstance(config, dict):
+        raise DeepSpeedConfigError(f"Unsupported config type: {type(config)}")
+    return DeepSpeedTpuConfig(**config)

@@ -1,0 +1,59 @@
+"""Typed config base model.
+
+Counterpart of reference ``runtime/config_utils.py`` (``DeepSpeedConfigModel``):
+a pydantic base with support for deprecated field aliases, ``"auto"``
+sentinels, and dict round-tripping. All feature sub-configs in
+:mod:`deepspeed_tpu.runtime.config` derive from this.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict
+
+AUTO = "auto"
+
+
+class DSConfigModel(BaseModel):
+    """Base for all config blocks: ignores unknown keys (with a warning),
+    allows population by field name or alias, validates on assignment."""
+
+    model_config = ConfigDict(
+        extra="allow",
+        populate_by_name=True,
+        validate_assignment=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data: Any):
+        if not strict:  # replace None values with defaults
+            for field_name, field_info in self.__class__.model_fields.items():
+                if field_name in data and data[field_name] is None:
+                    data[field_name] = field_info.get_default(call_default_factory=True)
+        super().__init__(**data)
+
+    def to_dict(self) -> dict:
+        return self.model_dump()
+
+
+def get_scalar_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """json.load object_pairs_hook that rejects duplicate keys
+    (reference config_utils.py behavior)."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter: dict[str, int] = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d

@@ -1,0 +1,39 @@
+"""Test harness: 8 virtual CPU devices standing in for a TPU slice.
+
+Counterpart of the reference's DistributedTest harness
+(tests/unit/common.py:102): the reference forks N processes with real
+NCCL/Gloo loopback; the TPU-native equivalent is a single process with
+``--xla_force_host_platform_device_count=8`` — real XLA collectives over a
+virtual 8-device mesh, exercising the same SPMD programs that run on ICI.
+"""
+
+import os
+
+# Must happen before the first device query. The axon TPU plugin (if present)
+# pins jax_platforms at interpreter startup, so override via jax.config too.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Each test builds its own mesh topology."""
+    from deepspeed_tpu.parallel import topology
+
+    topology.reset_topology()
+    yield
+    topology.reset_topology()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

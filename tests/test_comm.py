@@ -1,0 +1,95 @@
+"""Comm-layer tests over the virtual 8-device mesh (reference analogue:
+tests/unit/comm/test_dist.py via the DistributedTest harness)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.parallel.topology import DATA_AXIS, MeshTopology, set_topology
+
+
+@pytest.fixture(autouse=True)
+def _mesh(devices8):
+    set_topology(MeshTopology.build(data=8))
+    dist.init_distributed()
+
+
+def test_world_size():
+    assert dist.get_world_size() == 8
+    assert dist.get_world_size(DATA_AXIS) == 8
+    assert dist.get_rank() == 0
+
+
+def test_eager_all_reduce():
+    x = jnp.arange(8.0).reshape(8, 1)  # rank i holds value i
+    out = dist.eager_all_reduce(x, dist.ReduceOp.SUM, group=DATA_AXIS)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 28.0))
+
+
+def test_eager_all_reduce_max():
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = dist.eager_all_reduce(x, dist.ReduceOp.MAX, group=DATA_AXIS)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 7.0))
+
+
+def test_eager_all_reduce_avg():
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = dist.eager_all_reduce(x, dist.ReduceOp.AVG, group=DATA_AXIS)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.5))
+
+
+def test_eager_all_gather():
+    # rank i holds chunk [i, i] -> every rank gets the concatenation
+    x = jnp.repeat(jnp.arange(8.0)[:, None], 2, axis=1).reshape(8, 2)
+    out = dist.eager_all_gather(x, group=DATA_AXIS)
+    assert out.shape == (8, 16)
+    expected = np.repeat(np.arange(8.0), 2)
+    for r in range(8):
+        np.testing.assert_allclose(np.asarray(out)[r], expected)
+
+
+def test_eager_reduce_scatter():
+    # every rank holds the same [8] vector; rank i ends with sum-chunk i
+    x = jnp.tile(jnp.arange(8.0), (8, 1))
+    out = dist.eager_reduce_scatter(x, dist.ReduceOp.SUM, group=DATA_AXIS)
+    assert out.shape == (8, 1)
+    np.testing.assert_allclose(np.asarray(out)[:, 0], np.arange(8.0) * 8)
+
+
+def test_eager_all_to_all():
+    # rank i sends value 10*i+j to rank j
+    x = jnp.array([[10 * i + j for j in range(8)] for i in range(8)], dtype=jnp.float32)
+    out = dist.eager_all_to_all(x, group=DATA_AXIS)
+    expected = np.asarray(x).T
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_eager_broadcast():
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = dist.eager_broadcast(x, src=3, group=DATA_AXIS)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+
+def test_capability_probes():
+    assert dist.has_all_gather_into_tensor()
+    assert dist.has_reduce_scatter_tensor()
+    assert dist.has_coalescing_manager()
+
+
+def test_comms_logger():
+    dist.comms_logger.enabled = True
+    dist.comms_logger.prof_all = True
+    x = jnp.ones((8, 4))
+    dist.eager_all_reduce(x, group=DATA_AXIS)
+    summary = dist.log_summary()
+    assert "all_reduce" in summary
+    dist.comms_logger.enabled = False
+
+
+def test_multi_axis_world_size(devices8):
+    from deepspeed_tpu.parallel.topology import FSDP_AXIS, TENSOR_AXIS
+
+    set_topology(MeshTopology.build(data=2, fsdp=2, tensor=2))
+    assert dist.get_world_size((DATA_AXIS, FSDP_AXIS)) == 4
+    assert dist.get_world_size(TENSOR_AXIS) == 2

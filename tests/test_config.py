@@ -1,0 +1,94 @@
+"""Config-system tests (reference analogues: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (
+    DeepSpeedConfigError,
+    DeepSpeedTpuConfig,
+    load_config,
+)
+
+
+def test_defaults():
+    cfg = load_config(None)
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.fp16.enabled
+    assert not cfg.bf16.enabled
+    assert cfg.precision.value == "fp32"
+
+
+def test_dict_config():
+    cfg = load_config({
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 2,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        "gradient_clipping": 1.0,
+    })
+    assert cfg.train_batch_size == 32
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.zero_optimization.offload_optimizer.device.value == "cpu"
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.precision.value == "bf16"
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_json_file_config(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_micro_batch_size_per_gpu": 4, "fp16": {"enabled": True}}))
+    cfg = load_config(str(p))
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.precision.value == "fp16"
+
+
+def test_batch_resolution():
+    cfg = load_config({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+    cfg = load_config({"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 3})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_batch_size == 96
+
+    cfg = load_config({"train_batch_size": 64})
+    cfg.resolve_batch_sizes(dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_resolution_inconsistent():
+    cfg = load_config({
+        "train_batch_size": 65, "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+    })
+    with pytest.raises(DeepSpeedConfigError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_no_batch_size():
+    cfg = load_config({})
+    with pytest.raises(DeepSpeedConfigError):
+        cfg.resolve_batch_sizes(dp_world_size=8)
+
+
+def test_legacy_cpu_offload_flag():
+    cfg = load_config({"zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert cfg.zero_optimization.offload_optimizer is not None
+    assert cfg.zero_optimization.offload_optimizer.device.value == "cpu"
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 1, "train_batch_size": 2}')
+    with pytest.raises(ValueError):
+        load_config(str(p))
+
+
+def test_mesh_config():
+    cfg = load_config({"mesh": {"fsdp": 4, "tensor": 2, "data": 1}})
+    assert cfg.mesh.fsdp == 4
+    assert cfg.mesh.tensor == 2

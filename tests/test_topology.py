@@ -1,0 +1,57 @@
+"""Mesh topology tests (reference analogue: tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (
+    DATA_AXIS,
+    FSDP_AXIS,
+    TENSOR_AXIS,
+    MeshTopology,
+    get_topology,
+    set_topology,
+)
+from deepspeed_tpu.runtime.config import load_config
+
+
+def test_build_default(devices8):
+    t = MeshTopology.build()
+    assert t.world_size == 8
+    assert t.axis_size(DATA_AXIS) == 8  # wildcard axis soaks up all devices
+    assert t.axis_size(TENSOR_AXIS) == 1
+
+
+def test_build_from_config(devices8):
+    cfg = load_config({"mesh": {"data": -1, "fsdp": 2, "tensor": 2}})
+    t = MeshTopology.build(cfg.mesh)
+    assert t.axis_size(FSDP_AXIS) == 2
+    assert t.axis_size(TENSOR_AXIS) == 2
+    assert t.axis_size(DATA_AXIS) == 2
+    assert t.get_data_parallel_world_size() == 4  # data * fsdp
+    assert t.get_model_parallel_world_size() == 2
+
+
+def test_build_explicit_sizes(devices8):
+    t = MeshTopology.build(fsdp=8, data=1)
+    assert t.axis_size(FSDP_AXIS) == 8
+
+
+def test_invalid_sizes(devices8):
+    with pytest.raises(ValueError):
+        MeshTopology.build(data=3, fsdp=1)  # 3 doesn't divide 8... product mismatch
+    cfg = load_config({"mesh": {"data": -1, "fsdp": 3}})
+    with pytest.raises(ValueError):
+        MeshTopology.build(cfg.mesh)
+
+
+def test_registry(devices8):
+    t = MeshTopology.build(fsdp=4, data=2)
+    set_topology(t)
+    assert get_topology() is t
+
+
+def test_shardings(devices8):
+    t = MeshTopology.build(fsdp=4, data=2)
+    bs = t.batch_sharding()
+    assert bs is not None
+    rep = t.replicated()
+    assert rep.is_fully_replicated
