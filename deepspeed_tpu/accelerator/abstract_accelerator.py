@@ -102,20 +102,23 @@ class Accelerator(abc.ABC):
 
     def range_push(self, msg: str) -> None:
         """Open a named profiler trace region (reference: nvtx range_push)."""
+        if not hasattr(self, "_trace_stack"):
+            self._trace_stack = []  # per-instance: interleaved instances must not pop each other's regions
         try:
             import jax.profiler
 
             tc = jax.profiler.TraceAnnotation(msg)
             tc.__enter__()
-            self._trace_stack.append(tc)
         except Exception:
-            pass
+            return
+        self._trace_stack.append(tc)
 
     def range_pop(self) -> None:
+        stack = getattr(self, "_trace_stack", None)
+        if not stack:
+            return
+        tc = stack.pop()
         try:
-            tc = self._trace_stack.pop()
             tc.__exit__(None, None, None)
         except Exception:
             pass
-
-    _trace_stack: list = []

@@ -24,7 +24,12 @@ class CpuAccelerator(Accelerator):
     def local_devices(self) -> Sequence[Any]:
         import jax
 
-        return [d for d in jax.local_devices() if d.platform == "cpu"]
+        # jax.local_devices() lists only the default backend (TPU on a TPU
+        # host); ask the cpu backend explicitly.
+        try:
+            return jax.local_devices(backend="cpu")
+        except RuntimeError:
+            return [d for d in jax.devices("cpu") if d.process_index == jax.process_index()]
 
     def current_platform(self) -> str:
         return "cpu"

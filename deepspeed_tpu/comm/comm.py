@@ -168,7 +168,12 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: Group = None):
     if op == ReduceOp.PRODUCT:
         import jax.numpy as jnp
 
-        return jnp.exp(lax.psum(jnp.log(tensor), axes))
+        # all_gather + prod: exact for zeros/negatives (exp∘psum∘log is not)
+        out = tensor
+        for a in reversed(axes):
+            out = lax.all_gather(out, a, axis=0, tiled=False)
+            out = jnp.prod(out, axis=0)
+        return out
     raise NotImplementedError(f"ReduceOp {op} not supported on TPU mesh collectives")
 
 
@@ -237,8 +242,10 @@ def broadcast(tensor, src: int = 0, group: Group = None):
         idx = lax.axis_index(axes[0])
     else:
         idx = _flat_axis_index(axes)
-    mask = (idx == src).astype(tensor.dtype)
-    return lax.psum(tensor * mask, axes)
+    # where (not multiply-by-mask): non-src buffers may hold inf/NaN garbage,
+    # and 0 * inf = NaN would poison every rank.
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, axes)
 
 
 def _flat_axis_index(axes):
@@ -251,16 +258,19 @@ def _flat_axis_index(axes):
     return idx
 
 
-def send(tensor, dst: int, group: Group = None):
-    """P2P send inside a jitted program = ppermute to a single destination.
-    Returns the value that this rank *receives* under the same permutation
-    (JAX collectives are symmetric); pair with :func:`recv` conventions as in
-    the pipeline engine (parallel/pipeline.py)."""
-    return ppermute(tensor, [(get_rank(group), dst)], group)
+def send(tensor, dst: int, src: int, group: Group = None):
+    """P2P send inside a jitted program = ppermute moving ``src``'s shard to
+    ``dst``. SPMD collectives need the *static* (src, dst) pair — a
+    per-device "my rank" does not exist at trace time (lax.axis_index is a
+    traced value and ppermute permutations must be static), so the caller
+    names both endpoints, as the pipeline engine does for stage pairs.
+    Returns the value this device receives (zeros on non-participants)."""
+    return ppermute(tensor, [(src, dst)], group)
 
 
-def recv(tensor_shape_like, src: int, group: Group = None):
-    return ppermute(tensor_shape_like, [(src, get_rank(group))], group)
+def recv(tensor_shape_like, src: int, dst: int, group: Group = None):
+    """Symmetric to :func:`send` — same collective, receiver's view."""
+    return ppermute(tensor_shape_like, [(src, dst)], group)
 
 
 def ppermute(tensor, perm, group: Group = None):
@@ -306,10 +316,19 @@ def _timed(op_name):
                 result = fn(*args, **kwargs)
                 jax.block_until_ready(result)
                 dt = time.perf_counter() - t0
+                # group may be passed positionally (last arg, str/tuple)
                 group = kwargs.get("group")
+                if group is None:
+                    for a in reversed(args):
+                        if isinstance(a, (str, tuple)) and not hasattr(a, "shape"):
+                            group = a
+                            break
+                n = get_world_size(group)
+                # stacked convention: leading dim == group size, so the
+                # per-rank payload is total/n
                 comms_logger.append(op_name, op_name, dt,
-                                    get_msg_size_from_args(*args),
-                                    get_world_size(group))
+                                    get_msg_size_from_args(*args) // max(n, 1),
+                                    n)
                 return result
             return fn(*args, **kwargs)
         return wrapper

@@ -1,0 +1,69 @@
+"""`dstpu_report` — environment + capability report.
+
+Counterpart of reference ``deepspeed/env_report.py`` (``ds_report``): prints
+versions, devices, and which native/Pallas features are available, replacing
+the reference's op-builder compatibility table with the TPU feature set.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try(fn):
+    try:
+        return fn(), True
+    except Exception as e:
+        return str(e), False
+
+
+def main():
+    print("-" * 60)
+    print("deepspeed_tpu environment report")
+    print("-" * 60)
+
+    import deepspeed_tpu
+
+    print(f"deepspeed_tpu version ... {deepspeed_tpu.__version__}")
+    print(f"python version .......... {sys.version.split()[0]}")
+
+    ver, ok = _try(lambda: __import__("jax").__version__)
+    print(f"jax ..................... {ver if ok else RED_NO}")
+    ver, ok = _try(lambda: __import__("jaxlib").__version__)
+    print(f"jaxlib .................. {ver if ok else RED_NO}")
+
+    def devices():
+        import jax
+
+        return [(d.platform, getattr(d, "device_kind", "?")) for d in jax.devices()]
+
+    devs, ok = _try(devices)
+    print(f"devices ................. {devs if ok else RED_NO}")
+
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    print(f"accelerator ............. {acc.name()}")
+
+    feature_probes = {
+        "pallas": lambda: __import__("jax.experimental.pallas", fromlist=["x"]),
+        "flash_attention": lambda: __import__(
+            "deepspeed_tpu.ops.flash_attention", fromlist=["flash_attention"]),
+        "mesh collectives": lambda: __import__(
+            "deepspeed_tpu.comm.comm", fromlist=["all_reduce"]),
+        "orbax checkpoint": lambda: __import__("orbax.checkpoint", fromlist=["x"]),
+    }
+    print("-" * 60)
+    print("feature availability:")
+    for name, probe in feature_probes.items():
+        _, ok = _try(probe)
+        print(f"  {name:<22} {GREEN_OK if ok else RED_NO}")
+    print("-" * 60)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""`dstpu` CLI — multi-host TPU launcher.
+
+Counterpart of reference ``launcher/runner.py:389`` (``deepspeed`` CLI:
+hostfile parsing :201, ``--include/--exclude`` resource filters :256, ssh
+check, PDSH/MPI/SLURM multinode runners) and per-node ``launcher/launch.py:132``.
+
+The TPU process model is simpler than the reference's: ONE process per host
+(the PJRT client owns all local chips), not one per accelerator, so the
+per-node launcher forks a single training process with rendezvous env vars
+(``COORDINATOR_ADDRESS``/``RANK``/``WORLD_SIZE`` → ``jax.distributed``)
+instead of `launch.py`'s N-rank fork + RANK/LOCAL_RANK bookkeeping.
+
+Modes:
+- single host: exec the script directly (world_size 1).
+- ``--hostfile``: ssh/pdsh to each host, set env, run the same command
+  (reference MultiNodeRunner, multinode_runner.py:18,51).
+- under SLURM (``SLURM_PROCID`` set) or GKE/TPU-pod env
+  (``TPU_WORKER_ID``/``MEGASCALE_SLICE_ID``): derive rank/world/coordinator
+  from the environment and exec in-place.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def fetch_hostfile(path: str) -> Dict[str, int]:
+    """Parse ``host slots=N`` lines (reference launcher/runner.py:201)."""
+    if not os.path.isfile(path):
+        return {}
+    hosts: Dict[str, int] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if host in hosts:
+                raise ValueError(f"Duplicate host {host} in hostfile")
+            hosts[host] = slots
+    return hosts
+
+
+def parse_resource_filter(hosts: Dict[str, int], include: str = "",
+                          exclude: str = "") -> Dict[str, int]:
+    """``--include host1@host2`` / ``--exclude host3`` (reference :256).
+    Per-slot filters (host:0,1) are not meaningful on TPU (1 proc/host) and
+    raise."""
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    spec = include or exclude
+    if not spec:
+        return dict(hosts)
+    names = []
+    for part in spec.split("@"):
+        part = part.strip()
+        if ":" in part:
+            raise ValueError("per-slot filters are not supported on TPU "
+                             "(one process per host owns all local chips)")
+        if part and part not in hosts:
+            raise ValueError(f"host {part!r} not in hostfile")
+        if part:
+            names.append(part)
+    if include:
+        return {h: hosts[h] for h in names}
+    return {h: s for h, s in hosts.items() if h not in names}
+
+
+def _env_rank_info():
+    """Detect rank/world/coordinator from SLURM or TPU-pod env."""
+    env = os.environ
+    if "SLURM_PROCID" in env:
+        rank = int(env["SLURM_PROCID"])
+        world = int(env.get("SLURM_NTASKS", "1"))
+        nodelist = env.get("SLURM_JOB_NODELIST", "")
+        coord = env.get("COORDINATOR_ADDRESS")
+        if coord is None and nodelist:
+            first = subprocess.run(
+                ["scontrol", "show", "hostnames", nodelist],
+                capture_output=True, text=True).stdout.splitlines()
+            coord = f"{first[0]}:8476" if first else None
+        return rank, world, coord
+    if "TPU_WORKER_ID" in env:
+        rank = int(env["TPU_WORKER_ID"])
+        hosts = env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+        world = len([h for h in hosts if h.strip()]) or 1
+        coord = f"{hosts[0].strip()}:8476" if hosts and hosts[0].strip() else None
+        return rank, world, coord
+    return None
+
+
+def build_cmd(args, rank: int, world: int, coord: str) -> List[str]:
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+    return cmd
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher (TPU-pod aware; 1 process/host)")
+    parser.add_argument("--hostfile", type=str, default=DLTS_HOSTFILE)
+    parser.add_argument("--include", type=str, default="")
+    parser.add_argument("--exclude", type=str, default="")
+    parser.add_argument("--master_addr", type=str, default=None)
+    parser.add_argument("--master_port", type=int, default=8476)
+    parser.add_argument("--ssh_port", type=int, default=22)
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    info = _env_rank_info()
+    if info is not None:
+        # running inside a managed allocation: exec in place
+        rank, world, coord = info
+        env = os.environ
+        if coord:
+            env.setdefault("COORDINATOR_ADDRESS", coord)
+        env.setdefault("RANK", str(rank))
+        env.setdefault("WORLD_SIZE", str(world))
+        os.execvpe(sys.executable, build_cmd(args, rank, world, coord), env)
+
+    hosts = fetch_hostfile(args.hostfile)
+    hosts = parse_resource_filter(hosts, args.include, args.exclude)
+
+    if len(hosts) <= 1 and not args.force_multi:
+        env = dict(os.environ)
+        env.setdefault("RANK", "0")
+        env.setdefault("WORLD_SIZE", "1")
+        os.execvpe(sys.executable, build_cmd(args, 0, 1, None), env)
+        return
+
+    host_list = list(hosts)
+    coord_host = args.master_addr or host_list[0]
+    coord = f"{coord_host}:{args.master_port}"
+    world = len(host_list)
+    procs = []
+    for rank, host in enumerate(host_list):
+        envs = (f"COORDINATOR_ADDRESS={shlex.quote(coord)} RANK={rank} "
+                f"WORLD_SIZE={world}")
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {envs} " + " ".join(
+            shlex.quote(c) for c in build_cmd(args, rank, world, coord))
+        if args.launcher == "pdsh":
+            cmd = ["pdsh", "-w", host, remote_cmd]
+        else:
+            cmd = ["ssh", "-p", str(args.ssh_port), host, remote_cmd]
+        logger.info(f"launching rank {rank} on {host}")
+        procs.append(subprocess.Popen(cmd))
+
+    rc = 0
+    try:
+        for p in procs:
+            rc |= p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        raise
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Model zoo: TPU-native functional model implementations.
+
+Counterpart of the reference's model surface: training models are user-built
+torch modules there; here we ship first-class functional causal-LM
+implementations (GPT-2 / Llama-2 / Mistral / OPT families via one configurable
+transformer, reference inference v2 `model_implementations/llama_v2/...`)
+because a JAX engine needs `init/apply` functions rather than module wrapping.
+"""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    CausalLM,
+    GPT2_125M,
+    LLAMA2_7B,
+    LLAMA2_70B,
+    MISTRAL_7B,
+    TINY_TEST,
+)
+
+MODEL_CONFIGS = {
+    "gpt2-125m": GPT2_125M,
+    "llama2-7b": LLAMA2_7B,
+    "llama2-70b": LLAMA2_70B,
+    "mistral-7b": MISTRAL_7B,
+    "tiny": TINY_TEST,
+}
+
+
+def build_model(name_or_config, **overrides):
+    """Build a CausalLM from a registered name or a TransformerConfig."""
+    if isinstance(name_or_config, TransformerConfig):
+        cfg = name_or_config
+    else:
+        cfg = MODEL_CONFIGS[name_or_config]
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **overrides)
+    return CausalLM(cfg)

@@ -1,0 +1,371 @@
+"""TPU-native causal transformer LM (GPT-2 / Llama / Mistral families).
+
+One configurable functional implementation replaces the reference's per-model
+containers (``deepspeed/module_inject/containers/{gpt2,llama,opt,...}.py`` and
+inference-v2 ``model_implementations/llama_v2/llama_v2_model.py:204``):
+
+- pure-functional ``init`` / ``apply`` (no module system) so the whole train
+  step is one jitted SPMD program;
+- scan-over-layers with stacked layer params — O(1) compile time in depth and
+  the natural substrate for pipeline parallelism (layer dim → ``pipe`` axis)
+  and ``jax.checkpoint`` remat (the reference's activation checkpointing,
+  ``runtime/activation_checkpointing/checkpointing.py:485``);
+- every param carries a *logical* sharding spec consumed by
+  ``parallel/sharding.py`` — Megatron-style TP (column QKV/MLP-in, row
+  proj/MLP-out) falls out of the ``heads``/``mlp`` logical axes, ZeRO-3 out
+  of the fsdp rule;
+- GQA, RoPE, RMSNorm, SwiGLU for the Llama/Mistral family; learned positions,
+  LayerNorm, GELU for GPT-2.
+
+Attention dispatches to the Pallas flash-attention kernel on TPU
+(``deepspeed_tpu/ops/flash_attention.py``) and a pure-XLA reference path
+elsewhere — the counterpart of the reference's fused CUDA transformer kernels
+(``csrc/transformer/``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.sharding import spec
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None   # GQA; None => MHA
+    max_seq_len: int = 1024
+    # architecture switches
+    norm: str = "layernorm"              # "layernorm" | "rmsnorm"
+    activation: str = "gelu"             # "gelu" | "silu" (silu => SwiGLU gated MLP)
+    position: str = "learned"            # "learned" | "rope"
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    dtype: Any = jnp.float32             # compute dtype (params kept fp32)
+    remat: bool = False                  # activation checkpointing per layer
+    remat_policy: Optional[str] = None   # None|"dots_saveable"|"nothing_saveable"
+    use_flash_attention: bool = True     # pallas kernel on TPU
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    def num_params(self) -> int:
+        h, m, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
+        kvh = self.kv_heads * self.head_dim
+        attn = h * h + 2 * h * kvh + h * h                 # q, k, v, o
+        mlp = (3 if self.activation == "silu" else 2) * h * m
+        norms = (2 if self.norm == "rmsnorm" else 4) * h
+        per_layer = attn + mlp + norms
+        emb = v * h + (self.max_seq_len * h if self.position == "learned" else 0)
+        head = 0 if self.tie_embeddings else v * h
+        return L * per_layer + emb + head + h
+
+
+# Registered configurations (sizes follow the public model cards).
+GPT2_125M = TransformerConfig()
+LLAMA2_7B = TransformerConfig(vocab_size=32000, hidden_size=4096,
+                              intermediate_size=11008, num_layers=32,
+                              num_heads=32, num_kv_heads=32, max_seq_len=4096,
+                              norm="rmsnorm", activation="silu",
+                              position="rope", tie_embeddings=False,
+                              norm_eps=1e-5, dtype=jnp.bfloat16)
+LLAMA2_70B = TransformerConfig(vocab_size=32000, hidden_size=8192,
+                               intermediate_size=28672, num_layers=80,
+                               num_heads=64, num_kv_heads=8, max_seq_len=4096,
+                               norm="rmsnorm", activation="silu",
+                               position="rope", tie_embeddings=False,
+                               dtype=jnp.bfloat16)
+MISTRAL_7B = TransformerConfig(vocab_size=32000, hidden_size=4096,
+                               intermediate_size=14336, num_layers=32,
+                               num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                               norm="rmsnorm", activation="silu",
+                               position="rope", tie_embeddings=False,
+                               rope_theta=10000.0, dtype=jnp.bfloat16)
+TINY_TEST = TransformerConfig(vocab_size=256, hidden_size=64,
+                              intermediate_size=128, num_layers=2,
+                              num_heads=4, num_kv_heads=2, max_seq_len=128,
+                              norm="rmsnorm", activation="silu",
+                              position="rope", tie_embeddings=True)
+
+
+# ------------------------------------------------------------------ primitives
+
+def _norm(x, w, b, kind: str, eps: float):
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    if kind == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * lax.rsqrt(var + eps) * w.astype(jnp.float32)
+    else:
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        y = (x32 - mu) * lax.rsqrt(var + eps) * w.astype(jnp.float32) + b.astype(jnp.float32)
+    return y.astype(dt)
+
+
+def rope_table(max_len: int, head_dim: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                   # [T, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, T, H, D]; cos/sin: [T, D/2] (pre-sliced to positions)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def attention_reference(q, k, v, causal: bool = True, mask=None):
+    """Pure-XLA attention: q [B,T,H,D], k/v [B,S,KH,D] (GQA repeats kv)."""
+    B, T, H, D = q.shape
+    KH = k.shape[2]
+    if KH != H:
+        rep = H // KH
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    S = k.shape[1]
+    if causal:
+        qpos = jnp.arange(T)[:, None] + (S - T)
+        kpos = jnp.arange(S)[None, :]
+        cmask = qpos >= kpos
+        logits = jnp.where(cmask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _attention(q, k, v, cfg: TransformerConfig, causal=True):
+    if cfg.use_flash_attention and q.shape[1] == k.shape[1]:
+        try:
+            from ..ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=cfg.flash_block_q,
+                                   block_kv=cfg.flash_block_kv)
+        except Exception:
+            pass
+    return attention_reference(q, k, v, causal=causal)
+
+
+# ------------------------------------------------------------------- the model
+
+class CausalLM:
+    """Functional causal LM. ``init(rng) -> params``; ``apply(params, tokens)
+    -> logits``; ``loss(params, batch, rng) -> scalar``.
+
+    Params layout::
+
+        {"embed": {"wte": [V,H], ("wpe": [P,H])},
+         "layers": {...stacked leaves, leading dim = num_layers...},
+         "final_norm": {"w": [H], ("b": [H])},
+         ("lm_head": {"w": [H,V]})}
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng) -> Dict[str, Any]:
+        cfg = self.cfg
+        h, m, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+        hd, nh, kvh, L = cfg.head_dim, cfg.num_heads, cfg.kv_heads, cfg.num_layers
+        keys = jax.random.split(rng, 10)
+        std = 0.02
+
+        def normal(key, shape, scale=std):
+            return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
+
+        def layer_stack(key, shape, scale=std):
+            return (scale * jax.random.normal(key, (L,) + shape)).astype(jnp.float32)
+
+        ln_w = jnp.ones((L, h), jnp.float32)
+        layers = {
+            "attn_norm_w": ln_w,
+            "wq": layer_stack(keys[0], (h, nh * hd)),
+            "wk": layer_stack(keys[1], (h, kvh * hd)),
+            "wv": layer_stack(keys[2], (h, kvh * hd)),
+            "wo": layer_stack(keys[3], (nh * hd, h), scale=std / math.sqrt(2 * L)),
+            "mlp_norm_w": ln_w,
+            "w_in": layer_stack(keys[4], (h, m)),
+            "w_out": layer_stack(keys[5], (m, h), scale=std / math.sqrt(2 * L)),
+        }
+        if cfg.activation == "silu":
+            layers["w_gate"] = layer_stack(keys[6], (h, m))
+        if cfg.norm == "layernorm":
+            layers["attn_norm_b"] = jnp.zeros((L, h), jnp.float32)
+            layers["mlp_norm_b"] = jnp.zeros((L, h), jnp.float32)
+
+        params = {
+            "embed": {"wte": normal(keys[7], (v, h))},
+            "layers": layers,
+            "final_norm": {"w": jnp.ones((h,), jnp.float32)},
+        }
+        if cfg.position == "learned":
+            params["embed"]["wpe"] = normal(keys[8], (cfg.max_seq_len, h))
+        if cfg.norm == "layernorm":
+            params["final_norm"]["b"] = jnp.zeros((h,), jnp.float32)
+        if not cfg.tie_embeddings:
+            params["lm_head"] = {"w": normal(keys[9], (h, v))}
+        return params
+
+    # -- sharding specs -----------------------------------------------------
+    def param_specs(self) -> Dict[str, Any]:
+        """Logical-axis spec tree mirroring ``init``'s param tree
+        (consumed by parallel/sharding.py)."""
+        cfg = self.cfg
+        layers = {
+            "attn_norm_w": spec("layers", "embed"),
+            "wq": spec("layers", "embed", "heads"),
+            "wk": spec("layers", "embed", "kv_heads"),
+            "wv": spec("layers", "embed", "kv_heads"),
+            "wo": spec("layers", "heads", "embed"),
+            "mlp_norm_w": spec("layers", "embed"),
+            "w_in": spec("layers", "embed", "mlp"),
+            "w_out": spec("layers", "mlp", "embed"),
+        }
+        if cfg.activation == "silu":
+            layers["w_gate"] = spec("layers", "embed", "mlp")
+        if cfg.norm == "layernorm":
+            layers["attn_norm_b"] = spec("layers", "embed")
+            layers["mlp_norm_b"] = spec("layers", "embed")
+        specs = {
+            "embed": {"wte": spec("vocab", "embed")},
+            "layers": layers,
+            "final_norm": {"w": spec("embed")},
+        }
+        if cfg.position == "learned":
+            specs["embed"]["wpe"] = spec(None, "embed")
+        if cfg.norm == "layernorm":
+            specs["final_norm"]["b"] = spec("embed")
+        if not cfg.tie_embeddings:
+            specs["lm_head"] = {"w": spec("embed", "vocab")}
+        return specs
+
+    # -- one transformer block ---------------------------------------------
+    def _block(self, x, lp, cos, sin, rng, deterministic: bool):
+        cfg = self.cfg
+        B, T, H = x.shape
+        nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dt = cfg.dtype
+
+        def cast(w):
+            return w.astype(dt)
+
+        # attention
+        h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
+        q = (h1 @ cast(lp["wq"])).reshape(B, T, nh, hd)
+        k = (h1 @ cast(lp["wk"])).reshape(B, T, kvh, hd)
+        v = (h1 @ cast(lp["wv"])).reshape(B, T, kvh, hd)
+        if cfg.position == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        attn = _attention(q, k, v, cfg, causal=True)
+        attn = attn.reshape(B, T, nh * hd) @ cast(lp["wo"])
+        if cfg.dropout > 0 and not deterministic:
+            rng, sub = jax.random.split(rng)
+            attn = attn * jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
+        x = x + attn
+
+        # mlp
+        h2 = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm, cfg.norm_eps)
+        if cfg.activation == "silu":
+            y = jax.nn.silu(h2 @ cast(lp["w_gate"])) * (h2 @ cast(lp["w_in"]))
+        else:
+            y = jax.nn.gelu(h2 @ cast(lp["w_in"]), approximate=True)
+        y = y @ cast(lp["w_out"])
+        if cfg.dropout > 0 and not deterministic:
+            rng, sub = jax.random.split(rng)
+            y = y * jax.random.bernoulli(sub, 1 - cfg.dropout, y.shape) / (1 - cfg.dropout)
+        return x + y
+
+    # -- forward ------------------------------------------------------------
+    def apply(self, params, tokens, rng=None, deterministic: bool = True,
+              positions=None):
+        """tokens [B, T] int32 → logits [B, T, V] (in compute dtype)."""
+        cfg = self.cfg
+        B, T = tokens.shape
+        x = params["embed"]["wte"][tokens].astype(cfg.dtype)
+        if cfg.position == "learned":
+            pos = positions if positions is not None else jnp.arange(T)
+            x = x + params["embed"]["wpe"][pos].astype(cfg.dtype)
+            cos = sin = jnp.zeros((T, 1), jnp.float32)
+        else:
+            cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+            if positions is not None:
+                cos, sin = cos_full[positions], sin_full[positions]
+            else:
+                cos, sin = cos_full[:T], sin_full[:T]
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        block = self._block
+        if cfg.remat:
+            policy = None
+            if cfg.remat_policy == "dots_saveable":
+                policy = jax.checkpoint_policies.dots_saveable
+            elif cfg.remat_policy == "nothing_saveable":
+                policy = jax.checkpoint_policies.nothing_saveable
+            block = jax.checkpoint(block, policy=policy, static_argnums=(5,))
+
+        def scan_fn(carry, layer_params_and_key):
+            lp, key = layer_params_and_key
+            return block(carry, lp, cos, sin, key, deterministic), None
+
+        layer_keys = jax.random.split(rng, cfg.num_layers)
+        x, _ = lax.scan(scan_fn, x, (params["layers"], layer_keys))
+        x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
+                  cfg.norm, cfg.norm_eps)
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["wte"].T.astype(cfg.dtype)
+        else:
+            logits = x @ params["lm_head"]["w"].astype(cfg.dtype)
+        return logits
+
+    # -- loss ---------------------------------------------------------------
+    def loss(self, params, batch, rng=None):
+        """batch: {"input_ids": [B,T]} (labels = shifted inputs) or
+        {"input_ids", "labels"(, "loss_mask")}. Returns mean token NLL."""
+        tokens = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = tokens[:, 1:]
+            tokens = tokens[:, :-1]
+        mask = batch.get("loss_mask")
+        logits = self.apply(params, tokens, rng=rng, deterministic=rng is None)
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = logz - gold
+        if mask is not None:
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        return jnp.mean(nll)
+
+    # convenience
+    def num_params(self) -> int:
+        return self.cfg.num_params()

@@ -1,0 +1,89 @@
+"""Event monitoring behind one API: TensorBoard / W&B / CSV.
+
+Counterpart of reference ``deepspeed/monitor/monitor.py:29`` (``MonitorMaster``
+fan-out to ``TensorBoardMonitor`` tensorboard.py:13, ``WandbMonitor``
+wandb.py:12, ``csvMonitor`` csv_monitor.py:12). Events are
+``(tag, value, step)`` tuples; only process 0 writes.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, output_path: str, job_name: str = "job"):
+        self.dir = os.path.join(output_path or "csv_monitor", job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events: List[Event]):
+        for tag, value, step in events:
+            fname = os.path.join(self.dir, tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as fh:
+                w = csv.writer(fh)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, output_path: str, job_name: str = "job"):
+        from torch.utils.tensorboard import SummaryWriter  # lazy; torch is baked in
+
+        self.writer = SummaryWriter(log_dir=os.path.join(output_path or "runs", job_name))
+
+    def write_events(self, events: List[Event]):
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, float(value), step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, project: str, group=None, team=None):
+        import wandb
+
+        self.wandb = wandb
+        wandb.init(project=project, group=group, entity=team)
+
+    def write_events(self, events: List[Event]):
+        for tag, value, step in events:
+            self.wandb.log({tag: float(value)}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fans out to every enabled backend (reference monitor.py:29)."""
+
+    def __init__(self, config):
+        import jax
+
+        self.enabled = jax.process_index() == 0
+        self.backends: List[Monitor] = []
+        if not self.enabled:
+            return
+        try:
+            if config.csv_monitor.enabled:
+                self.backends.append(CSVMonitor(config.csv_monitor.output_path,
+                                                config.csv_monitor.job_name))
+            if config.tensorboard.enabled:
+                self.backends.append(TensorBoardMonitor(config.tensorboard.output_path,
+                                                        config.tensorboard.job_name))
+            if config.wandb.enabled:
+                self.backends.append(WandbMonitor(config.wandb.project,
+                                                  config.wandb.group, config.wandb.team))
+        except Exception:
+            pass
+
+    def write_events(self, events: List[Event]):
+        for b in self.backends:
+            b.write_events(events)

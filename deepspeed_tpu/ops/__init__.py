@@ -1,0 +1,19 @@
+"""ops — TPU-native kernels and fused numerical routines.
+
+Counterpart of the reference's ``deepspeed/ops/`` + ``csrc/`` stack
+(``FusedAdam`` ops/adam/fused_adam.py:18, transformer kernels
+csrc/transformer/, quantizer csrc/quantization/): optimizers are functional
+pytree updates XLA fuses into single kernels (the multi-tensor-apply role),
+attention/norm hot ops are Pallas kernels, quantization feeds ZeRO++-style
+compressed collectives.
+"""
+
+from .optimizers import (  # noqa: F401
+    OPTIMIZERS,
+    build_optimizer,
+    FusedAdam,
+    Lamb,
+    Lion,
+    SGD,
+    Adagrad,
+)

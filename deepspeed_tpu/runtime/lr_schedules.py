@@ -1,0 +1,160 @@
+"""LR schedules: WarmupLR / WarmupDecayLR / WarmupCosineLR / OneCycle /
+LRRangeTest.
+
+Counterpart of reference ``runtime/lr_schedules.py`` (:267 LRRangeTest,
+:370 OneCycle, :634 WarmupLR, WarmupDecayLR, WarmupCosineLR). The reference's
+schedulers mutate optimizer param groups per step from Python; here each
+schedule is a pure function ``step -> lr`` built from jnp ops so it traces
+into the jitted train step (no host round-trip per step). ``get_lr()`` /
+``step()`` host-side API is provided by the engine wrapper for parity.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.001, warmup_num_steps=1000,
+              warmup_type="log", **_) -> Schedule:
+    """Reference WarmupLR (lr_schedules.py:634): warm up then hold."""
+    warmup_num_steps = max(2, warmup_num_steps)
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(s / warmup_num_steps, 0.0, 1.0)
+        if warmup_type == "log":
+            # log(1+t)/log(1+T) ramp, matching the reference's log warmup
+            gamma = jnp.log1p(s) / math.log(1 + warmup_num_steps)
+            gamma = jnp.clip(gamma, 0.0, 1.0)
+        else:
+            gamma = frac
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * gamma
+
+    return sched
+
+
+def warmup_decay_lr(total_num_steps, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                    warmup_num_steps=1000, warmup_type="log", **_) -> Schedule:
+    """WarmupLR then linear decay to 0 over total_num_steps."""
+    base = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip((total_num_steps - s) / max(1.0, total_num_steps - warmup_num_steps),
+                         0.0, 1.0)
+        return jnp.where(s < warmup_num_steps, base(step), warmup_max_lr * decay)
+
+    return sched
+
+
+def warmup_cosine_lr(total_num_steps, warmup_min_ratio=0.0, warmup_num_steps=1000,
+                     cos_min_ratio=0.0001, lr=0.001, **_) -> Schedule:
+    """Reference WarmupCosineLR: linear warmup from min_ratio*lr, cosine to
+    cos_min_ratio*lr."""
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        warm = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            s / max(1, warmup_num_steps), 0.0, 1.0)
+        progress = jnp.clip((s - warmup_num_steps) /
+                            max(1.0, total_num_steps - warmup_num_steps), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * progress))
+        ratio = jnp.where(s < warmup_num_steps, warm, cos)
+        return lr * ratio
+
+    return sched
+
+
+def one_cycle(cycle_min_lr, cycle_max_lr, cycle_first_step_size=2000,
+              cycle_second_step_size=None, decay_step_size=0,
+              decay_lr_rate=0.0, **_) -> Schedule:
+    """Reference OneCycle (lr_schedules.py:370), LR part: ramp min→max over
+    first phase, max→min over second, then decay."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total = cycle_first_step_size + second
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        up = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.clip(
+            s / cycle_first_step_size, 0.0, 1.0)
+        down = cycle_max_lr - (cycle_max_lr - cycle_min_lr) * jnp.clip(
+            (s - cycle_first_step_size) / max(1, second), 0.0, 1.0)
+        in_cycle = jnp.where(s < cycle_first_step_size, up, down)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(s - total, 0.0) / decay_step_size
+            post = cycle_min_lr / (1.0 + decay_lr_rate * decay_steps)
+        else:
+            post = jnp.asarray(cycle_min_lr, jnp.float32)
+        return jnp.where(s <= total, in_cycle, post)
+
+    return sched
+
+
+def lr_range_test(lr_range_test_min_lr=1e-3, lr_range_test_step_size=2000,
+                  lr_range_test_step_rate=1.0, lr_range_test_staircase=False,
+                  **_) -> Schedule:
+    """Reference LRRangeTest (lr_schedules.py:267): LR sweep for tuning."""
+
+    def sched(step):
+        s = jnp.asarray(step, jnp.float32)
+        interval = jnp.floor(s / lr_range_test_step_size) if lr_range_test_staircase \
+            else s / lr_range_test_step_size
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return sched
+
+
+def constant_lr(lr=0.001, **_) -> Schedule:
+    def sched(step):
+        return jnp.full((), lr, jnp.float32)
+
+    return sched
+
+
+SCHEDULES = {
+    "warmuplr": warmup_lr,
+    "warmupdecaylr": warmup_decay_lr,
+    "warmupcosinelr": warmup_cosine_lr,
+    "onecycle": one_cycle,
+    "lrrangetest": lr_range_test,
+    "constant": constant_lr,
+}
+
+
+def build_schedule(type_name: Optional[str], params: Optional[dict] = None,
+                   fallback_lr: float = 1e-3) -> Schedule:
+    if type_name is None:
+        return constant_lr(lr=fallback_lr)
+    key = type_name.lower().replace("_", "")
+    if key not in SCHEDULES:
+        raise ValueError(f"Unknown scheduler {type_name!r}; known: {sorted(SCHEDULES)}")
+    return SCHEDULES[key](**(params or {}))
+
+
+class LRSchedulerShim:
+    """Host-side wrapper giving the reference's scheduler API
+    (``get_lr``/``get_last_lr``/``step``/``state_dict``) over a pure schedule."""
+
+    def __init__(self, schedule: Schedule, start_step: int = 0):
+        self.schedule = schedule
+        self.last_step = start_step
+
+    def step(self, increment: int = 1):
+        self.last_step += increment
+
+    def get_lr(self):
+        return [float(self.schedule(self.last_step))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd):
+        self.last_step = sd["last_step"]

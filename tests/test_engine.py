@@ -1,0 +1,155 @@
+"""End-to-end engine tests on the 8-device virtual mesh.
+
+Counterpart of reference tests/unit/runtime/test_ds_initialize.py and
+zero/test_zero.py: initialize → train loop → loss decreases, for each ZeRO
+stage, plus checkpoint round-trip (tests/unit/checkpoint/).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.models.transformer import TINY_TEST
+
+
+def tiny_data(n=64, seq=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(n, seq + 1), dtype=np.int64)}
+
+
+def make_config(stage=0, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_max_lr": 1e-3, "warmup_num_steps": 5}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"data": -1, "fsdp": 2},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 100,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(engine, data, steps=4):
+    loader = deepspeed_tpu.runtime.dataloader.RepeatingLoader(
+        engine.deepspeed_io(data))
+    it = iter(loader)
+    losses = []
+    for _ in range(steps):
+        for _ in range(engine.gradient_accumulation_steps()):
+            loss = engine(next(it))
+            engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_train(stage):
+    model = build_model("tiny")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=make_config(stage))
+    losses = run_steps(engine, tiny_data(), steps=6)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    assert engine.global_steps == 6
+
+
+def test_train_batch_api():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=make_config(1),
+        training_data=tiny_data())
+    loader = deepspeed_tpu.runtime.dataloader.RepeatingLoader(
+        engine.training_dataloader)
+    it = iter(loader)
+    l0 = float(engine.train_batch(it))
+    for _ in range(5):
+        l1 = float(engine.train_batch(it))
+    assert l1 < l0
+
+
+def test_eval_batch():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=make_config(2))
+    batch = {"input_ids": tiny_data(8)["input_ids"]}
+    loss = float(engine.eval_batch(batch))
+    assert np.isfinite(loss)
+
+
+def test_batch_size_resolution():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"),
+        config=make_config(0, train_micro_batch_size_per_gpu=2,
+                           gradient_accumulation_steps=4))
+    # dp world = 8 (data=4 × fsdp=2)
+    assert engine.train_batch_size() == 2 * 4 * 8
+
+
+def test_fp16_dynamic_loss_scale():
+    cfg = make_config(1)
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=cfg)
+    assert engine.loss_scale == 2.0 ** 8
+    losses = run_steps(engine, tiny_data(), steps=3)
+    assert np.isfinite(losses).all()
+
+
+def test_bf16():
+    cfg = make_config(2)
+    cfg["bf16"] = {"enabled": True}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=cfg)
+    losses = run_steps(engine, tiny_data(), steps=4)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    data = tiny_data()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=make_config(2))
+    run_steps(engine, data, steps=3)
+    tag_dir = engine.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+    assert tag_dir
+
+    # fresh engine, different mesh split → universal layout must still load
+    import deepspeed_tpu.parallel.topology as topo
+
+    topo.reset_topology()
+    cfg = make_config(3)
+    cfg["mesh"] = {"data": -1, "fsdp": 4}
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert client == {"note": "hi"}
+    assert engine2.global_steps == engine.global_steps
+
+    # params equal
+    import jax
+
+    p1 = jax.tree.leaves(engine.state.params)
+    p2 = jax.tree.leaves(engine2.state.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_save_16bit_model(tmp_path):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=make_config(0))
+    path = engine.save_16bit_model(str(tmp_path))
+    loaded = np.load(path)
+    assert "embed.wte" in loaded.files
+
+
+def test_zero3_param_sharding():
+    """ZeRO-3: large params must actually be sharded over the fsdp axis."""
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=make_config(3))
+    wte = engine.state.params["embed"]["wte"]
+    from deepspeed_tpu.parallel.topology import FSDP_AXIS
+
+    assert FSDP_AXIS in str(wte.sharding.spec), wte.sharding

@@ -1,0 +1,63 @@
+"""Launcher tests (reference tests/unit/launcher/test_run.py: hostfile
+parsing and resource filters)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import fetch_hostfile, parse_resource_filter
+
+
+def test_fetch_hostfile(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("""
+# comment
+worker-0 slots=4
+worker-1 slots=4   # trailing comment
+worker-2
+""")
+    hosts = fetch_hostfile(str(hf))
+    assert hosts == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+
+
+def test_fetch_hostfile_missing():
+    assert fetch_hostfile("/nonexistent/hostfile") == {}
+
+
+def test_duplicate_host_raises(tmp_path):
+    hf = tmp_path / "hostfile"
+    hf.write_text("a slots=1\na slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(hf))
+
+
+def test_include_filter():
+    hosts = {"a": 4, "b": 4, "c": 4}
+    assert parse_resource_filter(hosts, include="a@c") == {"a": 4, "c": 4}
+
+
+def test_exclude_filter():
+    hosts = {"a": 4, "b": 4}
+    assert parse_resource_filter(hosts, exclude="b") == {"a": 4}
+
+
+def test_include_and_exclude_conflict():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"a": 1}, include="a", exclude="a")
+
+
+def test_unknown_host_raises():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"a": 1}, include="zzz")
+
+
+def test_slot_filter_rejected():
+    with pytest.raises(ValueError):
+        parse_resource_filter({"a": 4}, include="a:0,1")
+
+
+def test_env_report_runs(capsys):
+    from deepspeed_tpu.env_report import main
+
+    main()
+    out = capsys.readouterr().out
+    assert "deepspeed_tpu version" in out
+    assert "accelerator" in out

