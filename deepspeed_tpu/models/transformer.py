@@ -60,6 +60,13 @@ class TransformerConfig:
     use_flash_attention: bool = True     # pallas kernel on TPU
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    attention_impl: str = "flash"        # "flash" | "reference" | "ring"
+    # MoE (reference deepspeed/moe/): >0 turns every MLP into a top-k MoE
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -74,6 +81,8 @@ class TransformerConfig:
         kvh = self.kv_heads * self.head_dim
         attn = h * h + 2 * h * kvh + h * h                 # q, k, v, o
         mlp = (3 if self.activation == "silu" else 2) * h * m
+        if self.moe_num_experts > 0:
+            mlp = mlp * self.moe_num_experts + h * self.moe_num_experts  # experts + router
         norms = (2 if self.norm == "rmsnorm" else 4) * h
         per_layer = attn + mlp + norms
         emb = v * h + (self.max_seq_len * h if self.position == "learned" else 0)
@@ -160,8 +169,9 @@ def attention_reference(q, k, v, causal: bool = True, mask=None):
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
-def _attention(q, k, v, cfg: TransformerConfig, causal=True):
-    if cfg.use_flash_attention and q.shape[1] == k.shape[1]:
+def _local_attention(q, k, v, cfg: TransformerConfig, causal=True):
+    if cfg.use_flash_attention and cfg.attention_impl != "reference" \
+            and q.shape[1] == k.shape[1]:
         try:
             from ..ops.flash_attention import flash_attention
 
@@ -171,6 +181,58 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
         except Exception:
             pass
     return attention_reference(q, k, v, causal=causal)
+
+
+def _seq_parallel_size() -> int:
+    from ..parallel import topology as topo
+
+    if not topo.has_topology():
+        return 1
+    return topo.get_topology().get_sequence_parallel_world_size()
+
+
+def _attention(q, k, v, cfg: TransformerConfig, causal=True):
+    """Dispatch: dense local attention, Ulysses all-to-all, or ring CP.
+
+    Under sequence parallelism (mesh ``sequence`` axis > 1) the attention
+    runs inside shard_map so the Pallas kernel operates on per-device
+    shards — GSPMD cannot partition custom kernels, so the sequence comm
+    (reference sequence/layer.py:37 Ulysses) is explicit here.
+    """
+    sp = _seq_parallel_size()
+    if sp <= 1:
+        return _local_attention(q, k, v, cfg, causal)
+
+    from functools import partial as _partial
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import topology as topo
+
+    t = topo.get_topology()
+    spec_ = P(topo.BATCH_AXES, topo.SEQUENCE_AXIS, None, None)
+
+    if cfg.attention_impl == "ring":
+        from ..sequence.ring_attention import ring_attention
+
+        fn = shard_map(_partial(ring_attention, causal=causal,
+                                axis_name=topo.SEQUENCE_AXIS),
+                       mesh=t.mesh, in_specs=(spec_, spec_, spec_),
+                       out_specs=spec_, check_vma=False)
+        return fn(q, k, v)
+
+    # Ulysses: all-to-all heads↔sequence around dense local attention
+    from ..sequence.layer import ulysses_attention
+
+    local = _partial(_local_attention, cfg=cfg, causal=causal)
+
+    def shard_fn(q, k, v):
+        return ulysses_attention(local, q, k, v)
+
+    fn = shard_map(shard_fn, mesh=t.mesh, in_specs=(spec_, spec_, spec_),
+                   out_specs=spec_, check_vma=False)
+    return fn(q, k, v)
 
 
 # ------------------------------------------------------------------- the model
@@ -195,7 +257,7 @@ class CausalLM:
         cfg = self.cfg
         h, m, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
         hd, nh, kvh, L = cfg.head_dim, cfg.num_heads, cfg.kv_heads, cfg.num_layers
-        keys = jax.random.split(rng, 10)
+        keys = jax.random.split(rng, 11)
         std = 0.02
 
         def normal(key, shape, scale=std):
@@ -212,11 +274,19 @@ class CausalLM:
             "wv": layer_stack(keys[2], (h, kvh * hd)),
             "wo": layer_stack(keys[3], (nh * hd, h), scale=std / math.sqrt(2 * L)),
             "mlp_norm_w": ln_w,
-            "w_in": layer_stack(keys[4], (h, m)),
-            "w_out": layer_stack(keys[5], (m, h), scale=std / math.sqrt(2 * L)),
         }
-        if cfg.activation == "silu":
-            layers["w_gate"] = layer_stack(keys[6], (h, m))
+        E = cfg.moe_num_experts
+        if E > 0:
+            layers["router_wg"] = layer_stack(keys[10], (h, E), scale=1.0 / math.sqrt(h))
+            layers["w_in"] = layer_stack(keys[4], (E, h, m))
+            layers["w_out"] = layer_stack(keys[5], (E, m, h), scale=std / math.sqrt(2 * L))
+            if cfg.activation == "silu":
+                layers["w_gate"] = layer_stack(keys[6], (E, h, m))
+        else:
+            layers["w_in"] = layer_stack(keys[4], (h, m))
+            layers["w_out"] = layer_stack(keys[5], (m, h), scale=std / math.sqrt(2 * L))
+            if cfg.activation == "silu":
+                layers["w_gate"] = layer_stack(keys[6], (h, m))
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = jnp.zeros((L, h), jnp.float32)
             layers["mlp_norm_b"] = jnp.zeros((L, h), jnp.float32)
@@ -246,11 +316,18 @@ class CausalLM:
             "wv": spec("layers", "embed", "kv_heads"),
             "wo": spec("layers", "heads", "embed"),
             "mlp_norm_w": spec("layers", "embed"),
-            "w_in": spec("layers", "embed", "mlp"),
-            "w_out": spec("layers", "mlp", "embed"),
         }
-        if cfg.activation == "silu":
-            layers["w_gate"] = spec("layers", "embed", "mlp")
+        if cfg.moe_num_experts > 0:
+            layers["router_wg"] = spec("layers", "embed", None)
+            layers["w_in"] = spec("layers", "expert", "embed", "mlp")
+            layers["w_out"] = spec("layers", "expert", "mlp", "embed")
+            if cfg.activation == "silu":
+                layers["w_gate"] = spec("layers", "expert", "embed", "mlp")
+        else:
+            layers["w_in"] = spec("layers", "embed", "mlp")
+            layers["w_out"] = spec("layers", "mlp", "embed")
+            if cfg.activation == "silu":
+                layers["w_gate"] = spec("layers", "embed", "mlp")
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = spec("layers", "embed")
             layers["mlp_norm_b"] = spec("layers", "embed")
@@ -292,22 +369,60 @@ class CausalLM:
             attn = attn * jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
         x = x + attn
 
-        # mlp
+        # mlp (dense or MoE)
         h2 = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm, cfg.norm_eps)
-        if cfg.activation == "silu":
-            y = jax.nn.silu(h2 @ cast(lp["w_gate"])) * (h2 @ cast(lp["w_in"]))
+        if cfg.moe_num_experts > 0:
+            y, l_aux = self._moe_mlp(h2, lp, rng, deterministic)
         else:
-            y = jax.nn.gelu(h2 @ cast(lp["w_in"]), approximate=True)
-        y = y @ cast(lp["w_out"])
+            l_aux = jnp.zeros((), jnp.float32)
+            if cfg.activation == "silu":
+                y = jax.nn.silu(h2 @ cast(lp["w_gate"])) * (h2 @ cast(lp["w_in"]))
+            else:
+                y = jax.nn.gelu(h2 @ cast(lp["w_in"]), approximate=True)
+            y = y @ cast(lp["w_out"])
         if cfg.dropout > 0 and not deterministic:
             rng, sub = jax.random.split(rng)
             y = y * jax.random.bernoulli(sub, 1 - cfg.dropout, y.shape) / (1 - cfg.dropout)
-        return x + y
+        return x + y, l_aux
+
+    def _moe_mlp(self, h2, lp, rng, deterministic):
+        """GShard top-k MoE MLP (reference moe/sharded_moe.py:477): gate +
+        shared dispatch/combine (moe/sharded_moe.py here) over the stacked
+        expert weights, whose expert dim is sharded over the ``expert`` axis."""
+        from ..moe.sharded_moe import moe_dispatch_combine, top1gating, top2gating
+
+        cfg = self.cfg
+        B, T, M = h2.shape
+        dt = cfg.dtype
+        tokens = h2.reshape(B * T, M)
+        logits = tokens.astype(jnp.float32) @ lp["router_wg"].astype(jnp.float32)
+        gate_rng = None if deterministic else rng
+        if cfg.moe_top_k == 1:
+            l_aux, combine, dispatch, _ = top1gating(
+                logits, cfg.moe_capacity_factor, cfg.moe_min_capacity, rng=gate_rng)
+        else:
+            l_aux, combine, dispatch, _ = top2gating(
+                logits, cfg.moe_capacity_factor, cfg.moe_min_capacity, rng=gate_rng)
+
+        def expert_fn(expert_in):  # [E, C, M]
+            w_in = lp["w_in"].astype(dt)
+            if cfg.activation == "silu":
+                hmid = jax.nn.silu(jnp.einsum("ecm,emf->ecf", expert_in,
+                                              lp["w_gate"].astype(dt))) \
+                    * jnp.einsum("ecm,emf->ecf", expert_in, w_in)
+            else:
+                hmid = jax.nn.gelu(jnp.einsum("ecm,emf->ecf", expert_in, w_in),
+                                   approximate=True)
+            return jnp.einsum("ecf,efm->ecm", hmid, lp["w_out"].astype(dt))
+
+        y = moe_dispatch_combine(tokens.astype(dt), combine, dispatch, expert_fn)
+        return y.reshape(B, T, M), l_aux
 
     # -- forward ------------------------------------------------------------
     def apply(self, params, tokens, rng=None, deterministic: bool = True,
-              positions=None):
-        """tokens [B, T] int32 → logits [B, T, V] (in compute dtype)."""
+              positions=None, return_aux: bool = False):
+        """tokens [B, T] int32 → logits [B, T, V] (in compute dtype).
+        With ``return_aux``, returns (logits, moe_aux_loss)."""
         cfg = self.cfg
         B, T = tokens.shape
         x = params["embed"]["wte"][tokens].astype(cfg.dtype)
@@ -324,6 +439,16 @@ class CausalLM:
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
+        if _seq_parallel_size() > 1:
+            # Ulysses/ring residency: activations live sequence-sharded
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel import topology as topo
+
+            t = topo.get_topology()
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(t.mesh, PartitionSpec(
+                    topo.BATCH_AXES, topo.SEQUENCE_AXIS, None)))
+
         block = self._block
         if cfg.remat:
             policy = None
@@ -335,16 +460,19 @@ class CausalLM:
 
         def scan_fn(carry, layer_params_and_key):
             lp, key = layer_params_and_key
-            return block(carry, lp, cos, sin, key, deterministic), None
+            x, aux = block(carry, lp, cos, sin, key, deterministic)
+            return x, aux
 
         layer_keys = jax.random.split(rng, cfg.num_layers)
-        x, _ = lax.scan(scan_fn, x, (params["layers"], layer_keys))
+        x, aux_losses = lax.scan(scan_fn, x, (params["layers"], layer_keys))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         if cfg.tie_embeddings:
             logits = x @ params["embed"]["wte"].T.astype(cfg.dtype)
         else:
             logits = x @ params["lm_head"]["w"].astype(cfg.dtype)
+        if return_aux:
+            return logits, jnp.sum(aux_losses)
         return logits
 
     # -- loss ---------------------------------------------------------------
@@ -357,14 +485,19 @@ class CausalLM:
             labels = tokens[:, 1:]
             tokens = tokens[:, :-1]
         mask = batch.get("loss_mask")
-        logits = self.apply(params, tokens, rng=rng, deterministic=rng is None)
+        logits, aux = self.apply(params, tokens, rng=rng,
+                                 deterministic=rng is None, return_aux=True)
         logits = logits.astype(jnp.float32)
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         nll = logz - gold
         if mask is not None:
-            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
-        return jnp.mean(nll)
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        else:
+            loss = jnp.mean(nll)
+        if self.cfg.moe_num_experts > 0:
+            loss = loss + self.cfg.moe_aux_loss_coef * aux
+        return loss
 
     # convenience
     def num_params(self) -> int:

@@ -1,0 +1,2 @@
+from .sharded_moe import TopKGate, top1gating, top2gating, moe_dispatch_combine  # noqa: F401
+from .layer import MoE  # noqa: F401

@@ -1,0 +1,95 @@
+"""MoE layer: gate + experts + dispatch, with expert-parallel sharding.
+
+Counterpart of reference ``deepspeed/moe/layer.py:16`` (``MoE``) and
+``moe/experts.py:10`` (``Experts``). Experts are a stacked parameter tree
+with leading dim = num_experts, sharded over the ``expert`` mesh axis by
+``parallel/sharding.py`` (logical axis "expert") — the reference's expert
+process groups (utils/groups.py:113,161) become that axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import spec
+from .sharded_moe import TopKGate, moe_dispatch_combine
+
+
+class MoE:
+    """Functional MoE FFN block.
+
+    ``init(rng) -> params``; ``apply(params, x, rng, train) ->
+    (y, l_aux, exp_counts)`` with x [..., M] (leading dims flattened to the
+    token dim internally).
+    """
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True, activation: str = "gelu",
+                 dtype=jnp.float32):
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.activation = activation
+        self.dtype = dtype
+        self.gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                             eval_capacity_factor, min_capacity,
+                             noisy_gate_policy, drop_tokens)
+
+    def init(self, rng):
+        E, M, F = self.num_experts, self.hidden_size, self.intermediate_size
+        k1, k2, k3, kg = jax.random.split(rng, 4)
+        std = 0.02
+        p = {
+            "gate": self.gate.init(kg),
+            "w_in": std * jax.random.normal(k1, (E, M, F), jnp.float32),
+            "w_out": std * jax.random.normal(k2, (E, F, M), jnp.float32),
+        }
+        if self.activation == "silu":
+            p["w_gate"] = std * jax.random.normal(k3, (E, M, F), jnp.float32)
+        return p
+
+    def param_specs(self):
+        s = {
+            "gate": {"wg": spec("embed", None)},
+            "w_in": spec("expert", "embed", "mlp"),
+            "w_out": spec("expert", "mlp", "embed"),
+        }
+        if self.activation == "silu":
+            s["w_gate"] = spec("expert", "embed", "mlp")
+        return s
+
+    def _expert_fn(self, params):
+        act = jax.nn.silu if self.activation == "silu" else \
+            (lambda z: jax.nn.gelu(z, approximate=True))
+
+        def fn(expert_in):  # [E, C, M]
+            w_in = params["w_in"].astype(self.dtype)
+            w_out = params["w_out"].astype(self.dtype)
+            h = jnp.einsum("ecm,emf->ecf", expert_in, w_in)
+            if self.activation == "silu":
+                g = jnp.einsum("ecm,emf->ecf", expert_in,
+                               params["w_gate"].astype(self.dtype))
+                h = jax.nn.silu(g) * h
+            else:
+                h = act(h)
+            return jnp.einsum("ecf,efm->ecm", h, w_out)
+
+        return fn
+
+    def apply(self, params, x, rng=None, train: bool = True):
+        orig_shape = x.shape
+        M = orig_shape[-1]
+        tokens = x.reshape(-1, M)
+        l_aux, combine, dispatch, exp_counts = self.gate(
+            params["gate"], tokens, rng, train)
+        y = moe_dispatch_combine(tokens.astype(self.dtype),
+                                 combine, dispatch,
+                                 self._expert_fn(params))
+        return y.reshape(orig_shape), l_aux, exp_counts

@@ -1,0 +1,154 @@
+"""Sharded MoE: top-k gating + capacity-based dispatch/combine.
+
+Counterpart of reference ``deepspeed/moe/sharded_moe.py`` (``top1gating``
+:184, ``top2gating`` :282, ``TopKGate`` :348, ``MOELayer.forward`` :477 with
+its two ``_AllToAll.apply`` :95 around expert compute). The TPU-native
+design is the original GShard formulation the reference itself derives from:
+dispatch and combine are einsums against a [tokens, experts, capacity]
+one-hot; with the expert dim of the expert parameters sharded over the
+``expert`` mesh axis and tokens sharded over data axes, XLA lowers the two
+einsums to exactly the reference's all-to-all pair — no hand-written
+dispatch code.
+
+Aux (load-balancing) loss follows the reference: ``l_aux = E · Σ_e me·ce``
+where ``me`` is mean gate prob and ``ce`` the fraction of tokens routed to
+expert e (sharded_moe.py:249).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx.astype(jnp.int32), n, dtype=jnp.float32)
+
+
+def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
+               rng: Optional[jax.Array] = None, noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True):
+    """Top-1 gating (reference sharded_moe.py:184).
+
+    logits [S, E] → (l_aux, combine [S,E,C], dispatch [S,E,C] bool, exp_counts).
+    """
+    S, E = logits.shape
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.normal(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(logits_w_noise, axis=-1)                 # [S]
+    mask1 = _one_hot(idx1, E)                                  # [S, E]
+    C = _capacity(S, E, capacity_factor, min_capacity) if drop_tokens else S
+
+    # position of each token within its expert's queue
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1             # [S, E]
+    loc1 = jnp.sum(locations1 * mask1, axis=-1)                # [S]
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    keep = (loc1 < C) & (mask1.sum(-1) > 0)
+    gate1 = jnp.sum(gates * mask1, axis=-1)                    # [S]
+    combine = (gate1 * keep)[:, None, None] * mask1[:, :, None] \
+        * _one_hot(loc1, C)[:, None, :]                        # [S, E, C]
+    dispatch = combine > 0
+    exp_counts = jnp.sum(mask1, axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
+               rng: Optional[jax.Array] = None):
+    """Top-2 gating (reference sharded_moe.py:282): second expert chosen from
+    masked logits; gates renormalized over the chosen pair."""
+    S, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    logits_no1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    if rng is not None:
+        # Gumbel-noise second-expert sampling (reference sharded_moe.py:297)
+        logits_no1 = logits_no1 + jax.random.gumbel(rng, logits.shape)
+    idx2 = jnp.argmax(logits_no1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    C = _capacity(S, E, capacity_factor * 2, min_capacity)
+
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1
+    locations2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+    loc1 = jnp.sum(locations1 * mask1, axis=-1)
+    loc2 = jnp.sum(locations2 * mask2, axis=-1)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    keep1 = loc1 < C
+    keep2 = loc2 < C
+    g1 = jnp.sum(gates * mask1, axis=-1) * keep1
+    g2 = jnp.sum(gates * mask2, axis=-1) * keep2
+    denom = jnp.clip(g1 + g2, 1e-9, None)
+    g1, g2 = g1 / denom, g2 / denom
+
+    combine = g1[:, None, None] * mask1[:, :, None] * _one_hot(loc1, C)[:, None, :] \
+        + g2[:, None, None] * mask2[:, :, None] * _one_hot(loc2, C)[:, None, :]
+    dispatch = combine > 0
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+    return l_aux, combine, dispatch, exp_counts
+
+
+class TopKGate:
+    """Gate module (reference sharded_moe.py:348): linear router + top-k."""
+
+    def __init__(self, hidden_size: int, num_experts: int, k: int = 1,
+                 capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
+                 min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True):
+        if k not in (1, 2):
+            raise ValueError("Only top-1 and top-2 gating supported (reference parity)")
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+
+    def init(self, rng):
+        scale = 1.0 / math.sqrt(self.hidden_size)
+        return {"wg": scale * jax.random.normal(
+            rng, (self.hidden_size, self.num_experts), jnp.float32)}
+
+    def __call__(self, params, x, rng=None, train: bool = True):
+        """x [S, M] → (l_aux, combine [S,E,C], dispatch, exp_counts)."""
+        logits = x.astype(jnp.float32) @ params["wg"]
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(logits, cf, self.min_capacity, rng,
+                              self.noisy_gate_policy if train else None,
+                              self.drop_tokens)
+        return top2gating(logits, cf, self.min_capacity, rng)
+
+
+def moe_dispatch_combine(x, combine, dispatch, expert_fn):
+    """The GShard einsum pair (reference MOELayer.forward sharded_moe.py:477).
+
+    x [S, M]; combine/dispatch [S, E, C]; expert_fn: [E, C, M] → [E, C, M]
+    (expert dim sharded over the ``expert`` mesh axis ⇒ XLA inserts the
+    all-to-alls here).
+    """
+    expert_in = jnp.einsum("sec,sm->ecm", dispatch.astype(x.dtype), x)
+    expert_out = expert_fn(expert_in)
+    return jnp.einsum("sec,ecm->sm", combine.astype(x.dtype), expert_out)

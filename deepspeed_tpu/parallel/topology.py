@@ -130,7 +130,9 @@ class MeshTopology:
         return NamedSharding(self.mesh, PartitionSpec(*spec_axes))
 
     def batch_sharding(self):
-        """Sharding for a [batch, ...] array: batch split over data+fsdp axes."""
+        """Sharding for a [batch, ...] array: batch split over data+fsdp axes.
+        (Sequence sharding happens on *activations* via in-model constraints —
+        raw token arrays are often seq+1 long and not divisible.)"""
         from jax.sharding import NamedSharding, PartitionSpec
 
         return NamedSharding(self.mesh, PartitionSpec(BATCH_AXES))

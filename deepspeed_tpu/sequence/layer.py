@@ -1,0 +1,89 @@
+"""Ulysses sequence parallelism — all-to-all head-scatter / seq-gather.
+
+Counterpart of reference ``deepspeed/sequence/layer.py:37``
+(``DistributedAttention`` wrapping any local attention between two
+``_SeqAllToAll`` ops :15): activations arrive sharded over the sequence
+dim; the first all-to-all re-shards to full sequence × sharded heads, local
+attention runs dense, the second all-to-all inverts. Per-link message volume
+is O(M/P) (the Ulysses property) because ICI all-to-all moves only 1/P of
+the tensor per hop.
+
+Two TPU-native forms are provided:
+
+1. :func:`ulysses_attention` — shard_map formulation with explicit
+   ``lax.all_to_all``. This is the production path:
+   ``models/transformer.py`` wraps it in a shard_map inside the jitted train
+   step (custom Pallas kernels must run on per-device shards — GSPMD cannot
+   partition them).
+2. :class:`DistributedAttention` — GSPMD formulation for user models built
+   on plain XLA ops: two ``with_sharding_constraint`` annotations around the
+   local attention; XLA lowers the resharding to the same ICI all-to-all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..parallel import topology as topo
+
+
+def ulysses_attention(local_attn: Callable, q, k, v, *args,
+                      seq_axis: str = topo.SEQUENCE_AXIS,
+                      scatter_dim: int = 2, gather_dim: int = 1, **kwargs):
+    """Inside shard_map with ``seq_axis`` bound: q/k/v are the local sequence
+    shard [B, T/P, H, D]. Scatters heads, gathers sequence, runs
+    ``local_attn`` on [B, T, H/P, D], inverts. Mirrors reference
+    ``_SeqAllToAll.apply`` (sequence/layer.py:15)."""
+
+    def fwd_a2a(t):
+        return lax.all_to_all(t, seq_axis, split_axis=scatter_dim,
+                              concat_axis=gather_dim, tiled=True)
+
+    def inv_a2a(t):
+        return lax.all_to_all(t, seq_axis, split_axis=gather_dim,
+                              concat_axis=scatter_dim, tiled=True)
+
+    out = local_attn(fwd_a2a(q), fwd_a2a(k), fwd_a2a(v), *args, **kwargs)
+    return inv_a2a(out)
+
+
+class DistributedAttention:
+    """GSPMD Ulysses (reference sequence/layer.py:37 API).
+
+    ``local_attn(q, k, v, *args, **kwargs) -> out`` with [B, T, H, D]
+    layouts. Under jit over a mesh whose ``sequence`` axis > 1, inputs are
+    expected sequence-sharded on dim 1; the sharding constraints flip to
+    head-sharded (dim 2) which XLA implements as the Ulysses all-to-all.
+    """
+
+    def __init__(self, local_attn: Callable,
+                 sequence_axis: str = topo.SEQUENCE_AXIS,
+                 batch_axes=topo.BATCH_AXES):
+        self.local_attn = local_attn
+        self.seq_axis = sequence_axis
+        self.batch_axes = batch_axes
+
+    def _sharding(self, *spec):
+        mesh = topo.get_topology().mesh
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    def __call__(self, q, k, v, *args, **kwargs):
+        t = topo.get_topology()
+        if t.get_sequence_parallel_world_size() <= 1:
+            return self.local_attn(q, k, v, *args, **kwargs)
+
+        ba = self.batch_axes
+        seq_sharded = self._sharding(ba, self.seq_axis, None, None)
+        head_sharded = self._sharding(ba, None, self.seq_axis, None)
+
+        # in: [B, T(sharded), H, D] → all-to-all → [B, T, H(sharded), D]
+        q, k, v = (lax.with_sharding_constraint(x, head_sharded)
+                   for x in (q, k, v))
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        # out: back to sequence-sharded
+        return lax.with_sharding_constraint(out, seq_sharded)

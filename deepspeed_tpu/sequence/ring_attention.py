@@ -1,0 +1,118 @@
+"""Ring attention — blockwise context parallelism over the sequence axis.
+
+Superset capability beyond the reference (SURVEY §2.3: the reference's only
+long-context mechanism is Ulysses all-to-all; no ring/blockwise CP exists in
+the snapshot). Ring attention removes Ulysses' head-count ceiling (Ulysses
+needs heads ≥ seq ranks): KV blocks rotate around the ``sequence`` mesh axis
+via ``lax.ppermute`` while each device keeps its local Q block, accumulating
+online-softmax partial results — comm overlaps compute and per-step message
+volume is the KV block size, riding ICI neighbor links.
+
+Causal masking is by *global* position: device i holds Q positions
+[i·T_loc, (i+1)·T_loc); at ring step s it sees KV from device (i - s) mod P.
+
+Use under ``shard_map`` with the sequence axis bound (the engine wires this
+when ``mesh.sequence > 1`` and ``attention_impl == "ring"``).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import topology as topo
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, k_off, causal: bool):
+    """Partial attention of local q against one kv block, returning
+    (unnormalized out, row max m, row sum l) for online-softmax merging.
+
+    q [B, Tq, H, D], k/v [B, Tk, KH, D]; offsets are global positions.
+    """
+    B, Tq, H, D = q.shape
+    KH = k.shape[2]
+    if KH != H:
+        k = jnp.repeat(k, H // KH, axis=2)
+        v = jnp.repeat(v, H // KH, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / math.sqrt(D)
+    if causal:
+        rows = q_off + jnp.arange(Tq)[:, None]
+        cols = k_off + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                                   # [B,H,Tq]
+    out = jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v)  # unnormalized
+    return out, m, l
+
+
+def ring_attention(q, k, v, causal: bool = True,
+                   axis_name: str = topo.SEQUENCE_AXIS):
+    """Blockwise ring attention inside shard_map.
+
+    q/k/v: local sequence shards [B, T_loc, H|KH, D]. Returns [B, T_loc, H, D].
+    """
+    P = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    T_loc = k.shape[1]
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def merge(carry, s, k_cur, v_cur):
+        acc, m_acc, l_acc = carry
+        src = (my - s) % P                      # whose KV block we hold now
+        out, m, l = _block_attn(q, k_cur, v_cur,
+                                q_off=my * Tq, k_off=src * T_loc,
+                                causal=causal)
+        # online softmax merge
+        m_new = jnp.maximum(m_acc, m)
+        a_old = jnp.exp(m_acc - m_new)
+        a_cur = jnp.exp(m - m_new)
+        acc = acc * a_old.transpose(0, 2, 1)[..., None] \
+            + out * a_cur.transpose(0, 2, 1)[..., None]
+        l_new = l_acc * a_old + l * a_cur
+        return acc, m_new, l_new
+
+    def step(carry, s):
+        k_cur, v_cur, *softmax_carry = carry
+        softmax_carry = merge(tuple(softmax_carry), s, k_cur, v_cur)
+        # rotate KV to the next device
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt) + softmax_carry, None
+
+    acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    if P > 1:
+        # rotate on all but the final block (the last rotation's result
+        # would be discarded — pure ICI waste at long-context scale)
+        (k, v, acc0, m0, l0), _ = lax.scan(
+            step, (k, v, acc0, m0, l0), jnp.arange(P - 1))
+    acc, m, l = merge((acc0, m0, l0), P - 1, k, v)
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, causal: bool = True,
+                           axis_name: str = topo.SEQUENCE_AXIS,
+                           batch_axes=None):
+    """Host-callable wrapper: shard_map ring_attention over the current mesh
+    (q/k/v global [B, T, H, D], sequence-sharded on dim 1). ``batch_axes``
+    (e.g. the engine's data axes) additionally split the batch dim; default
+    replicates it, which any batch size supports."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = topo.get_topology().mesh
+    spec = P(batch_axes, axis_name, None, None)
+    fn = shard_map(partial(ring_attention, causal=causal, axis_name=axis_name),
+                   mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                   check_vma=False)
+    return fn(q, k, v)
