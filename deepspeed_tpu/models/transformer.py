@@ -61,6 +61,7 @@ class TransformerConfig:
     flash_block_q: int = 512
     flash_block_kv: int = 512
     attention_impl: str = "flash"        # "flash" | "reference" | "ring"
+    pipeline_microbatches: int = 0       # 0 → pipe-axis size when pipelined
     # MoE (reference deepspeed/moe/): >0 turns every MLP into a top-k MoE
     moe_num_experts: int = 0
     moe_top_k: int = 1
@@ -189,6 +190,14 @@ def _seq_parallel_size() -> int:
     if not topo.has_topology():
         return 1
     return topo.get_topology().get_sequence_parallel_world_size()
+
+
+def _pipe_parallel_size() -> int:
+    from ..parallel import topology as topo
+
+    if not topo.has_topology():
+        return 1
+    return topo.get_topology().get_pipe_parallel_world_size()
 
 
 def _attention(q, k, v, cfg: TransformerConfig, causal=True):
@@ -389,7 +398,8 @@ class CausalLM:
         """GShard top-k MoE MLP (reference moe/sharded_moe.py:477): gate +
         shared dispatch/combine (moe/sharded_moe.py here) over the stacked
         expert weights, whose expert dim is sharded over the ``expert`` axis."""
-        from ..moe.sharded_moe import moe_dispatch_combine, top1gating, top2gating
+        from ..moe.sharded_moe import (
+            expert_mlp, moe_dispatch_combine, top1gating, top2gating)
 
         cfg = self.cfg
         B, T, M = h2.shape
@@ -405,15 +415,8 @@ class CausalLM:
                 logits, cfg.moe_capacity_factor, cfg.moe_min_capacity, rng=gate_rng)
 
         def expert_fn(expert_in):  # [E, C, M]
-            w_in = lp["w_in"].astype(dt)
-            if cfg.activation == "silu":
-                hmid = jax.nn.silu(jnp.einsum("ecm,emf->ecf", expert_in,
-                                              lp["w_gate"].astype(dt))) \
-                    * jnp.einsum("ecm,emf->ecf", expert_in, w_in)
-            else:
-                hmid = jax.nn.gelu(jnp.einsum("ecm,emf->ecf", expert_in, w_in),
-                                   approximate=True)
-            return jnp.einsum("ecf,efm->ecm", hmid, lp["w_out"].astype(dt))
+            return expert_mlp(expert_in, lp["w_in"], lp["w_out"],
+                              lp.get("w_gate"), cfg.activation, dt)
 
         y = moe_dispatch_combine(tokens.astype(dt), combine, dispatch, expert_fn)
         return y.reshape(B, T, M), l_aux
@@ -458,13 +461,32 @@ class CausalLM:
                 policy = jax.checkpoint_policies.nothing_saveable
             block = jax.checkpoint(block, policy=policy, static_argnums=(5,))
 
-        def scan_fn(carry, layer_params_and_key):
-            lp, key = layer_params_and_key
-            x, aux = block(carry, lp, cos, sin, key, deterministic)
-            return x, aux
-
         layer_keys = jax.random.split(rng, cfg.num_layers)
-        x, aux_losses = lax.scan(scan_fn, x, (params["layers"], layer_keys))
+        pp = _pipe_parallel_size()
+        if pp > 1:
+            # SPMD pipeline: layer dim sharded over the pipe axis, microbatch
+            # activations rotate via ppermute (parallel/pipeline.py).
+            from ..parallel.pipeline import pipelined_layer_apply
+            from ..parallel import topology as topo
+
+            def layer_fn(carry, layer_slice, micro_idx):
+                lp, key = layer_slice
+                # distinct dropout mask per microbatch
+                key = jax.random.fold_in(key, micro_idx)
+                return block(carry, lp, cos, sin, key, deterministic)
+
+            num_micro = cfg.pipeline_microbatches or pp
+            x, aux_sum = pipelined_layer_apply(
+                layer_fn, (params["layers"], layer_keys), x, num_micro,
+                mesh=topo.get_topology().mesh)
+            aux_losses = aux_sum[None]
+        else:
+            def scan_fn(carry, layer_params_and_key):
+                lp, key = layer_params_and_key
+                x, aux = block(carry, lp, cos, sin, key, deterministic)
+                return x, aux
+
+            x, aux_losses = lax.scan(scan_fn, x, (params["layers"], layer_keys))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         if cfg.tie_embeddings:

@@ -66,20 +66,11 @@ class MoE:
         return s
 
     def _expert_fn(self, params):
-        act = jax.nn.silu if self.activation == "silu" else \
-            (lambda z: jax.nn.gelu(z, approximate=True))
+        from .sharded_moe import expert_mlp
 
         def fn(expert_in):  # [E, C, M]
-            w_in = params["w_in"].astype(self.dtype)
-            w_out = params["w_out"].astype(self.dtype)
-            h = jnp.einsum("ecm,emf->ecf", expert_in, w_in)
-            if self.activation == "silu":
-                g = jnp.einsum("ecm,emf->ecf", expert_in,
-                               params["w_gate"].astype(self.dtype))
-                h = jax.nn.silu(g) * h
-            else:
-                h = act(h)
-            return jnp.einsum("ecf,efm->ecm", h, w_out)
+            return expert_mlp(expert_in, params["w_in"], params["w_out"],
+                              params.get("w_gate"), self.activation, self.dtype)
 
         return fn
 

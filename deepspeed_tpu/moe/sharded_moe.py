@@ -43,7 +43,9 @@ def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
     """
     S, E = logits.shape
     if noisy_gate_policy == "RSample" and rng is not None:
-        logits_w_noise = logits + jax.random.normal(rng, logits.shape)
+        # Gumbel-argmax = sampling from softmax(logits) (reference
+        # sharded_moe.py:194 gumbel_rsample)
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape)
     else:
         logits_w_noise = logits
     gates = jax.nn.softmax(logits, axis=-1)
@@ -140,6 +142,23 @@ class TopKGate:
                               self.noisy_gate_policy if train else None,
                               self.drop_tokens)
         return top2gating(logits, cf, self.min_capacity, rng)
+
+
+def expert_mlp(expert_in, w_in, w_out, w_gate=None, activation: str = "gelu",
+               dtype=None):
+    """Per-expert FFN over dispatched tokens [E, C, M] → [E, C, M] (the
+    expert compute of reference moe/experts.py:10). Shared by the MoE layer
+    and the in-model MoE path."""
+    if dtype is None:
+        dtype = expert_in.dtype
+    w_in = w_in.astype(dtype)
+    h = jnp.einsum("ecm,emf->ecf", expert_in, w_in)
+    if activation == "silu":
+        g = jnp.einsum("ecm,emf->ecf", expert_in, w_gate.astype(dtype))
+        h = jax.nn.silu(g) * h
+    else:
+        h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("ecf,efm->ecm", h, w_out.astype(dtype))
 
 
 def moe_dispatch_combine(x, combine, dispatch, expert_fn):

@@ -1,0 +1,133 @@
+"""SPMD pipeline parallelism: GPipe over the ``pipe`` mesh axis.
+
+Counterpart of reference ``runtime/pipe/engine.py`` (``PipelineEngine``
+:55, ``train_batch`` :312, ``_exec_schedule`` :1331 interpreting
+``PipeInstruction`` streams over P2P sends). The TPU-native design collapses
+the instruction interpreter + p2p protocol into ONE jitted collective
+program: the layer stack's leading dim is sharded over the ``pipe`` axis
+(each device holds L/P contiguous layers), microbatch activations rotate
+stage→stage via ``lax.ppermute`` inside a ``lax.scan`` over schedule ticks,
+and XLA's autodiff of that scan *is* the backward pipeline (reversed
+ppermutes, exact 1F1B-equivalent data flow in reverse). The warm-up/drain
+bubble is (P-1)/(M+P-1), identical to GPipe/the reference's TrainSchedule.
+
+Runs under ``shard_map`` with ONLY the pipe axis manual (``axis_names=
+{'pipe'}``): data/fsdp/tensor stay in GSPMD auto mode inside the body, so
+ZeRO sharding and tensor parallelism compose with the pipeline untouched.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import topology as topo
+
+
+def spmd_pipeline(layer_fn: Callable, local_layers, x, num_micro: int,
+                  axis_name: str = topo.PIPE_AXIS):
+    """Run a pipelined scan-over-layers inside shard_map.
+
+    ``layer_fn(carry, layer_slice, micro_idx) -> (carry, aux)`` — one
+    transformer block; ``micro_idx`` is the microbatch id (for per-microbatch
+    RNG folding) and ``aux`` a scalar auxiliary loss (e.g. MoE load
+    balancing; return 0.0 if unused). ``local_layers`` — pytree with leading
+    dim L/P (this stage's layers, as sliced by shard_map); ``x`` [B, T, H]
+    full activations (replicated over the pipe axis); ``num_micro`` M
+    pipeline microbatches (B % M == 0).
+
+    Returns ``(out [B, T, H], aux)`` on every stage: the last stage's output
+    broadcast, and the aux loss summed over layers/stages, averaged over
+    microbatches (comparable to the unpipelined full-batch value).
+    """
+    P = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    B = x.shape[0]
+    M = num_micro
+    assert B % M == 0, f"batch {B} not divisible by pipeline microbatches {M}"
+    mb = B // M
+    x_m = x.reshape(M, mb, *x.shape[1:])
+    n_ticks = M + P - 1
+
+    def run_local_layers(carry, micro_idx):
+        def body(c, lp):
+            y, aux = layer_fn(c, lp, micro_idx)
+            return y, aux
+
+        y, auxes = lax.scan(body, carry, local_layers)
+        return y, jnp.sum(auxes)
+
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def compute(state, t):
+        """One schedule tick: ingest, run local layers, record output."""
+        cur, out, aux_acc = state
+        # stage 0 ingests microbatch t (garbage ticks masked by clip+where)
+        m_in = jnp.clip(t, 0, M - 1)
+        inject = x_m[m_in]
+        cur = jnp.where(stage == 0, jnp.where(t < M, inject, cur), cur)
+        # this stage processes microbatch t-stage (may be out of range
+        # during fill/drain — masked below)
+        m_here = t - stage
+        y, aux = run_local_layers(cur, jnp.clip(m_here, 0, M - 1))
+        aux_acc = aux_acc + jnp.where((m_here >= 0) & (m_here < M), aux, 0.0)
+        # last stage records microbatch t-(P-1)
+        m_out = t - (P - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            out, y.astype(out.dtype), jnp.clip(m_out, 0, M - 1), 0)
+        valid = (m_out >= 0) & (stage == P - 1)
+        out = jnp.where(valid, upd, out)
+        return y, out, aux_acc
+
+    def tick(state, t):
+        y, out, aux_acc = compute(state, t)
+        # hand activations to the next stage
+        nxt = lax.ppermute(y, axis_name, perm)
+        return (nxt, out, aux_acc), None
+
+    # carries become stage-varying after the first tick; mark them so
+    var = lambda a: lax.pcast(a, (axis_name,), to="varying")  # noqa: E731
+    cur0 = var(jnp.zeros((mb,) + x.shape[1:], x.dtype))
+    out0 = var(jnp.zeros_like(x_m))
+    aux0 = var(jnp.zeros((), jnp.float32))
+    state = (cur0, out0, aux0)
+    if n_ticks > 1:
+        # rotate on all but the final tick (its ppermute result would be
+        # discarded — wasted ICI transfer each way)
+        state, _ = lax.scan(tick, state, jnp.arange(n_ticks - 1))
+    _, out, aux_acc = compute(state, n_ticks - 1)
+
+    # broadcast the last stage's output to all stages (final norm/unembed
+    # run replicated, exactly like the reference's loss broadcast
+    # pipe/engine.py:545 _aggregate_total_loss)
+    out = jnp.where(stage == P - 1, out, jnp.zeros_like(out))
+    out = lax.psum(out, axis_name)
+    aux = lax.psum(aux_acc, axis_name) / M
+    return out.reshape(B, *x.shape[1:]), aux
+
+
+def pipelined_layer_apply(layer_fn: Callable, stacked_layers, x,
+                          num_micro: int, mesh=None,
+                          axis_name: str = topo.PIPE_AXIS):
+    """Host-level wrapper: shard_map ``spmd_pipeline`` with only the pipe
+    axis manual. ``stacked_layers`` leaves have leading dim L (divisible by
+    the pipe axis size); ``x`` [B, T, H]. Returns ``(out, aux)``."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as Pspec
+
+    if mesh is None:
+        mesh = topo.get_topology().mesh
+
+    layer_specs = jax.tree.map(lambda _: Pspec(axis_name), stacked_layers)
+    fn = shard_map(
+        partial(spmd_pipeline, layer_fn, num_micro=num_micro,
+                axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(layer_specs, Pspec()),
+        out_specs=(Pspec(), Pspec()),
+        axis_names={axis_name})
+    return fn(stacked_layers, x)

@@ -45,7 +45,7 @@ DEFAULT_RULES: Dict[str, Optional[str]] = {
     "heads": topo.TENSOR_AXIS,  # attention heads dim (column-parallel QKV)
     "kv_heads": topo.TENSOR_AXIS,
     "head_dim": None,
-    "layers": None,           # stacked-layer leading dim (sharded over pipe later)
+    "layers": topo.PIPE_AXIS,  # stacked-layer leading dim → pipeline stages
     "expert": topo.EXPERT_AXIS,
     "seq": topo.SEQUENCE_AXIS,
     "batch": topo.DATA_AXIS,

@@ -171,10 +171,18 @@ class DeepSpeedTpuEngine:
             return None
 
     def _model_dtype_override(self):
-        """Push engine precision into the model config when possible."""
-        if isinstance(self.module, CausalLM) and self.module.cfg.dtype != self.compute_dtype:
-            self.module = CausalLM(dataclasses.replace(self.module.cfg,
-                                                       dtype=self.compute_dtype))
+        """Push engine precision + pipeline/remat settings into the model
+        config when the model is a framework CausalLM."""
+        if not isinstance(self.module, CausalLM):
+            return
+        over = {}
+        if self.module.cfg.dtype != self.compute_dtype:
+            over["dtype"] = self.compute_dtype
+        pmb = self.config.pipeline.micro_batches
+        if pmb and self.module.cfg.pipeline_microbatches != pmb:
+            over["pipeline_microbatches"] = pmb
+        if over:
+            self.module = CausalLM(dataclasses.replace(self.module.cfg, **over))
 
     def _init_state(self) -> TrainState:
         self._model_dtype_override()

@@ -1,0 +1,7 @@
+from .topology import ProcessTopology, PipeModelDataParallelTopology  # noqa: F401
+from .schedule import (  # noqa: F401
+    TrainSchedule, InferenceSchedule, PipeSchedule,
+    ForwardPass, BackwardPass, SendActivation, RecvActivation,
+    SendGrad, RecvGrad, LoadMicroBatch, ReduceGrads, ReduceTiedGrads,
+    OptimizerStep)
+from .module import LayerSpec, TiedLayerSpec, PipelineModule  # noqa: F401
