@@ -1,0 +1,2 @@
+from .engine import InferenceEngine  # noqa: F401
+from .config import InferenceConfig  # noqa: F401

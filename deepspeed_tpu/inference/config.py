@@ -1,0 +1,42 @@
+"""Inference config (reference deepspeed/inference/config.py:
+``DeepSpeedInferenceConfig``). Keeps the reference's key surface
+(dtype/tensor_parallel/max_out_tokens/replace_with_kernel_inject...) mapped
+onto the TPU runtime: kernel injection is a no-op (JAX models are already
+compiled+fused), tensor_parallel.tp_size maps to the mesh's tensor axis."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DSConfigModel
+
+
+class DeepSpeedTPConfig(DSConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class QuantizationConfig(DSConfigModel):
+    enabled: bool = False
+    bits: int = 8
+
+
+class InferenceConfig(DSConfigModel):
+    dtype: str = "bf16"
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig,
+                                               alias="tp")
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 1024
+    replace_with_kernel_inject: bool = False   # accepted; meaningless on TPU
+    replace_method: str = "auto"
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    checkpoint: Optional[str] = None
+    zero_allow_untested_optimizer: bool = True
+    enable_cuda_graph: bool = False            # XLA compiles whole graphs anyway
+    set_empty_params: bool = False
+    save_mp_checkpoint_path: Optional[str] = None
+    ep_size: int = 1
+    moe: Dict[str, Any] = Field(default_factory=dict)

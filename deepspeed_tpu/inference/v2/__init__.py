@@ -1,0 +1,3 @@
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig  # noqa: F401
+from .scheduler import ContinuousBatchingScheduler, Request  # noqa: F401
+from .scheduling_utils import SchedulingResult, SchedulingError  # noqa: F401

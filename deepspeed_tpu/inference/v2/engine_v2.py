@@ -1,0 +1,119 @@
+"""InferenceEngineV2 — FastGen-style ragged continuous-batching engine.
+
+Counterpart of reference ``inference/v2/engine_v2.py:26``
+(``InferenceEngineV2``: ``put`` :89 runs one forward over a ragged batch,
+``query``/``can_schedule`` :161 for admission control, ``flush`` frees a
+sequence's KV blocks). The serving loop on top (Dynamic SplitFuse) lives in
+``scheduler.py`` — in the reference that loop is DeepSpeed-MII.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import CausalLM
+from ...utils.logging import logger
+from .paged_model import PagedCausalLM
+from .ragged import BlockedAllocator, DSStateManager, RaggedBatchWrapper
+from .scheduling_utils import SchedulingError, SchedulingResult
+
+
+class RaggedInferenceEngineConfig:
+    def __init__(self, max_ragged_batch_size: int = 768,
+                 max_ragged_sequence_count: int = 32,
+                 max_chunk_tokens: int = 256,
+                 kv_blocks: int = 512, kv_block_size: int = 16,
+                 max_tracked_sequences: int = 256):
+        self.max_ragged_batch_size = max_ragged_batch_size
+        self.max_ragged_sequence_count = max_ragged_sequence_count
+        self.max_chunk_tokens = max_chunk_tokens
+        self.kv_blocks = kv_blocks
+        self.kv_block_size = kv_block_size
+        self.max_tracked_sequences = max_tracked_sequences
+
+
+class InferenceEngineV2:
+    def __init__(self, model: CausalLM, params=None,
+                 config: Optional[RaggedInferenceEngineConfig] = None):
+        self.config = config or RaggedInferenceEngineConfig()
+        self.model = model
+        if params is None:
+            params = model.init(jax.random.PRNGKey(0))
+        self.params = params
+
+        cfg = model.cfg
+        max_blocks_per_seq = -(-cfg.max_seq_len // self.config.kv_block_size)
+        self.state_manager = DSStateManager(
+            cfg, self.config.max_tracked_sequences, self.config.kv_blocks,
+            self.config.kv_block_size)
+        self.paged = PagedCausalLM(model, self.config.kv_block_size,
+                                   max_blocks_per_seq)
+        self.batch = RaggedBatchWrapper(self.config.max_ragged_sequence_count,
+                                        self.config.max_chunk_tokens,
+                                        max_blocks_per_seq)
+
+    # ----------------------------------------------------------- admission
+    def can_schedule(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> SchedulingResult:
+        """Reference engine_v2.py:161: can this (uids, lengths) batch run?"""
+        if len(uids) > self.config.max_ragged_sequence_count:
+            return SchedulingResult.BatchSequenceLimitExceeded
+        if sum(lengths) > self.config.max_ragged_batch_size:
+            return SchedulingResult.BatchTokenLimitExceeded
+        blocks_needed = 0
+        for uid, n in zip(uids, lengths):
+            if n > self.config.max_chunk_tokens:
+                return SchedulingResult.SequenceTokenLimitExceeded
+            seq = self.state_manager.get_sequence(uid)
+            total = (seq.seen_tokens if seq else 0) + n
+            if total > self.model.cfg.max_seq_len:
+                return SchedulingResult.SequenceTokenLimitExceeded
+            have = seq.cur_allocated_blocks if seq else 0
+            need = -(-total // self.config.kv_block_size)
+            blocks_needed += max(0, need - have)
+        if blocks_needed > self.state_manager.free_blocks:
+            return SchedulingResult.KVCacheLimitExceeded
+        return SchedulingResult.Success
+
+    def query(self, uid: int) -> Tuple[int, int]:
+        """(seen_tokens, allocated_blocks) for a sequence (reference query)."""
+        seq = self.state_manager.get_sequence(uid)
+        if seq is None:
+            return (0, 0)
+        return (seq.seen_tokens, seq.cur_allocated_blocks)
+
+    # -------------------------------------------------------------- serving
+    def put(self, uids: Sequence[int],
+            tokens_list: Sequence[Sequence[int]]) -> jnp.ndarray:
+        """Run one forward over the ragged batch; returns next-token logits
+        [len(uids), vocab] (reference engine_v2.py:89)."""
+        status = self.can_schedule(uids, [len(t) for t in tokens_list])
+        if status != SchedulingResult.Success:
+            raise SchedulingError(status)
+
+        self.batch.clear()
+        for uid, toks in zip(uids, tokens_list):
+            seq = self.state_manager.get_or_create_sequence(uid)
+            self.state_manager.maybe_allocate_kv(seq, len(toks))
+            self.batch.insert_sequence(uid, list(toks), seq.seen_tokens,
+                                       seq.kv_blocks)
+            seq.seen_tokens += len(toks)
+
+        arrays = self.batch.finalize()
+        logits, new_cache = self.paged.forward(
+            self.params, self.state_manager.kv_cache,
+            jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["start_pos"]),
+            jnp.asarray(arrays["n_tokens"]), jnp.asarray(arrays["block_tables"]))
+        self.state_manager.kv_cache = new_cache
+        return logits[:len(uids)]
+
+    def flush(self, uid: int) -> None:
+        self.state_manager.flush_sequence(uid)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.state_manager.free_blocks

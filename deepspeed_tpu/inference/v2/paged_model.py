@@ -1,0 +1,143 @@
+"""Paged ragged-batch forward over a CausalLM.
+
+Counterpart of the reference FastGen model stack
+(``inference/v2/model_implementations/inference_transformer_base.py:616``
+with the ragged kernel suite: ``linear_blocked_kv_rotary`` KV write,
+``blocked_flash`` attention over atoms, ``logits_gather``). One jitted
+function processes a mixed prefill/decode ragged batch with static shapes:
+
+- tokens [N, C] padded chunks, per-seq ``start_pos`` (tokens already
+  cached) and ``n_tokens`` (valid width) — Dynamic SplitFuse feeds both
+  prompt chunks and single decode tokens through this same path;
+- paged KV cache [L, num_blocks, bs, KH, D] with per-seq block tables;
+  writes use flat scatter indices (drop-mode for padding), reads gather the
+  table into [N, max_ctx, KH, D] and mask — the XLA formulation of the
+  blocked-flash atom walk (a Pallas paged kernel slots in behind the same
+  signature);
+- returns logits only at each sequence's last valid token (logits_gather).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...models.transformer import CausalLM, _norm, apply_rope, rope_table
+
+
+class PagedCausalLM:
+    """Wraps a CausalLM's weights with a paged ragged forward."""
+
+    def __init__(self, model: CausalLM, block_size: int,
+                 max_blocks_per_seq: int):
+        self.model = model
+        self.cfg = model.cfg
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.forward = jax.jit(self._forward)
+
+    # ------------------------------------------------------------------
+    def _forward(self, params, kv_cache, tokens, start_pos, n_tokens,
+                 block_tables):
+        """tokens [N, C]; start_pos/n_tokens [N]; block_tables [N, MB];
+        kv_cache {k,v}: [L, NB, BS, KH, D].
+
+        Returns (last_logits [N, V], new_kv_cache).
+        """
+        cfg = self.cfg
+        N, C = tokens.shape
+        bs = self.block_size
+        NB = kv_cache["k"].shape[1]
+        MB = block_tables.shape[1]
+        dt = cfg.dtype
+
+        x = params["embed"]["wte"][tokens].astype(dt)          # [N, C, H]
+        positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [N, C]
+        if cfg.position == "rope":
+            cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.head_dim,
+                                            cfg.rope_theta)
+            cos = cos_full[positions]                           # [N, C, D/2]
+            sin = sin_full[positions]
+        else:
+            x = x + params["embed"]["wpe"][positions].astype(dt)
+            cos = sin = None
+
+        valid = jnp.arange(C)[None, :] < n_tokens[:, None]      # [N, C]
+
+        # scatter indices for KV writes: flat position in [NB*bs]
+        blk_idx = positions // bs                               # [N, C]
+        blk_off = positions % bs
+        blk_ids = jnp.take_along_axis(
+            block_tables, jnp.clip(blk_idx, 0, MB - 1), axis=1)  # [N, C]
+        write_idx = jnp.where(valid & (blk_ids >= 0),
+                              blk_ids * bs + blk_off, -1)        # -1 → dropped
+
+        # gather indices for attention reads: all table positions
+        ctx_positions = jnp.arange(MB * bs)                      # [MB*bs]
+        tbl = jnp.repeat(block_tables, bs, axis=1)               # [N, MB*bs]
+        read_idx = jnp.where(tbl >= 0,
+                             tbl * bs + ctx_positions % bs, 0)   # [N, MB*bs]
+        ctx_len = start_pos + n_tokens                           # [N]
+        ctx_valid = ctx_positions[None, :] < ctx_len[:, None]    # [N, MB*bs]
+
+        def rope_q(q):
+            if cfg.position != "rope":
+                return q
+            # apply_rope expects [B, T, H, D] with tables [T, D/2]; here the
+            # tables are per-(seq, pos): inline the rotation
+            q1, q2 = jnp.split(q, 2, axis=-1)
+            c = cos[:, :, None, :]
+            s = sin[:, :, None, :]
+            return jnp.concatenate([q1 * c - q2 * s, q2 * c + q1 * s],
+                                   axis=-1).astype(q.dtype)
+
+        def block(x, xs):
+            lp, kc, vc = xs   # kc/vc [NB, bs, KH, D]
+            h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
+                       cfg.norm, cfg.norm_eps)
+            nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+            q = rope_q((h1 @ lp["wq"].astype(dt)).reshape(N, C, nh, hd))
+            k = rope_q((h1 @ lp["wk"].astype(dt)).reshape(N, C, kvh, hd))
+            v = (h1 @ lp["wv"].astype(dt)).reshape(N, C, kvh, hd)
+
+            # paged KV write (reference linear_blocked_kv_rotary kernel)
+            kc_flat = kc.reshape(NB * bs, kvh, hd)
+            vc_flat = vc.reshape(NB * bs, kvh, hd)
+            flat_w = write_idx.reshape(-1)
+            kc_flat = kc_flat.at[flat_w].set(
+                k.reshape(-1, kvh, hd), mode="drop")
+            vc_flat = vc_flat.at[flat_w].set(
+                v.reshape(-1, kvh, hd), mode="drop")
+
+            # paged read (reference blocked_flash over atoms)
+            k_ctx = kc_flat[read_idx]                  # [N, MB*bs, KH, D]
+            v_ctx = vc_flat[read_idx]
+            if kvh != nh:
+                k_ctx = jnp.repeat(k_ctx, nh // kvh, axis=2)
+                v_ctx = jnp.repeat(v_ctx, nh // kvh, axis=2)
+            scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            s = jnp.einsum("nchd,nshd->nhcs", q, k_ctx).astype(jnp.float32) * scale
+            causal = positions[:, None, :, None] >= ctx_positions[None, None, None, :]
+            mask = causal & ctx_valid[:, None, None, :] & valid[:, None, :, None]
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(dt)
+            attn = jnp.einsum("nhcs,nshd->nchd", p, v_ctx).reshape(N, C, nh * hd)
+            x = x + attn @ lp["wo"].astype(dt)
+            x = self.model._mlp(x, lp)
+            return x, (kc_flat.reshape(NB, bs, kvh, hd),
+                       vc_flat.reshape(NB, bs, kvh, hd))
+
+        x, (new_k, new_v) = lax.scan(block, x,
+                                     (params["layers"], kv_cache["k"],
+                                      kv_cache["v"]))
+        x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
+                  cfg.norm, cfg.norm_eps)
+        # logits_gather: only the last valid token per sequence
+        last_idx = jnp.clip(n_tokens - 1, 0, C - 1)
+        x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
+        logits = self.model._unembed(params, x_last[:, None, :])[:, 0]
+        return logits, {"k": new_k, "v": new_v}

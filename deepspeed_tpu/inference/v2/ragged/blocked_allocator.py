@@ -1,0 +1,41 @@
+"""Paged-KV block allocator (reference inference/v2/ragged/blocked_allocator.py).
+
+Free-list allocator over a fixed pool of KV blocks; the reference implements
+this as a linked list in a torch tensor — host-side Python is equally fast
+at this scale and keeps the device program pure.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class BlockedAllocator:
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least one block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> List[int]:
+        if num_blocks > len(self._free):
+            raise ValueError(
+                f"cannot allocate {num_blocks} blocks ({len(self._free)} free)")
+        out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        seen = set(self._free)
+        for b in blocks:
+            if b < 0 or b >= self._num_blocks or b in seen:
+                raise ValueError(f"invalid or double free of block {b}")
+            seen.add(b)
+        self._free.extend(blocks)

@@ -1,0 +1,83 @@
+"""Ragged sequence state: descriptors, block tables, paged KV cache.
+
+Counterpart of reference ``inference/v2/ragged/ragged_manager.py``
+(``DSStateManager``), ``sequence_descriptor.py`` (``DSSequenceDescriptor``)
+and ``kv_cache.py`` (``BlockedKVCache``): tracks per-sequence seen-token
+counts and KV block ownership, allocates blocks on demand, and owns the
+device-side paged cache tensors [L, num_blocks, block_size, KH, D].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from .blocked_allocator import BlockedAllocator
+
+
+@dataclass
+class DSSequenceDescriptor:
+    uid: int
+    seen_tokens: int = 0                   # tokens already in the KV cache
+    kv_blocks: List[int] = field(default_factory=list)
+    input_tokens: List[int] = field(default_factory=list)  # pending prompt
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.kv_blocks)
+
+
+class DSStateManager:
+    """Sequence registry + paged KV cache (reference ragged_manager.py:204)."""
+
+    def __init__(self, model_cfg, max_tracked_sequences: int = 256,
+                 num_blocks: int = 256, block_size: int = 16,
+                 dtype=None):
+        self.cfg = model_cfg
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.max_tracked_sequences = max_tracked_sequences
+        self.allocator = BlockedAllocator(num_blocks)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        dt = dtype or model_cfg.dtype
+        shape = (model_cfg.num_layers, num_blocks, block_size,
+                 model_cfg.kv_heads, model_cfg.head_dim)
+        self.kv_cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    # -- sequence registry -------------------------------------------------
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        if uid not in self._seqs:
+            if len(self._seqs) >= self.max_tracked_sequences:
+                raise RuntimeError("max tracked sequences exceeded")
+            self._seqs[uid] = DSSequenceDescriptor(uid=uid)
+        return self._seqs[uid]
+
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def flush_sequence(self, uid: int) -> None:
+        """Release a finished sequence's blocks (reference engine_v2.flush)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is not None and seq.kv_blocks:
+            self.allocator.free(seq.kv_blocks)
+
+    @property
+    def tracked_sequences(self) -> List[int]:
+        return list(self._seqs)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    # -- block math ---------------------------------------------------------
+    def blocks_needed(self, seq: DSSequenceDescriptor, new_tokens: int) -> int:
+        total = seq.seen_tokens + new_tokens
+        need = -(-total // self.block_size)   # ceil
+        return max(0, need - len(seq.kv_blocks))
+
+    def maybe_allocate_kv(self, seq: DSSequenceDescriptor, new_tokens: int):
+        need = self.blocks_needed(seq, new_tokens)
+        if need > 0:
+            seq.kv_blocks.extend(self.allocator.allocate(need))

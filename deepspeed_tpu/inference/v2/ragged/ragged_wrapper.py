@@ -1,0 +1,66 @@
+"""Ragged batch descriptor: host-side assembly of the padded device batch.
+
+Counterpart of reference ``inference/v2/ragged/ragged_wrapper.py``
+(``RaggedBatchWrapper`` :267 — token concatenation + inflight descriptors
+uploaded via the pinned fast_host_buffer). The TPU program wants *static*
+shapes, so the wrapper pads to (max_seqs, max_chunk) and carries per-seq
+metadata arrays; XLA masks do the ragged part. One wrapper instance is
+reused across steps (buffers re-filled, no allocation per step).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+class RaggedBatchWrapper:
+    def __init__(self, max_seqs: int, max_chunk: int, max_blocks_per_seq: int):
+        self.max_seqs = max_seqs
+        self.max_chunk = max_chunk
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.clear()
+
+    def clear(self):
+        ms, mc, mb = self.max_seqs, self.max_chunk, self.max_blocks_per_seq
+        self.tokens = np.zeros((ms, mc), np.int32)
+        self.start_pos = np.zeros((ms,), np.int32)     # tokens already cached
+        self.n_tokens = np.zeros((ms,), np.int32)      # new tokens this step
+        self.block_tables = np.full((ms, mb), -1, np.int32)
+        self.uids: List[int] = []
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self.uids)
+
+    @property
+    def current_tokens(self) -> int:
+        return int(self.n_tokens.sum())
+
+    def insert_sequence(self, uid: int, tokens: Sequence[int], start_pos: int,
+                        kv_blocks: Sequence[int]) -> int:
+        """Add one sequence's chunk; returns its row index."""
+        i = len(self.uids)
+        if i >= self.max_seqs:
+            raise ValueError("ragged batch full (max_seqs)")
+        n = len(tokens)
+        if n > self.max_chunk:
+            raise ValueError(f"chunk {n} > max_chunk {self.max_chunk}")
+        if len(kv_blocks) > self.max_blocks_per_seq:
+            raise ValueError("sequence exceeds max_blocks_per_seq")
+        self.tokens[i, :n] = np.asarray(tokens, np.int32)
+        self.start_pos[i] = start_pos
+        self.n_tokens[i] = n
+        self.block_tables[i, :len(kv_blocks)] = np.asarray(kv_blocks, np.int32)
+        self.uids.append(uid)
+        return i
+
+    def finalize(self) -> Dict[str, np.ndarray]:
+        """Device-ready arrays (the reference's pinned-buffer upload)."""
+        return {
+            "tokens": self.tokens,
+            "start_pos": self.start_pos,
+            "n_tokens": self.n_tokens,
+            "block_tables": self.block_tables,
+        }

@@ -357,42 +357,36 @@ class CausalLM:
     def _block(self, x, lp, cos, sin, rng, deterministic: bool):
         cfg = self.cfg
         B, T, H = x.shape
-        nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-        dt = cfg.dtype
 
-        def cast(w):
-            return w.astype(dt)
-
-        # attention
+        # attention (projections shared with the KV-cache/paged paths)
         h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
-        q = (h1 @ cast(lp["wq"])).reshape(B, T, nh, hd)
-        k = (h1 @ cast(lp["wk"])).reshape(B, T, kvh, hd)
-        v = (h1 @ cast(lp["wv"])).reshape(B, T, kvh, hd)
-        if cfg.position == "rope":
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+        q, k, v = self._qkv(h1, lp, cos, sin, B, T)
         attn = _attention(q, k, v, cfg, causal=True)
-        attn = attn.reshape(B, T, nh * hd) @ cast(lp["wo"])
+        attn = attn.reshape(B, T, -1) @ lp["wo"].astype(cfg.dtype)
         if cfg.dropout > 0 and not deterministic:
             rng, sub = jax.random.split(rng)
             attn = attn * jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
         x = x + attn
 
-        # mlp (dense or MoE)
+        # mlp (dense or MoE; body shared with the inference paths)
         h2 = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm, cfg.norm_eps)
-        if cfg.moe_num_experts > 0:
-            y, l_aux = self._moe_mlp(h2, lp, rng, deterministic)
-        else:
-            l_aux = jnp.zeros((), jnp.float32)
-            if cfg.activation == "silu":
-                y = jax.nn.silu(h2 @ cast(lp["w_gate"])) * (h2 @ cast(lp["w_in"]))
-            else:
-                y = jax.nn.gelu(h2 @ cast(lp["w_in"]), approximate=True)
-            y = y @ cast(lp["w_out"])
+        y, l_aux = self._mlp_body(h2, lp, rng, deterministic)
         if cfg.dropout > 0 and not deterministic:
             rng, sub = jax.random.split(rng)
             y = y * jax.random.bernoulli(sub, 1 - cfg.dropout, y.shape) / (1 - cfg.dropout)
         return x + y, l_aux
+
+    def _mlp_body(self, h2, lp, rng, deterministic: bool):
+        """Dense or MoE FFN on normed input; returns (y, aux_loss)."""
+        cfg = self.cfg
+        if cfg.moe_num_experts > 0:
+            return self._moe_mlp(h2, lp, rng, deterministic)
+        dt = cfg.dtype
+        if cfg.activation == "silu":
+            y = jax.nn.silu(h2 @ lp["w_gate"].astype(dt)) * (h2 @ lp["w_in"].astype(dt))
+        else:
+            y = jax.nn.gelu(h2 @ lp["w_in"].astype(dt), approximate=True)
+        return y @ lp["w_out"].astype(dt), jnp.zeros((), jnp.float32)
 
     def _moe_mlp(self, h2, lp, rng, deterministic):
         """GShard top-k MoE MLP (reference moe/sharded_moe.py:477): gate +
@@ -496,6 +490,119 @@ class CausalLM:
         if return_aux:
             return logits, jnp.sum(aux_losses)
         return logits
+
+    # -- KV-cache inference (reference inference v1: model_implementations/
+    # transformers/ds_transformer.py decode path) ---------------------------
+    def init_cache(self, batch_size: int, max_len: int):
+        cfg = self.cfg
+        shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
+        return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+    def prefill(self, params, tokens, cache):
+        """Process a full prompt, filling cache[:, :, :T]. Returns
+        (logits [B, T, V], cache)."""
+        cfg = self.cfg
+        B, T = tokens.shape
+        x = params["embed"]["wte"][tokens].astype(cfg.dtype)
+        cos, sin = self._pos_tables(T, None)
+        if cfg.position == "learned":
+            x = x + params["embed"]["wpe"][jnp.arange(T)].astype(cfg.dtype)
+
+        def body(carry, xs):
+            x = carry
+            lp, kc, vc = xs
+            x, k, v = self._block_kv(x, lp, cos, sin)
+            kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
+            vc = lax.dynamic_update_slice(vc, v, (0, 0, 0, 0))
+            return x, (kc, vc)
+
+        x, (new_k, new_v) = lax.scan(body, x,
+                                     (params["layers"], cache["k"], cache["v"]))
+        x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
+                  cfg.norm, cfg.norm_eps)
+        logits = self._unembed(params, x)
+        return logits, {"k": new_k, "v": new_v}
+
+    def decode_step(self, params, cache, tokens, pos):
+        """One decode step: tokens [B] at position ``pos`` (scalar int32).
+        Returns (logits [B, V], cache)."""
+        cfg = self.cfg
+        B = tokens.shape[0]
+        S = cache["k"].shape[2]
+        x = params["embed"]["wte"][tokens][:, None, :].astype(cfg.dtype)  # [B,1,H]
+        cos, sin = self._pos_tables(1, jnp.asarray(pos)[None])
+        if cfg.position == "learned":
+            x = x + params["embed"]["wpe"][jnp.asarray(pos)[None]].astype(cfg.dtype)
+
+        def body(carry, xs):
+            x = carry
+            lp, kc, vc = xs
+            x, kc, vc = self._block_decode(x, lp, kc, vc, cos, sin, pos, S)
+            return x, (kc, vc)
+
+        x, (new_k, new_v) = lax.scan(body, x,
+                                     (params["layers"], cache["k"], cache["v"]))
+        x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
+                  cfg.norm, cfg.norm_eps)
+        logits = self._unembed(params, x)[:, 0]
+        return logits, {"k": new_k, "v": new_v}
+
+    def _pos_tables(self, T, positions):
+        cfg = self.cfg
+        if cfg.position != "rope":
+            return jnp.zeros((T, 1), jnp.float32), jnp.zeros((T, 1), jnp.float32)
+        cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        if positions is not None:
+            return cos_full[positions], sin_full[positions]
+        return cos_full[:T], sin_full[:T]
+
+    def _unembed(self, params, x):
+        cfg = self.cfg
+        if cfg.tie_embeddings:
+            return x @ params["embed"]["wte"].T.astype(cfg.dtype)
+        return x @ params["lm_head"]["w"].astype(cfg.dtype)
+
+    def _qkv(self, h1, lp, cos, sin, B, T):
+        cfg = self.cfg
+        nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dt = cfg.dtype
+        q = (h1 @ lp["wq"].astype(dt)).reshape(B, T, nh, hd)
+        k = (h1 @ lp["wk"].astype(dt)).reshape(B, T, kvh, hd)
+        v = (h1 @ lp["wv"].astype(dt)).reshape(B, T, kvh, hd)
+        if cfg.position == "rope":
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        return q, k, v
+
+    def _mlp(self, x, lp):
+        """Inference-path residual MLP (no dropout, aux discarded)."""
+        cfg = self.cfg
+        h2 = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm, cfg.norm_eps)
+        y, _ = self._mlp_body(h2, lp, None, True)
+        return x + y
+
+    def _block_kv(self, x, lp, cos, sin):
+        """Forward block that also returns this layer's K/V (for prefill)."""
+        cfg = self.cfg
+        B, T, _ = x.shape
+        h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
+        q, k, v = self._qkv(h1, lp, cos, sin, B, T)
+        attn = _attention(q, k, v, cfg, causal=True)
+        x = x + attn.reshape(B, T, -1) @ lp["wo"].astype(cfg.dtype)
+        return self._mlp(x, lp), k, v
+
+    def _block_decode(self, x, lp, kc, vc, cos, sin, pos, S):
+        """Decode block: single token attends over the cache."""
+        cfg = self.cfg
+        B = x.shape[0]
+        h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
+        q, k, v = self._qkv(h1, lp, cos, sin, B, 1)
+        kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
+        mask = (jnp.arange(S) <= pos)[None, None, None, :]   # [1,1,1,S]
+        attn = attention_reference(q, kc, vc, causal=False, mask=mask)
+        x = x + attn.reshape(B, 1, -1) @ lp["wo"].astype(cfg.dtype)
+        return self._mlp(x, lp), kc, vc
 
     # -- loss ---------------------------------------------------------------
     def loss(self, params, batch, rng=None):
