@@ -1,0 +1,127 @@
+"""DeepSpeedCPUAdam — host-memory optimizer for ZeRO-Offload.
+
+Counterpart of reference ``ops/adam/cpu_adam.py:13`` (``DeepSpeedCPUAdam``
+driving csrc/adam/cpu_adam_impl.cpp). Operates on flat fp32 numpy arrays
+living in host DRAM (the offloaded partition); the update runs in the C++
+module (ops/op_builder.py CPUAdamBuilder) with a numpy fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .op_builder import CPUAdamBuilder
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, bias_correction=True,
+                 fp32_optimizer_states=True, **_):
+        self.lr = lr
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self._lib = CPUAdamBuilder().load()
+
+    @property
+    def has_native(self) -> bool:
+        return self._lib is not None
+
+    def init_state(self, flat_params: np.ndarray):
+        # per-leaf step counter: bias correction must not advance once per
+        # leaf when one optimizer instance serves many leaves
+        return {"m": np.zeros_like(flat_params), "v": np.zeros_like(flat_params),
+                "step": np.zeros((1,), np.float32)}
+
+    def step(self, params: np.ndarray, grads: np.ndarray, state: dict,
+             lr: float = None) -> None:
+        """In-place update of ``params`` and ``state`` (host arrays)."""
+        lr = self.lr if lr is None else float(lr)
+        state["step"][0] += 1
+        step_count = int(state["step"][0])
+        b1, b2 = self.betas
+        if self._lib is not None:
+            import ctypes
+
+            fp = ctypes.POINTER(ctypes.c_float)
+            self._lib.ds_adam_step(
+                params.ctypes.data_as(fp), grads.ctypes.data_as(fp),
+                state["m"].ctypes.data_as(fp), state["v"].ctypes.data_as(fp),
+                params.size, lr, b1, b2, self.eps, self.weight_decay,
+                int(self.adamw_mode), int(self.bias_correction),
+                step_count)
+            return
+        # numpy fallback (same math)
+        g = grads
+        if self.weight_decay and not self.adamw_mode:
+            g = g + self.weight_decay * params
+        state["m"] *= b1
+        state["m"] += (1 - b1) * g
+        state["v"] *= b2
+        state["v"] += (1 - b2) * np.square(g)
+        if self.bias_correction:
+            c1 = 1 - b1 ** step_count
+            c2 = 1 - b2 ** step_count
+        else:
+            c1 = c2 = 1.0
+        update = (state["m"] / c1) / (np.sqrt(state["v"] / c2) + self.eps)
+        if self.weight_decay and self.adamw_mode:
+            update = update + self.weight_decay * params
+        params -= lr * update
+
+
+class DeepSpeedCPUAdagrad:
+    """reference ops/adagrad/cpu_adagrad.py."""
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0, **_):
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self._lib = CPUAdamBuilder().load()
+
+    def init_state(self, flat_params):
+        return {"v": np.zeros_like(flat_params)}
+
+    def step(self, params, grads, state, lr=None):
+        lr = self.lr if lr is None else float(lr)
+        if self._lib is not None:
+            import ctypes
+
+            fp = ctypes.POINTER(ctypes.c_float)
+            self._lib.ds_adagrad_step(
+                params.ctypes.data_as(fp), grads.ctypes.data_as(fp),
+                state["v"].ctypes.data_as(fp), params.size, lr, self.eps,
+                self.weight_decay)
+            return
+        g = grads + self.weight_decay * params
+        state["v"] += np.square(g)
+        params -= lr * g / (np.sqrt(state["v"]) + self.eps)
+
+
+class DeepSpeedCPULion:
+    """reference ops/lion/cpu_lion.py."""
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0, **_):
+        self.lr, self.betas, self.weight_decay = lr, tuple(betas), weight_decay
+        self._lib = CPUAdamBuilder().load()
+
+    def init_state(self, flat_params):
+        return {"m": np.zeros_like(flat_params)}
+
+    def step(self, params, grads, state, lr=None):
+        lr = self.lr if lr is None else float(lr)
+        b1, b2 = self.betas
+        if self._lib is not None:
+            import ctypes
+
+            fp = ctypes.POINTER(ctypes.c_float)
+            self._lib.ds_lion_step(
+                params.ctypes.data_as(fp), grads.ctypes.data_as(fp),
+                state["m"].ctypes.data_as(fp), params.size, lr, b1, b2,
+                self.weight_decay)
+            return
+        update = np.sign(b1 * state["m"] + (1 - b1) * grads) \
+            + self.weight_decay * params
+        params -= lr * update
+        state["m"] *= b2
+        state["m"] += (1 - b2) * grads

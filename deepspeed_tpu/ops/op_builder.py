@@ -1,0 +1,142 @@
+"""Native op builder: JIT-compiles the C++ runtime modules and loads them
+via ctypes.
+
+Counterpart of reference ``op_builder/builder.py`` (``OpBuilder.load`` :98 /
+``jit_load`` :450 over torch cpp_extension + ninja): here the toolchain is
+plain g++ → shared object, cached by source hash under
+``~/.cache/deepspeed_tpu``, bound through ctypes (pybind11 is not in this
+image). Every builder degrades gracefully: ``available()`` is False when
+the compiler or sources are missing and callers fall back to numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+from ..utils.logging import logger
+
+CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "csrc")
+CACHE_DIR = os.environ.get(
+    "DSTPU_OPS_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu"))
+
+
+class OpBuilder:
+    name = "base"
+    sources: list = []
+    extra_flags: list = []
+
+    _lib_cache: dict = {}
+
+    def compiler(self) -> Optional[str]:
+        return shutil.which("g++")
+
+    def source_paths(self):
+        return [os.path.join(CSRC, s) for s in self.sources]
+
+    def available(self) -> bool:
+        return self.compiler() is not None and all(
+            os.path.exists(p) for p in self.source_paths())
+
+    def _hash(self) -> str:
+        h = hashlib.sha256()
+        for p in self.source_paths():
+            with open(p, "rb") as fh:
+                h.update(fh.read())
+        h.update(" ".join(self.extra_flags).encode())
+        return h.hexdigest()[:16]
+
+    def so_path(self) -> str:
+        return os.path.join(CACHE_DIR, f"{self.name}-{self._hash()}.so")
+
+    def build(self) -> str:
+        so = self.so_path()
+        if os.path.exists(so):
+            return so
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        cmd = [self.compiler(), "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-march=native", "-fopenmp"] + self.extra_flags \
+            + self.source_paths() + ["-o", so + ".tmp", "-lpthread"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            # retry without -march=native / openmp (portability)
+            cmd2 = [c for c in cmd if c not in ("-march=native", "-fopenmp")]
+            try:
+                subprocess.run(cmd2, check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError as e2:
+                raise RuntimeError(
+                    f"build of {self.name} failed:\n{e.stderr}\n{e2.stderr}")
+        os.replace(so + ".tmp", so)
+        logger.info(f"built native op {self.name} → {so}")
+        return so
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        """Compile (cached) and dlopen; None when unavailable."""
+        if self.name in OpBuilder._lib_cache:
+            return OpBuilder._lib_cache[self.name]
+        if not self.available():
+            OpBuilder._lib_cache[self.name] = None
+            return None
+        try:
+            lib = ctypes.CDLL(self.build())
+        except Exception as e:  # toolchain breakage → numpy fallback
+            logger.warning(f"native op {self.name} unavailable: {e}")
+            lib = None
+        OpBuilder._lib_cache[self.name] = lib
+        return lib
+
+
+class CPUAdamBuilder(OpBuilder):
+    """reference op_builder/cpu_adam.py (CPUAdamBuilder)."""
+    name = "cpu_adam"
+    sources = ["cpu_adam.cpp"]
+
+    def load(self):
+        lib = super().load()
+        if lib is not None and not hasattr(lib, "_sigs_set"):
+            i64, f32 = ctypes.c_int64, ctypes.c_float
+            fp = ctypes.POINTER(ctypes.c_float)
+            u16p = ctypes.POINTER(ctypes.c_uint16)
+            lib.ds_adam_step.argtypes = [fp, fp, fp, fp, i64, f32, f32, f32,
+                                         f32, f32, ctypes.c_int, ctypes.c_int, i64]
+            lib.ds_adagrad_step.argtypes = [fp, fp, fp, i64, f32, f32, f32]
+            lib.ds_lion_step.argtypes = [fp, fp, fp, i64, f32, f32, f32, f32]
+            lib.ds_fp32_to_bf16.argtypes = [fp, u16p, i64]
+            lib.ds_bf16_to_fp32.argtypes = [u16p, fp, i64]
+            lib._sigs_set = True
+        return lib
+
+
+class AsyncIOBuilder(OpBuilder):
+    """reference op_builder/async_io.py (AsyncIOBuilder over libaio)."""
+    name = "aio"
+    sources = ["aio.cpp"]
+
+    def load(self):
+        lib = super().load()
+        if lib is not None and not hasattr(lib, "_sigs_set"):
+            i64 = ctypes.c_int64
+            cp = ctypes.c_char_p
+            vp = ctypes.c_void_p
+            charp = ctypes.POINTER(ctypes.c_char)
+            lib.ds_aio_new.restype = vp
+            lib.ds_aio_new.argtypes = [i64, ctypes.c_int]
+            lib.ds_aio_free.argtypes = [vp]
+            lib.ds_aio_pread.argtypes = [vp, cp, charp, i64, i64]
+            lib.ds_aio_pwrite.argtypes = [vp, cp, charp, i64, i64]
+            lib.ds_aio_wait.restype = i64
+            lib.ds_aio_wait.argtypes = [vp]
+            lib.ds_aio_inflight.restype = i64
+            lib.ds_aio_inflight.argtypes = [vp]
+            lib._sigs_set = True
+        return lib
+
+
+ALL_OPS = {b.name: b for b in (CPUAdamBuilder(), AsyncIOBuilder())}

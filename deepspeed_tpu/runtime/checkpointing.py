@@ -89,6 +89,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if jax.process_index() == 0:
         p_index = _save_tree(state.params, os.path.join(ckpt_dir, "params"))
         o_index = _save_tree(state.opt_state.moments, os.path.join(ckpt_dir, "opt"))
+        plan = getattr(engine, "_offload_plan", None)
+        if plan is not None:
+            # host-side optimizer state (ZeRO-Offload masters + moments)
+            off_dir = os.path.join(ckpt_dir, "offload")
+            os.makedirs(off_dir, exist_ok=True)
+            for i in plan.offloaded:
+                np.save(os.path.join(off_dir, f"master_{i}.npy"), plan.masters[i])
+                if plan.swapper is None:
+                    for mk, arr in plan.states[i].items():
+                        np.save(os.path.join(off_dir, f"state_{i}_{mk}.npy"), arr)
         manifest = {
             "tag": str(tag),
             "global_step": int(state.global_step),
@@ -150,6 +160,19 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.global_steps = manifest["global_step"]
         engine.micro_steps = manifest.get("micro_steps", 0)
         engine.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
+
+    plan = getattr(engine, "_offload_plan", None)
+    off_dir = os.path.join(ckpt_dir, "offload")
+    if plan is not None and os.path.isdir(off_dir) and not load_module_only:
+        for i in plan.offloaded:
+            mpath = os.path.join(off_dir, f"master_{i}.npy")
+            if os.path.exists(mpath):
+                plan.masters[i][...] = np.load(mpath)
+            if plan.swapper is None:
+                for mk in plan.states[i]:
+                    spath = os.path.join(off_dir, f"state_{i}_{mk}.npy")
+                    if os.path.exists(spath):
+                        plan.states[i][mk][...] = np.load(spath)
 
     engine.state = new_state
     logger.info(f"Loaded checkpoint {ckpt_dir} (step {manifest['global_step']})")

@@ -184,6 +184,12 @@ class DeepSpeedTpuEngine:
         if over:
             self.module = CausalLM(dataclasses.replace(self.module.cfg, **over))
 
+    def _offload_config(self):
+        oc = self.config.zero_optimization.offload_optimizer
+        if oc is None or str(oc.device.value) == "none":
+            return None
+        return oc
+
     def _init_state(self) -> TrainState:
         self._model_dtype_override()
         init_rng, self._rng = jax.random.split(self._rng)
@@ -194,11 +200,37 @@ class DeepSpeedTpuEngine:
         p_shard = self.plan.params(shapes)
         params = jax.jit(self.module.init, out_shardings=p_shard)(init_rng)
 
-        opt_shapes = jax.eval_shape(self.opt.init, params)
-        o_shard = OptimizerState(
-            step=self.plan.replicated(),
-            moments=self.plan.opt_state(opt_shapes.moments))
-        opt_state = jax.jit(self.opt.init, out_shardings=o_shard)(params)
+        # ZeRO-Offload: split leaves between host optimizer and device
+        oc = self._offload_config()
+        self._offload_plan = None
+        if oc is not None:
+            from .zero_offload import OffloadOptimizerPlan
+
+            opt_cfg = self.config.optimizer
+            self._offload_plan = OffloadOptimizerPlan(
+                params, opt_cfg.type if opt_cfg else "Adam",
+                opt_cfg.params if opt_cfg else {},
+                device=str(oc.device.value), ratio=oc.ratio,
+                nvme_path=oc.nvme_path,
+                aio_threads=self.config.aio.thread_count)
+
+        if self._offload_plan is not None:
+            # device optimizer covers only the non-offloaded subtree
+            p_leaves = jax.tree_util.tree_flatten(params)[0]
+            s_leaves = jax.tree_util.tree_flatten(p_shard)[0]
+            kept = {str(i): p_leaves[i] for i in self._offload_plan.kept}
+            kept_shard = {str(i): s_leaves[i] for i in self._offload_plan.kept}
+            opt_shapes = jax.eval_shape(self.opt.init, kept)
+            o_shard = OptimizerState(
+                step=self.plan.replicated(),
+                moments={mk: kept_shard for mk in opt_shapes.moments})
+            opt_state = jax.jit(self.opt.init, out_shardings=o_shard)(kept)
+        else:
+            opt_shapes = jax.eval_shape(self.opt.init, params)
+            o_shard = OptimizerState(
+                step=self.plan.replicated(),
+                moments=self.plan.opt_state(opt_shapes.moments))
+            opt_state = jax.jit(self.opt.init, out_shardings=o_shard)(params)
 
         g_shard = self.plan.grads(shapes)
         grad_acc = jax.jit(lambda: jax.tree.map(jnp.zeros_like, shapes),
@@ -254,80 +286,119 @@ class DeepSpeedTpuEngine:
             grad_acc = jax.tree.map(jnp.add, state.grad_acc, grads)
             return state._replace(grad_acc=grad_acc), loss
 
-        def update(state: TrainState):
-            """unscale → clip → (overflow-gated) optimizer step → new scale."""
+        def unscale_and_clip(state: TrainState):
             scale = state.scale_state.scale
             denom = scale * gas / (dp_size if predivide else 1.0)
             grads = jax.tree.map(lambda g: g / denom, state.grad_acc)
-
             flat = jax.tree.leaves(grads)
             sumsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in flat)
             gnorm = jnp.sqrt(sumsq)
             overflow = ~jnp.isfinite(gnorm)
-
             if clip > 0:
                 coeff = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coeff, grads)
+            return grads, gnorm, overflow
 
+        def next_scale_state(ss: ScaleState, overflow):
+            """Dynamic loss scale automaton (reference loss_scaler.py:136)."""
+            if not (fp16 and dynamic):
+                return ss
+            window = fpc.loss_scale_window
+            min_scale = fpc.min_loss_scale
+
+            def on_overflow(s):
+                new_h = jnp.maximum(s.hysteresis - 1, 0)
+                shrink = new_h <= 0
+                new_scale = jnp.where(
+                    shrink, jnp.maximum(s.scale / 2.0, min_scale), s.scale)
+                return ScaleState(
+                    scale=new_scale, good_steps=jnp.zeros((), jnp.int32),
+                    hysteresis=jnp.where(
+                        shrink, jnp.asarray(fpc.hysteresis, jnp.int32), new_h))
+
+            def on_good(s):
+                grown = s.good_steps + 1 >= window
+                return ScaleState(
+                    scale=jnp.where(grown, s.scale * 2.0, s.scale),
+                    good_steps=jnp.where(grown, 0, s.good_steps + 1).astype(jnp.int32),
+                    hysteresis=s.hysteresis)
+
+            return lax.cond(overflow, on_overflow, on_good, ss)
+
+        def book_keeping(state, new_params, new_opt, overflow):
+            zero_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
+            return TrainState(
+                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
+                scale_state=next_scale_state(state.scale_state, overflow),
+                global_step=state.global_step + jnp.where(overflow, 0, 1),
+                skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0))
+
+        def update(state: TrainState):
+            """unscale → clip → (overflow-gated) optimizer step → new scale."""
+            grads, gnorm, overflow = unscale_and_clip(state)
             lr = schedule(state.global_step)
 
             def do_step(_):
-                new_p, new_o = opt.step(state.params, grads, state.opt_state, lr)
-                return new_p, new_o
+                return opt.step(state.params, grads, state.opt_state, lr)
 
             def skip(_):
                 return state.params, state.opt_state
 
             new_params, new_opt = lax.cond(overflow, skip, do_step, None)
-
-            # dynamic loss scale automaton (reference loss_scaler.py:136)
-            ss = state.scale_state
-            if fp16 and dynamic:
-                window = fpc.loss_scale_window
-                min_scale = fpc.min_loss_scale
-                hyst = ss.hysteresis
-
-                def on_overflow(s):
-                    new_h = jnp.maximum(s.hysteresis - 1, 0)
-                    shrink = new_h <= 0
-                    new_scale = jnp.where(
-                        shrink, jnp.maximum(s.scale / 2.0, min_scale), s.scale)
-                    return ScaleState(
-                        scale=new_scale, good_steps=jnp.zeros((), jnp.int32),
-                        hysteresis=jnp.where(
-                            shrink, jnp.asarray(fpc.hysteresis, jnp.int32), new_h))
-
-                def on_good(s):
-                    grown = s.good_steps + 1 >= window
-                    return ScaleState(
-                        scale=jnp.where(grown, s.scale * 2.0, s.scale),
-                        good_steps=jnp.where(grown, 0, s.good_steps + 1).astype(jnp.int32),
-                        hysteresis=s.hysteresis)
-
-                new_ss = lax.cond(overflow, on_overflow, on_good, ss)
-            else:
-                new_ss = ss
-
-            zero_acc = jax.tree.map(jnp.zeros_like, state.grad_acc)
-            new_state = TrainState(
-                params=new_params, opt_state=new_opt, grad_acc=zero_acc,
-                scale_state=new_ss,
-                global_step=state.global_step + jnp.where(overflow, 0, 1),
-                skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0))
+            new_state = book_keeping(state, new_params, new_opt, overflow)
             metrics = {"grad_norm": gnorm, "lr": lr, "overflow": overflow,
-                       "loss_scale": scale}
+                       "loss_scale": state.scale_state.scale}
             return new_state, metrics
+
+        offload_plan = getattr(self, "_offload_plan", None)
+
+        def finalize_offload(state: TrainState):
+            """Offload variant: device update for the kept subtree, grads of
+            offloaded leaves returned for the host optimizer."""
+            grads, gnorm, overflow = unscale_and_clip(state)
+            lr = schedule(state.global_step)
+            p_leaves = jax.tree_util.tree_flatten(state.params)[0]
+            g_leaves = jax.tree_util.tree_flatten(grads)[0]
+            kept = {str(i): p_leaves[i] for i in offload_plan.kept}
+            kept_grads = {str(i): g_leaves[i] for i in offload_plan.kept}
+
+            def do_step(_):
+                return opt.step(kept, kept_grads, state.opt_state, lr)
+
+            def skip(_):
+                return kept, state.opt_state
+
+            new_kept, new_opt = lax.cond(overflow, skip, do_step, None)
+            new_leaves = list(p_leaves)
+            for i in offload_plan.kept:
+                new_leaves[i] = new_kept[str(i)]
+            new_params = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(state.params), new_leaves)
+            off_grads = {str(i): g_leaves[i] for i in offload_plan.offloaded}
+            new_state = book_keeping(state, new_params, new_opt, overflow)
+            metrics = {"grad_norm": gnorm, "lr": lr, "overflow": overflow,
+                       "loss_scale": state.scale_state.scale}
+            return new_state, off_grads, metrics
 
         self._micro_fn = jax.jit(
             micro,
             in_shardings=(state_shardings, batch_sharding, None),
             out_shardings=(state_shardings, plan.replicated()),
             donate_argnums=(0,))
-        self._update_fn = jax.jit(
-            update,
-            in_shardings=(state_shardings,),
-            out_shardings=(state_shardings, None),
-            donate_argnums=(0,))
+        if offload_plan is not None:
+            self._update_fn = None
+            self._finalize_fn = jax.jit(
+                finalize_offload,
+                in_shardings=(state_shardings,),
+                out_shardings=(state_shardings, None, None),
+                donate_argnums=(0,))
+        else:
+            self._finalize_fn = None
+            self._update_fn = jax.jit(
+                update,
+                in_shardings=(state_shardings,),
+                out_shardings=(state_shardings, None),
+                donate_argnums=(0,))
 
         def eval_step(state: TrainState, batch, rng):
             return module.loss(state.params, batch, None)
@@ -401,7 +472,10 @@ class DeepSpeedTpuEngine:
         """Reference engine.py:2096: optimizer step at accumulation boundary."""
         if not self.is_gradient_accumulation_boundary():
             return
-        self.state, metrics = self._update_fn(self.state)
+        if self._offload_plan is not None:
+            metrics = self._offload_step()
+        else:
+            self.state, metrics = self._update_fn(self.state)
         self.global_steps += 1
         self.lr_scheduler.step()
         self._last_metrics = metrics
@@ -419,6 +493,23 @@ class DeepSpeedTpuEngine:
                 self.monitor.write_events([
                     ("Train/loss", float(self._pending_loss), self.global_steps),
                     ("Train/lr", m["lr"], self.global_steps)])
+        return metrics
+
+    def _offload_step(self):
+        """Host-side optimizer step for offloaded leaves (ZeRO-Offload):
+        device finalize → grads to host → C++ SIMD update of fp32 masters →
+        masters stream back into the sharded device params."""
+        lr_host = float(self.schedule(self.global_steps))
+        self.state, off_grads, metrics = self._finalize_fn(self.state)
+        if not bool(metrics["overflow"]):
+            plan = self._offload_plan
+            off_np = {int(k): np.asarray(jax.device_get(v))
+                      for k, v in off_grads.items()}
+            masters = plan.host_update(off_np, lr_host)
+            p_leaves = jax.tree_util.tree_flatten(self.state.params)[0]
+            kept = {str(i): p_leaves[i] for i in plan.kept}
+            new_params = plan.merge(kept, masters, self._param_shardings)
+            self.state = self.state._replace(params=new_params)
         return metrics
 
     def train_batch(self, data_iter=None):

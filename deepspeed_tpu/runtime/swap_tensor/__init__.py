@@ -1,0 +1,1 @@
+from .async_swapper import AsyncTensorSwapper, OptimizerStateSwapper  # noqa: F401

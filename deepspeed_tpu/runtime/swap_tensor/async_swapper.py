@@ -1,0 +1,115 @@
+"""Async tensor swapper over the native aio engine.
+
+Counterpart of reference ``runtime/swap_tensor/async_swapper.py``
+(``AsyncTensorSwapper``) + ``partitioned_optimizer_swapper.py`` over
+``csrc/aio``: moves flat numpy arrays between host DRAM and NVMe files with
+overlapped background I/O (swap-out of step N overlaps compute of N+1).
+Falls back to synchronous numpy file I/O when the native module is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...ops.op_builder import AsyncIOBuilder
+from ...utils.logging import logger
+
+
+class AsyncTensorSwapper:
+    def __init__(self, swap_dir: str, block_size: int = 1 << 20,
+                 n_threads: int = 2):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self._lib = AsyncIOBuilder().load()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.ds_aio_new(block_size, n_threads)
+
+    @property
+    def has_native(self) -> bool:
+        return self._handle is not None
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.swap_dir, f"{key}.swp")
+
+    def swap_out(self, key: str, array: np.ndarray) -> None:
+        """Write to NVMe (async when native). ``array`` must stay alive
+        until ``wait`` returns."""
+        if self._handle is not None:
+            buf = array.ctypes.data_as(ctypes.POINTER(ctypes.c_char))
+            self._lib.ds_aio_pwrite(self._handle, self._path(key).encode(),
+                                    buf, array.nbytes, 0)
+        else:
+            array.tofile(self._path(key))
+
+    def swap_in(self, key: str, array: np.ndarray) -> None:
+        """Read from NVMe into ``array`` (async when native)."""
+        if self._handle is not None:
+            buf = array.ctypes.data_as(ctypes.POINTER(ctypes.c_char))
+            self._lib.ds_aio_pread(self._handle, self._path(key).encode(),
+                                   buf, array.nbytes, 0)
+        else:
+            array[...] = np.fromfile(self._path(key),
+                                     dtype=array.dtype).reshape(array.shape)
+
+    def wait(self) -> None:
+        if self._handle is not None:
+            errors = self._lib.ds_aio_wait(self._handle)
+            if errors:
+                raise IOError(f"{errors} async I/O operations failed "
+                              f"in {self.swap_dir}")
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ds_aio_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class OptimizerStateSwapper:
+    """NVMe-resident optimizer moments (ZeRO-Infinity tier, reference
+    partitioned_optimizer_swapper.py): keeps m/v on disk, pages them into
+    reusable host buffers around each optimizer step."""
+
+    def __init__(self, swap_dir: str, n_threads: int = 2):
+        self.swapper = AsyncTensorSwapper(swap_dir, n_threads=n_threads)
+        self._shapes: Dict[str, tuple] = {}
+        self._buffers: Dict[str, np.ndarray] = {}
+
+    def register(self, key: str, shape: tuple, dtype=np.float32) -> None:
+        self._shapes[key] = (tuple(shape), np.dtype(dtype))
+        init = np.zeros(shape, dtype)
+        self.swapper.swap_out(key, init)
+        self.swapper.wait()
+
+    def _buffer(self, key: str) -> np.ndarray:
+        shape, dtype = self._shapes[key]
+        if key not in self._buffers or self._buffers[key].shape != shape:
+            self._buffers[key] = np.empty(shape, dtype)
+        return self._buffers[key]
+
+    def load(self, key: str) -> np.ndarray:
+        buf = self._buffer(key)
+        self.swapper.swap_in(key, buf)
+        self.swapper.wait()
+        return buf
+
+    def store(self, key: str, array: np.ndarray, wait: bool = True) -> None:
+        self.swapper.swap_out(key, array)
+        if wait:
+            self.swapper.wait()
+
+    def close(self):
+        self.swapper.close()
