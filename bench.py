@@ -30,6 +30,9 @@ PEAK_FLOPS = {
 def detect_peak():
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "cpu").lower()
+    # v5e reports as "TPU v5 lite" / "v5litepod"; plain "v5" means v5p.
+    if "lite" in kind:
+        return PEAK_FLOPS["v5e"]
     for key, val in PEAK_FLOPS.items():
         if key in kind:
             return val

@@ -117,7 +117,10 @@ class DeepSpeedTpuEngine:
             self.schedule = build_schedule(sc.type if sc else None,
                                            sc.params if sc else None,
                                            fallback_lr=base_lr)
-        self.lr_scheduler = LRSchedulerShim(self.schedule)
+        self.lr_scheduler = LRSchedulerShim(
+            self.schedule,
+            step_source=lambda: int(self.state.global_step)
+            if getattr(self, "state", None) is not None else 0)
 
         # -- state init (sharded from birth — zero.Init role) --------------
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
@@ -125,6 +128,7 @@ class DeepSpeedTpuEngine:
 
         # -- data ----------------------------------------------------------
         self.training_dataloader = None
+        self._data_iter = None  # persistent train_batch iterator (ADVICE r1)
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
@@ -134,7 +138,9 @@ class DeepSpeedTpuEngine:
         # -- counters / telemetry -----------------------------------------
         self.micro_steps = 0          # micro steps since engine start
         self.global_steps = 0         # host mirror of state.global_step
-        self.skipped_steps = 0
+        # NOTE: skipped_steps is a property over state.skipped_steps — the
+        # device counter is authoritative and reading it lazily avoids a
+        # host-device sync on every optimizer boundary (ADVICE r1 / review r2).
         self._pending_loss = None
         self._last_lr = float(self.schedule(0))
         self.timers = SynchronizedWallClockTimer(sync_fn=self._sync)
@@ -380,31 +386,100 @@ class DeepSpeedTpuEngine:
                        "loss_scale": state.scale_state.scale}
             return new_state, off_grads, metrics
 
+        # NOTE: no in_shardings on any of these jits. The state/batch arrays
+        # are committed with the plan's shardings already (init runs under
+        # out_shardings; batches via device_put), so jit infers identical
+        # input shardings from the arrays — and pinning in_shardings to
+        # default layouts was measured to cost ~3x step time on TPU (it
+        # defeats XLA's input-layout selection, forcing full-state relayouts
+        # per call). The TPU path instead pins *XLA-preferred* layouts, found
+        # by a one-time AUTO-format compile at the first forward
+        # (_autotune_layouts below).
+        self._micro_raw = micro
+        self._update_raw = update
+        self._finalize_raw = finalize_offload if offload_plan is not None else None
+        self._layouts_tuned = False
         self._micro_fn = jax.jit(
             micro,
-            in_shardings=(state_shardings, batch_sharding, None),
             out_shardings=(state_shardings, plan.replicated()),
             donate_argnums=(0,))
         if offload_plan is not None:
             self._update_fn = None
             self._finalize_fn = jax.jit(
                 finalize_offload,
-                in_shardings=(state_shardings,),
                 out_shardings=(state_shardings, None, None),
                 donate_argnums=(0,))
         else:
             self._finalize_fn = None
             self._update_fn = jax.jit(
                 update,
-                in_shardings=(state_shardings,),
                 out_shardings=(state_shardings, None),
                 donate_argnums=(0,))
 
         def eval_step(state: TrainState, batch, rng):
             return module.loss(state.params, batch, None)
 
-        self._eval_fn = jax.jit(
-            eval_step, in_shardings=(state_shardings, batch_sharding, None))
+        self._eval_fn = jax.jit(eval_step)
+
+    def _autotune_layouts(self, batch, rng):
+        """One-time XLA layout autotuning for the hot step (TPU only).
+
+        XLA picks faster-than-default in-memory layouts for the train state
+        when allowed to (measured ~3x step time on a 536M LM on v5e when the
+        state is pinned to default layouts). Compile the micro program once
+        with AUTO input/output formats, read back the layouts XLA chose, move
+        the live state into them, and rebuild the step jits pinned to those
+        concrete formats so state cycles micro→update→micro with zero
+        relayouts. Counterpart of the reference's kernel/layout autotuning
+        role (it has no direct equivalent — CUDA torch controls layouts
+        explicitly)."""
+        self._layouts_tuned = True
+        try:
+            from jax.experimental.layout import Format, Layout
+        except Exception:
+            return
+        if jax.devices()[0].platform != "tpu":
+            return
+        try:
+            ss = self._state_shardings
+            is_shard = lambda x: isinstance(x, jax.sharding.Sharding)
+            auto_state = jax.tree.map(lambda s: Format(Layout.AUTO, s), ss,
+                                      is_leaf=is_shard)
+            rep = self.plan.replicated()
+            micro_auto = jax.jit(
+                self._micro_raw,
+                in_shardings=(auto_state, None, None),
+                out_shardings=(auto_state, rep),
+                donate_argnums=(0,))
+            # AUTO layouts require abstract (ShapeDtypeStruct) args to lower.
+            avals = jax.eval_shape(lambda s, b, r: (s, b, r),
+                                   self.state, batch, rng)
+            compiled = micro_auto.lower(*avals).compile()
+            out_state_fmt = compiled.output_formats[0]
+            # Move the live state into the preferred layouts (one-time cost)
+            # and pin every step program to them.
+            self.state = jax.device_put(self.state, out_state_fmt)
+            self._micro_fn = jax.jit(
+                self._micro_raw,
+                in_shardings=(out_state_fmt, None, None),
+                out_shardings=(out_state_fmt, rep),
+                donate_argnums=(0,))
+            if self._finalize_raw is not None:
+                self._finalize_fn = jax.jit(
+                    self._finalize_raw,
+                    in_shardings=(out_state_fmt,),
+                    out_shardings=(out_state_fmt, None, None),
+                    donate_argnums=(0,))
+            else:
+                self._update_fn = jax.jit(
+                    self._update_raw,
+                    in_shardings=(out_state_fmt,),
+                    out_shardings=(out_state_fmt, None),
+                    donate_argnums=(0,))
+            log_dist("layout autotune: state pinned to XLA-preferred formats",
+                     ranks=[0])
+        except Exception as exc:  # pragma: no cover - depends on backend
+            logger.warning(f"layout autotune skipped: {exc}")
 
     # ------------------------------------------------------------- data plumbing
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None,
@@ -450,6 +525,8 @@ class DeepSpeedTpuEngine:
         self.tput_timer.start()
         batch = self._device_batch(batch) if not self._is_device_batch(batch) else batch
         step_rng = jax.random.fold_in(self._rng, self.micro_steps)
+        if not self._layouts_tuned:
+            self._autotune_layouts(batch, step_rng)
         self.state, loss = self._micro_fn(self.state, batch, step_rng)
         self._pending_loss = loss
         return loss
@@ -483,8 +560,6 @@ class DeepSpeedTpuEngine:
             self.global_steps % self.config.steps_per_print == 0))
         if self.global_steps % self.config.steps_per_print == 0:
             m = {k: float(v) for k, v in metrics.items()}
-            if m.get("overflow"):
-                self.skipped_steps += 1
             log_dist(
                 f"step={self.global_steps} loss={float(self._pending_loss):.4f} "
                 f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
@@ -499,7 +574,12 @@ class DeepSpeedTpuEngine:
         """Host-side optimizer step for offloaded leaves (ZeRO-Offload):
         device finalize → grads to host → C++ SIMD update of fp32 masters →
         masters stream back into the sharded device params."""
-        lr_host = float(self.schedule(self.global_steps))
+        # Drive the host LR from the authoritative device counter: the jitted
+        # path uses state.global_step, which does NOT advance on fp16-overflow
+        # skipped steps, while self.global_steps advances on every boundary.
+        # Using the host mirror would permanently desync offloaded-leaf LR
+        # from device-resident leaves after any overflow (ADVICE r1).
+        lr_host = float(self.schedule(int(self.state.global_step)))
         self.state, off_grads, metrics = self._finalize_fn(self.state)
         if not bool(metrics["overflow"]):
             plan = self._offload_plan
@@ -514,8 +594,23 @@ class DeepSpeedTpuEngine:
 
     def train_batch(self, data_iter=None):
         """Full effective batch: GAS micro steps + update (pipeline-engine
-        parity, reference pipe/engine.py:312)."""
-        it = data_iter if data_iter is not None else iter(self.training_dataloader)
+        parity, reference pipe/engine.py:312).
+
+        The no-arg form keeps ONE persistent iterator across calls (reference
+        PipelineEngine keeps self.data_iterator, pipe/engine.py:114) so that
+        successive train_batch() calls walk the dataset instead of restarting
+        it; the loader repeats across epochs via RepeatingLoader.
+        """
+        if data_iter is not None:
+            it = data_iter
+        else:
+            if self._data_iter is None:
+                from .dataloader import RepeatingLoader
+                loader = self.training_dataloader
+                if not isinstance(loader, RepeatingLoader):
+                    loader = RepeatingLoader(loader)
+                self._data_iter = iter(loader)
+            it = self._data_iter
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(it)
@@ -543,7 +638,9 @@ class DeepSpeedTpuEngine:
         return self.opt
 
     def get_lr(self):
-        return [float(self.schedule(self.global_steps))]
+        # state.global_step is authoritative (does not count overflow-skipped
+        # steps); the host mirror would report a drifted LR after overflows.
+        return [float(self.schedule(int(self.state.global_step)))]
 
     def get_global_grad_norm(self) -> Optional[float]:
         m = getattr(self, "_last_metrics", None)
@@ -552,6 +649,12 @@ class DeepSpeedTpuEngine:
     @property
     def loss_scale(self) -> float:
         return float(self.state.scale_state.scale)
+
+    @property
+    def skipped_steps(self) -> int:
+        """Overflow-skipped steps; reads the authoritative device counter
+        lazily (no per-step host sync)."""
+        return int(self.state.skipped_steps)
 
     def zero_optimization(self) -> bool:
         return self.zero_stage > 0

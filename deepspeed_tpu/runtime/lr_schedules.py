@@ -47,7 +47,10 @@ def warmup_decay_lr(total_num_steps, warmup_min_lr=0.0, warmup_max_lr=0.001,
         s = jnp.asarray(step, jnp.float32)
         decay = jnp.clip((total_num_steps - s) / max(1.0, total_num_steps - warmup_num_steps),
                          0.0, 1.0)
-        return jnp.where(s < warmup_num_steps, base(step), warmup_max_lr * decay)
+        # Decay to warmup_min_lr, not zero (reference WarmupDecayLR,
+        # lr_schedules.py:684: min_lr + (max_lr - min_lr) * gamma).
+        decayed = warmup_min_lr + (warmup_max_lr - warmup_min_lr) * decay
+        return jnp.where(s < warmup_num_steps, base(step), decayed)
 
     return sched
 
@@ -138,23 +141,37 @@ def build_schedule(type_name: Optional[str], params: Optional[dict] = None,
 
 class LRSchedulerShim:
     """Host-side wrapper giving the reference's scheduler API
-    (``get_lr``/``get_last_lr``/``step``/``state_dict``) over a pure schedule."""
+    (``get_lr``/``get_last_lr``/``step``/``state_dict``) over a pure schedule.
 
-    def __init__(self, schedule: Schedule, start_step: int = 0):
+    When given a ``step_source`` callable (the engine wires
+    ``lambda: int(state.global_step)``), the authoritative step count comes
+    from the device train state — which does NOT advance on overflow-skipped
+    steps — so ``get_lr``/``state_dict`` can never drift from the LR the
+    jitted update actually applied. The host ``last_step`` mirror is kept
+    only as a fallback for standalone use."""
+
+    def __init__(self, schedule: Schedule, start_step: int = 0,
+                 step_source=None):
         self.schedule = schedule
         self.last_step = start_step
+        self.step_source = step_source
+
+    def _current_step(self) -> int:
+        if self.step_source is not None:
+            return int(self.step_source())
+        return self.last_step
 
     def step(self, increment: int = 1):
         self.last_step += increment
 
     def get_lr(self):
-        return [float(self.schedule(self.last_step))]
+        return [float(self.schedule(self._current_step()))]
 
     def get_last_lr(self):
         return self.get_lr()
 
     def state_dict(self):
-        return {"last_step": self.last_step}
+        return {"last_step": self._current_step()}
 
     def load_state_dict(self, sd):
         self.last_step = sd["last_step"]

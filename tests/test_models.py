@@ -66,7 +66,10 @@ def test_gqa_matches_mha_when_repeated():
     out_gqa = attention_reference(q, k, v)
     out_mha = attention_reference(q, jnp.repeat(k, H // KH, axis=2),
                                   jnp.repeat(v, H // KH, axis=2))
-    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), rtol=1e-6)
+    # grouped-einsum GQA reduces in a different order than repeated-KV MHA;
+    # only float-associativity noise is allowed
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               rtol=1e-4, atol=1e-6)
 
 
 def test_flash_attention_matches_reference():
