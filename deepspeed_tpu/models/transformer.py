@@ -53,6 +53,7 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
+    use_bias: bool = False               # linear biases (GPT-2/OPT style)
     dropout: float = 0.0
     dtype: Any = jnp.float32             # compute dtype (params kept fp32)
     remat: bool = False                  # activation checkpointing per layer
@@ -119,6 +120,12 @@ TINY_TEST = TransformerConfig(vocab_size=256, hidden_size=64,
 
 
 # ------------------------------------------------------------------ primitives
+
+def _linear(x, w, b, dt):
+    """x @ w (+ b) in compute dtype; b may be None (bias-free families)."""
+    y = x @ w.astype(dt)
+    return y if b is None else y + b.astype(dt)
+
 
 def _norm(x, w, b, kind: str, eps: float):
     dt = x.dtype
@@ -305,6 +312,15 @@ class CausalLM:
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = jnp.zeros((L, h), jnp.float32)
             layers["mlp_norm_b"] = jnp.zeros((L, h), jnp.float32)
+        if cfg.use_bias:
+            layers["wq_b"] = jnp.zeros((L, nh * hd), jnp.float32)
+            layers["wk_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
+            layers["wv_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
+            layers["wo_b"] = jnp.zeros((L, h), jnp.float32)
+            layers["w_in_b"] = jnp.zeros((L, m), jnp.float32)
+            layers["w_out_b"] = jnp.zeros((L, h), jnp.float32)
+            if cfg.activation == "silu" and E == 0:
+                layers["w_gate_b"] = jnp.zeros((L, m), jnp.float32)
 
         params = {
             "embed": {"wte": normal(keys[7], (v, h))},
@@ -346,6 +362,15 @@ class CausalLM:
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = spec("layers", "embed")
             layers["mlp_norm_b"] = spec("layers", "embed")
+        if cfg.use_bias:
+            layers["wq_b"] = spec("layers", "heads")
+            layers["wk_b"] = spec("layers", "kv_heads")
+            layers["wv_b"] = spec("layers", "kv_heads")
+            layers["wo_b"] = spec("layers", "embed")
+            layers["w_in_b"] = spec("layers", "mlp")
+            layers["w_out_b"] = spec("layers", "embed")
+            if cfg.activation == "silu" and cfg.moe_num_experts == 0:
+                layers["w_gate_b"] = spec("layers", "mlp")
         specs = {
             "embed": {"wte": spec("vocab", "embed")},
             "layers": layers,
@@ -572,9 +597,9 @@ class CausalLM:
         cfg = self.cfg
         nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
         dt = cfg.dtype
-        q = (h1 @ lp["wq"].astype(dt)).reshape(B, T, nh, hd)
-        k = (h1 @ lp["wk"].astype(dt)).reshape(B, T, kvh, hd)
-        v = (h1 @ lp["wv"].astype(dt)).reshape(B, T, kvh, hd)
+        q = _linear(h1, lp["wq"], lp.get("wq_b"), dt).reshape(B, T, nh, hd)
+        k = _linear(h1, lp["wk"], lp.get("wk_b"), dt).reshape(B, T, kvh, hd)
+        v = _linear(h1, lp["wv"], lp.get("wv_b"), dt).reshape(B, T, kvh, hd)
         if cfg.position == "rope":
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
