@@ -21,8 +21,8 @@ reference hand-codes:
 Tensor parallelism: model code annotates each param with *logical* axis
 names (``('embed','mlp')``…); a rules table maps logical names to mesh axes
 (Megatron-style column/row sharding = mapping ``mlp``/``heads`` to the
-``tensor`` axis). ZeRO-3 then shards the largest *remaining* dim over
-``fsdp``.
+``tensor`` axis). ZeRO-3 then shards one *remaining* dim over ``fsdp`` —
+preferring the ``embed`` dim (see ``_FSDP_PREFERRED``), else the largest.
 """
 
 from __future__ import annotations
@@ -67,13 +67,35 @@ def logical_to_mesh_axes(logical: Sequence[Optional[str]],
     return [rules.get(name) if name is not None else None for name in logical]
 
 
+# Logical dims preferred for the fsdp shard, in order. Sharding every param's
+# ``embed`` dim (rather than its largest dim) keeps all grad/param shardings
+# mutually consistent with the batch-sharded backward: e.g. putting fsdp on the
+# embedding table's *vocab* dim makes the SPMD partitioner reshard the
+# [batch, seq, vocab] logits cotangent from batch-sharded to vocab-sharded,
+# which XLA can only do by full rematerialization (a per-step collective tax
+# observed in the tp×fsdp×dp dryrun). MaxText's logical rules make the same
+# choice (embed→fsdp, vocab→tensor).
+_FSDP_PREFERRED = ("embed",)
+
+
 def _assign_fsdp(mesh_axes: list, shape: Tuple[int, ...], mesh: Mesh,
+                 logical: Optional[Sequence[Optional[str]]] = None,
                  fsdp_axis: str = topo.FSDP_AXIS) -> list:
-    """Shard the largest not-yet-sharded dim over the fsdp axis (must divide)."""
+    """Shard one not-yet-sharded dim over the fsdp axis (must divide).
+
+    Preference: a dim with a logical name in ``_FSDP_PREFERRED`` (see above),
+    else the largest eligible dim (memory balance).
+    """
     fsdp = mesh.shape.get(fsdp_axis, 1)
     if fsdp <= 1:
         return mesh_axes
-    # candidate dims: unsharded, divisible by fsdp size; pick the largest
+    logical = logical or [None] * len(shape)
+    for name in _FSDP_PREFERRED:
+        for i, (ax, dim, lname) in enumerate(zip(mesh_axes, shape, logical)):
+            if ax is None and lname == name and dim % fsdp == 0:
+                mesh_axes[i] = fsdp_axis
+                return mesh_axes
+    # fallback: unsharded, divisible by fsdp size; pick the largest
     best, best_size = None, 0
     for i, (ax, dim) in enumerate(zip(mesh_axes, shape)):
         if ax is None and dim % fsdp == 0 and dim > best_size:
@@ -104,7 +126,7 @@ def shard_spec_for(shape: Tuple[int, ...],
             if n <= 1 or shape[i] % n != 0:
                 mesh_axes[i] = None
     if zero_stage >= 3 or force_fsdp:
-        mesh_axes = _assign_fsdp(mesh_axes, shape, mesh)
+        mesh_axes = _assign_fsdp(mesh_axes, shape, mesh, logical)
     return PartitionSpec(*mesh_axes)
 
 
