@@ -49,6 +49,15 @@ class InferenceEngine:
             from ..models import build_model
 
             model = build_model(model)
+        if model is None:
+            # model inferred from an HF checkpoint directory's config.json
+            from ..models import convert
+
+            ckpt = self.config.checkpoint
+            if not (ckpt and convert.is_hf_checkpoint(ckpt)):
+                raise ValueError("init_inference needs a model or an HF "
+                                 "checkpoint dir in config.checkpoint")
+            model = convert.model_from_checkpoint(ckpt)
         self.module = model
 
         # topology: tp_size maps onto the tensor mesh axis
@@ -73,6 +82,25 @@ class InferenceEngine:
         # zero_stage=0: params replicated except TP-sharded dims
         self.plan = ZeroShardingPlan(self.topology, 0, spec_tree)
 
+        ckpt = self.config.checkpoint
+        if params is None and ckpt is not None:
+            from ..models import convert
+
+            if convert.is_hf_checkpoint(ckpt):
+                # TP-sharded load straight from HF files: each device's
+                # shard is read from disk via the leaf plans (reference
+                # module_inject/load_checkpoint.py role). Params stored at
+                # the serving dtype (fp32 would double weight HBM).
+                _, params = convert.load_hf_checkpoint(
+                    ckpt, model=self.module, sharding_plan=self.plan,
+                    param_dtype=dtype)
+            else:
+                # native universal-layout checkpoint
+                from ..runtime.checkpointing import _load_tree
+
+                shapes = jax.eval_shape(self.module.init, jax.random.PRNGKey(0))
+                shardings = self.plan.params(shapes)
+                params = _load_tree(shapes, shardings, ckpt)
         if params is None:
             shapes = jax.eval_shape(self.module.init, jax.random.PRNGKey(0))
             shardings = self.plan.params(shapes)

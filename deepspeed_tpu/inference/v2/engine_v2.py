@@ -37,9 +37,19 @@ class RaggedInferenceEngineConfig:
 
 
 class InferenceEngineV2:
-    def __init__(self, model: CausalLM, params=None,
-                 config: Optional[RaggedInferenceEngineConfig] = None):
+    def __init__(self, model: Optional[CausalLM] = None, params=None,
+                 config: Optional[RaggedInferenceEngineConfig] = None,
+                 checkpoint_path: Optional[str] = None):
         self.config = config or RaggedInferenceEngineConfig()
+        if params is None and checkpoint_path is not None:
+            # pretrained weights (reference engine_v2 builds its model from a
+            # checkpoint via the layer-container DSL; here: models/convert.py)
+            from ...models import convert
+
+            model, params = convert.load_hf_checkpoint(checkpoint_path,
+                                                       model=model)
+        if model is None:
+            raise ValueError("InferenceEngineV2 needs a model or checkpoint_path")
         self.model = model
         if params is None:
             params = model.init(jax.random.PRNGKey(0))

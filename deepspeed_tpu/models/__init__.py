@@ -17,6 +17,13 @@ from .transformer import (  # noqa: F401
     TINY_TEST,
 )
 
+from .convert import (  # noqa: F401
+    config_from_hf,
+    from_pretrained,
+    is_hf_checkpoint,
+    load_hf_checkpoint,
+)
+
 MODEL_CONFIGS = {
     "gpt2-125m": GPT2_125M,
     "llama2-7b": LLAMA2_7B,
