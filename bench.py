@@ -39,6 +39,74 @@ def detect_peak():
     return PEAK_FLOPS["v5e" if dev.platform == "tpu" else "cpu"]
 
 
+def bench_serving(on_tpu: bool):
+    """FastGen-equivalent serving bench on the v2 ragged engine: p50 TTFT
+    (prefill via SplitFuse chunks) + batched decode tokens/sec, exercising
+    the Pallas paged-attention kernel on TPU (BASELINE.json 'FastGen p50
+    TTFT' metric)."""
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+    if on_tpu:
+        cfg = TransformerConfig(vocab_size=32000, hidden_size=2048,
+                                intermediate_size=5504, num_layers=8,
+                                num_heads=16, num_kv_heads=16,
+                                max_seq_len=2048, norm="rmsnorm",
+                                activation="silu", position="rope",
+                                tie_embeddings=False, dtype=jnp.bfloat16)
+        n_seqs, prompt_len, decode_steps, chunk = 8, 512, 64, 256
+        vcfg = RaggedInferenceEngineConfig(
+            max_ragged_batch_size=4096, max_ragged_sequence_count=16,
+            max_chunk_tokens=chunk, kv_blocks=128, kv_block_size=64,
+            max_tracked_sequences=64)
+    else:
+        cfg = TransformerConfig(vocab_size=512, hidden_size=128,
+                                intermediate_size=256, num_layers=2,
+                                num_heads=4, max_seq_len=256, norm="rmsnorm",
+                                activation="silu", position="rope")
+        n_seqs, prompt_len, decode_steps, chunk = 2, 32, 8, 32
+        vcfg = RaggedInferenceEngineConfig(
+            max_ragged_batch_size=256, max_ragged_sequence_count=8,
+            max_chunk_tokens=chunk, kv_blocks=64, kv_block_size=16,
+            max_tracked_sequences=16)
+
+    engine = InferenceEngineV2(CausalLM(cfg), config=vcfg)
+    rng = np.random.default_rng(0)
+
+    def run_phase(uid_base):
+        """Prefill all seqs (chunked) recording TTFT, then batched decode."""
+        ttfts = []
+        uids = []
+        for i in range(n_seqs):
+            uid = uid_base + i
+            prompt = rng.integers(0, cfg.vocab_size, size=prompt_len).tolist()
+            t0 = time.perf_counter()
+            for lo in range(0, prompt_len, chunk):
+                logits = engine.put([uid], [prompt[lo:lo + chunk]])
+            np.asarray(logits)          # first-token logits ready
+            ttfts.append(time.perf_counter() - t0)
+            uids.append(uid)
+        next_tok = [[int(rng.integers(0, cfg.vocab_size))] for _ in uids]
+        t0 = time.perf_counter()
+        for _ in range(decode_steps):
+            logits = engine.put(uids, next_tok)
+        np.asarray(logits)
+        decode_dt = time.perf_counter() - t0
+        for uid in uids:
+            engine.flush(uid)
+        return ttfts, n_seqs * decode_steps / decode_dt
+
+    run_phase(10_000)                   # warmup: compile all shape buckets
+    ttfts, decode_tps = run_phase(20_000)
+    return {
+        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+        "n_seqs": n_seqs,
+        "prompt_len": prompt_len,
+    }
+
+
 def main():
     import deepspeed_tpu
     from deepspeed_tpu.models import build_model
@@ -96,6 +164,11 @@ def main():
     jax.block_until_ready(engine.state.params)
     dt = (time.perf_counter() - t0) / steps
 
+    try:
+        serving = bench_serving(on_tpu)
+    except Exception as e:  # serving bench must never sink the train metric
+        serving = {"error": str(e)[:200]}
+
     n_params = model.num_params()
     tokens = global_batch * seq
     # 6ND fwd+bwd (+remat recompute ≈ 2ND when enabled) model FLOPs
@@ -115,6 +188,7 @@ def main():
             "n_devices": n_dev,
             "platform": jax.devices()[0].platform,
             "final_loss": float(loss),
+            "serving": serving,
         },
     }))
 

@@ -9,24 +9,25 @@ function processes a mixed prefill/decode ragged batch with static shapes:
 - tokens [N, C] padded chunks, per-seq ``start_pos`` (tokens already
   cached) and ``n_tokens`` (valid width) — Dynamic SplitFuse feeds both
   prompt chunks and single decode tokens through this same path;
-- paged KV cache [L, num_blocks, bs, KH, D] with per-seq block tables;
-  writes use flat scatter indices (drop-mode for padding), reads gather the
-  table into [N, max_ctx, KH, D] and mask — the XLA formulation of the
-  blocked-flash atom walk (a Pallas paged kernel slots in behind the same
-  signature);
+- paged KV cache [L, NB, KH, bs, D] with per-seq block tables; writes are
+  a drop-mode scatter at (block, slot), reads go through the Pallas
+  paged-attention kernel (``ops/paged_attention.py``) which walks each
+  sequence's block table directly — no dense [N, max_ctx, KH, D] gather,
+  no GQA ``jnp.repeat`` (the XLA gather formulation remains as the
+  off-TPU fallback inside ``paged_attention``);
 - returns logits only at each sequence's last valid token (logits_gather).
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...models.transformer import CausalLM, _norm, apply_rope, rope_table
+from ...models.transformer import CausalLM, _norm, rope_table
+from ...ops.paged_attention import paged_attention
 
 
 class PagedCausalLM:
@@ -44,7 +45,7 @@ class PagedCausalLM:
     def _forward(self, params, kv_cache, tokens, start_pos, n_tokens,
                  block_tables):
         """tokens [N, C]; start_pos/n_tokens [N]; block_tables [N, MB];
-        kv_cache {k,v}: [L, NB, BS, KH, D].
+        kv_cache {k,v}: [L, NB, KH, bs, D].
 
         Returns (last_logits [N, V], new_kv_cache).
         """
@@ -68,21 +69,16 @@ class PagedCausalLM:
 
         valid = jnp.arange(C)[None, :] < n_tokens[:, None]      # [N, C]
 
-        # scatter indices for KV writes: flat position in [NB*bs]
+        # scatter coordinates for KV writes: (pool block, slot-in-block)
         blk_idx = positions // bs                               # [N, C]
         blk_off = positions % bs
         blk_ids = jnp.take_along_axis(
             block_tables, jnp.clip(blk_idx, 0, MB - 1), axis=1)  # [N, C]
-        write_idx = jnp.where(valid & (blk_ids >= 0),
-                              blk_ids * bs + blk_off, -1)        # -1 → dropped
-
-        # gather indices for attention reads: all table positions
-        ctx_positions = jnp.arange(MB * bs)                      # [MB*bs]
-        tbl = jnp.repeat(block_tables, bs, axis=1)               # [N, MB*bs]
-        read_idx = jnp.where(tbl >= 0,
-                             tbl * bs + ctx_positions % bs, 0)   # [N, MB*bs]
-        ctx_len = start_pos + n_tokens                           # [N]
-        ctx_valid = ctx_positions[None, :] < ctx_len[:, None]    # [N, MB*bs]
+        # invalid tokens → sentinel NB: a *positive* out-of-range id, which
+        # mode="drop" really drops (-1 would wrap to pool block NB-1 — JAX
+        # normalizes negative scatter indices before the bounds check)
+        write_blk = jnp.where(valid & (blk_ids >= 0), blk_ids, NB).reshape(-1)
+        write_off = blk_off.reshape(-1)
 
         def rope_q(q):
             if cfg.position != "rope":
@@ -96,7 +92,7 @@ class PagedCausalLM:
                                    axis=-1).astype(q.dtype)
 
         def block(x, xs):
-            lp, kc, vc = xs   # kc/vc [NB, bs, KH, D]
+            lp, kc, vc = xs   # kc/vc [NB, KH, bs, D]
             h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
                        cfg.norm, cfg.norm_eps)
             nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -104,32 +100,19 @@ class PagedCausalLM:
             k = rope_q((h1 @ lp["wk"].astype(dt)).reshape(N, C, kvh, hd))
             v = (h1 @ lp["wv"].astype(dt)).reshape(N, C, kvh, hd)
 
-            # paged KV write (reference linear_blocked_kv_rotary kernel)
-            kc_flat = kc.reshape(NB * bs, kvh, hd)
-            vc_flat = vc.reshape(NB * bs, kvh, hd)
-            flat_w = write_idx.reshape(-1)
-            kc_flat = kc_flat.at[flat_w].set(
+            # paged KV write (reference linear_blocked_kv_rotary kernel):
+            # token t lands at kc[block(t), :, slot(t), :]
+            kc = kc.at[write_blk, :, write_off, :].set(
                 k.reshape(-1, kvh, hd), mode="drop")
-            vc_flat = vc_flat.at[flat_w].set(
+            vc = vc.at[write_blk, :, write_off, :].set(
                 v.reshape(-1, kvh, hd), mode="drop")
 
-            # paged read (reference blocked_flash over atoms)
-            k_ctx = kc_flat[read_idx]                  # [N, MB*bs, KH, D]
-            v_ctx = vc_flat[read_idx]
-            if kvh != nh:
-                k_ctx = jnp.repeat(k_ctx, nh // kvh, axis=2)
-                v_ctx = jnp.repeat(v_ctx, nh // kvh, axis=2)
-            scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-            s = jnp.einsum("nchd,nshd->nhcs", q, k_ctx).astype(jnp.float32) * scale
-            causal = positions[:, None, :, None] >= ctx_positions[None, None, None, :]
-            mask = causal & ctx_valid[:, None, None, :] & valid[:, None, :, None]
-            s = jnp.where(mask, s, -1e30)
-            p = jax.nn.softmax(s, axis=-1).astype(dt)
-            attn = jnp.einsum("nhcs,nshd->nchd", p, v_ctx).reshape(N, C, nh * hd)
-            x = x + attn @ lp["wo"].astype(dt)
+            # paged read: Pallas block-table walk (reference blocked_flash)
+            attn = paged_attention(q, kc, vc, block_tables, start_pos,
+                                   n_tokens)
+            x = x + attn.reshape(N, C, nh * hd) @ lp["wo"].astype(dt)
             x = self.model._mlp(x, lp)
-            return x, (kc_flat.reshape(NB, bs, kvh, hd),
-                       vc_flat.reshape(NB, bs, kvh, hd))
+            return x, (kc, vc)
 
         x, (new_k, new_v) = lax.scan(block, x,
                                      (params["layers"], kv_cache["k"],

@@ -42,8 +42,11 @@ class DSStateManager:
         self.allocator = BlockedAllocator(num_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
         dt = dtype or model_cfg.dtype
-        shape = (model_cfg.num_layers, num_blocks, block_size,
-                 model_cfg.kv_heads, model_cfg.head_dim)
+        # [L, NB, KH, bs, D]: the per-(block, kv-head) slab is the trailing
+        # [bs, D] — one tileable VMEM block, DMA'd directly by the Pallas
+        # paged-attention index maps (ops/paged_attention.py).
+        shape = (model_cfg.num_layers, num_blocks, model_cfg.kv_heads,
+                 block_size, model_cfg.head_dim)
         self.kv_cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     # -- sequence registry -------------------------------------------------

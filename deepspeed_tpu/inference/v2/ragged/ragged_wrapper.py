@@ -56,11 +56,34 @@ class RaggedBatchWrapper:
         self.uids.append(uid)
         return i
 
-    def finalize(self) -> Dict[str, np.ndarray]:
-        """Device-ready arrays (the reference's pinned-buffer upload)."""
+    @staticmethod
+    def _bucket(n: int, cap: int) -> int:
+        """Smallest power of two >= n, capped. Bounds the number of compiled
+        program variants to O(log² cap) while letting a decode step run a
+        [S, 1] batch instead of the full [max_seqs, max_chunk] pad."""
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, cap)
+
+    def finalize(self, bucketed: bool = True) -> Dict[str, np.ndarray]:
+        """Device-ready arrays (the reference's pinned-buffer upload).
+
+        With ``bucketed`` (default), the batch is trimmed to
+        (bucket(num_seqs), bucket(max chunk width)) — rows beyond the real
+        sequences carry n_tokens=0 / table=-1 and are fully masked."""
+        if not bucketed:
+            return {
+                "tokens": self.tokens,
+                "start_pos": self.start_pos,
+                "n_tokens": self.n_tokens,
+                "block_tables": self.block_tables,
+            }
+        S = self._bucket(max(len(self.uids), 1), self.max_seqs)
+        C = self._bucket(max(int(self.n_tokens.max()), 1), self.max_chunk)
         return {
-            "tokens": self.tokens,
-            "start_pos": self.start_pos,
-            "n_tokens": self.n_tokens,
-            "block_tables": self.block_tables,
+            "tokens": self.tokens[:S, :C],
+            "start_pos": self.start_pos[:S],
+            "n_tokens": self.n_tokens[:S],
+            "block_tables": self.block_tables[:S],
         }

@@ -1,0 +1,234 @@
+"""Pallas paged (block-table) attention — the FastGen decode/serving hot op.
+
+Counterpart of the reference's ragged kernel suite
+(``inference/v2/kernels/ragged_ops/blocked_flash/blocked_flash.cpp`` — the
+blocked flash attention over "atoms" — plus ``atom_builder/atom_builder.cpp``
+which splits the ragged batch into fixed-size attention atoms). The TPU-first
+design needs no atom decomposition: the grid *is* the atom walk —
+``(seqs, kv_heads, table_blocks)`` with the table dimension innermost, each
+step streaming one KV block from the paged pool through VMEM into an online
+softmax.
+
+- **q** [N, C, H, D]: per-sequence chunk of new tokens (C = 1 for pure
+  decode; Dynamic SplitFuse feeds prompt chunks through the same path).
+- **KV pool** [NB, KH, bs, D]: the paged cache. The pool's per-(block,
+  kv-head) slab is the trailing [bs, D] — exactly one tileable VMEM block,
+  DMA'd directly by a BlockSpec index map that *dereferences the block
+  table* (scalar-prefetched, so indices are known before the body runs).
+  No [N, max_ctx, H, D] gather is ever materialized in HBM and GQA needs
+  no ``jnp.repeat`` — each grid step matmuls the [G·C, D] query group
+  against the shared [bs, D] KV block.
+- **Dead blocks** (past a sequence's context length) are skipped by
+  ``pl.when`` for compute and — because the index map clamps them to the
+  sequence's last live block, and Pallas only issues a DMA when the mapped
+  index changes — cost no HBM traffic either (same mechanism as the causal
+  clamp in flash_attention.py).
+- Masking: query row r (= g·C + ci) has global position start_pos + ci;
+  KV slot s in table block b has position b·bs + s; attend iff
+  kv_pos <= q_pos (causal over the shared pool) and kv_pos < ctx_len.
+
+The XLA gather formulation (``paged_attention_xla``) remains as the
+off-TPU fallback and the numeric reference for the kernel tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+NEG_INF = -1e30
+LANES = 128
+
+# Test hook: force the Pallas path in interpreter mode off-TPU (same pattern
+# as ops/flash_attention.py).
+_FORCE_INTERPRET = False
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _use_interpret() -> bool:
+    return _FORCE_INTERPRET or not _on_tpu()
+
+
+# ------------------------------------------------------------------- kernel
+
+def _paged_kernel(tables_ref, startp_ref, ntok_ref, q_ref, k_ref, v_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *, block_size: int,
+                  chunk: int, sm_scale: float):
+    """One (n, kh, b) grid step: fold table block b of sequence n into the
+    online softmax of its [G·C, D] query group."""
+    n = pl.program_id(0)
+    b = pl.program_id(2)
+    nb = pl.num_programs(2)
+
+    @pl.when(b == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx_len = startp_ref[n] + ntok_ref[n]
+    live = b * block_size < ctx_len
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # [G*C, D]
+        k = k_ref[0, 0].astype(jnp.float32)                   # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [G*C, bs]
+        # causal + context mask: q row r is chunk pos r % C at global
+        # position startp + r % C; KV slot col is position b*bs + col.
+        ci = lax.broadcasted_iota(jnp.int32, s.shape, 0) % chunk
+        qpos = startp_ref[n] + ci
+        kvpos = b * block_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where((kvpos <= qpos) & (kvpos < ctx_len), s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]               # [G*C, 128]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(b == nb - 1)
+    def _flush():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l[:, :1]).astype(o_ref.dtype)
+
+
+def _clamp_tables(block_tables, ctx_len, block_size):
+    """Replace dead/unallocated table entries with the sequence's last live
+    block id so the kernel's index map repeats it (no DMA is issued when the
+    mapped block doesn't change between grid steps)."""
+    N, MB = block_tables.shape
+    live_blocks = jnp.maximum(-(-ctx_len // block_size), 1)        # [N] >= 1
+    cols = jnp.arange(MB)[None, :]
+    last_live = jnp.clip(live_blocks - 1, 0, MB - 1)[:, None]
+    idx = jnp.minimum(cols, last_live)
+    tbl = jnp.take_along_axis(block_tables, idx, axis=1)
+    return jnp.maximum(tbl, 0).astype(jnp.int32)
+
+
+def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
+                  interpret: bool):
+    N, C, H, D = q.shape
+    NB, KH, bs, _ = k_pool.shape
+    G = H // KH
+    MB = block_tables.shape[1]
+    sm_scale = 1.0 / math.sqrt(D)
+
+    # [N, C, H, D] -> [N, KH, G*C, D]: row r = g*C + ci
+    qh = q.transpose(0, 2, 1, 3).reshape(N, KH, G * C, D)
+
+    ctx_len = start_pos + n_tokens
+    tables = _clamp_tables(block_tables, ctx_len, bs)
+    startp = start_pos.astype(jnp.int32)
+    ntok = n_tokens.astype(jnp.int32)
+
+    kernel = functools.partial(_paged_kernel, block_size=bs, chunk=C,
+                               sm_scale=sm_scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(N, KH, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, G * C, D),
+                         lambda n, kh, b, tbl, sp, nt: (n, kh, 0, 0)),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda n, kh, b, tbl, sp, nt: (tbl[n, b], kh, 0, 0)),
+            pl.BlockSpec((1, 1, bs, D),
+                         lambda n, kh, b, tbl, sp, nt: (tbl[n, b], kh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G * C, D),
+                               lambda n, kh, b, tbl, sp, nt: (n, kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G * C, D), jnp.float32),
+            pltpu.VMEM((G * C, LANES), jnp.float32),
+            pltpu.VMEM((G * C, LANES), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, KH, G * C, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(tables, startp, ntok, qh, k_pool, v_pool)
+    # [N, KH, G*C, D] -> [N, C, H, D]
+    return (o.reshape(N, KH, G, C, D).transpose(0, 3, 1, 2, 4)
+            .reshape(N, C, H, D))
+
+
+# ----------------------------------------------------------- XLA reference
+
+def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens):
+    """Dense-gather formulation (the pre-Pallas path): gather the table into
+    [N, MB*bs, KH, D] and mask. Numerically the kernel's reference."""
+    N, C, H, D = q.shape
+    NB, KH, bs, _ = k_pool.shape
+    G = H // KH
+    MB = block_tables.shape[1]
+    sm_scale = 1.0 / math.sqrt(D)
+
+    ctx_positions = jnp.arange(MB * bs)
+    tbl = jnp.maximum(block_tables, 0)
+    # pool [NB, KH, bs, D] -> per-seq [N, MB, KH, bs, D] -> [N, KH, MB*bs, D]
+    k_ctx = k_pool[tbl]
+    v_ctx = v_pool[tbl]
+    k_ctx = k_ctx.transpose(0, 2, 1, 3, 4).reshape(N, KH, MB * bs, D)
+    v_ctx = v_ctx.transpose(0, 2, 1, 3, 4).reshape(N, KH, MB * bs, D)
+
+    qg = q.reshape(N, C, KH, G, D)
+    s = jnp.einsum("nckgd,nksd->nkgcs", qg, k_ctx).astype(jnp.float32) * sm_scale
+    ctx_len = (start_pos + n_tokens)[:, None]
+    qpos = start_pos[:, None] + jnp.arange(C)[None, :]          # [N, C]
+    causal = qpos[:, None, None, :, None] >= ctx_positions[None, None, None, None, :]
+    valid = (ctx_positions[None, :] < ctx_len)[:, None, None, None, :]
+    s = jnp.where(causal & valid, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    o = jnp.einsum("nkgcs,nksd->nckgd", p, v_ctx)
+    return o.reshape(N, C, H, D)
+
+
+# ------------------------------------------------------------------- public
+
+def _pallas_ok(q, k_pool) -> bool:
+    N, C, H, D = q.shape
+    KH = k_pool.shape[1]
+    return (_HAS_PALLAS and H % KH == 0 and D % 8 == 0
+            and (_on_tpu() or _FORCE_INTERPRET))
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens):
+    """Block-table paged attention.
+
+    q [N, C, H, D]; k/v pool [NB, KH, bs, D]; block_tables [N, MB]
+    (entries < 0 = unallocated); start_pos/n_tokens [N]. The pool must
+    already contain this chunk's K/V (write-then-attend, like the
+    reference's blocked_kv_rotary-then-blocked_flash sequence).
+    Rows beyond n_tokens are garbage (masked out downstream).
+    """
+    if _pallas_ok(q, k_pool):
+        return _paged_pallas(q, k_pool, v_pool, block_tables, start_pos,
+                             n_tokens, interpret=_use_interpret())
+    return paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos,
+                               n_tokens)

@@ -1,0 +1,151 @@
+"""Pallas paged attention: kernel (interpret mode) vs XLA gather reference.
+
+Mirrors the reference's ragged-ops kernel tests
+(tests/unit/inference/kernels/ragged_ops/test_blocked_flash.py pattern:
+build a paged cache + block tables, compare against a dense reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import paged_attention as pa
+
+
+def _build_case(rng, N, C, H, KH, D, bs, MB, NB, ctx_lens):
+    """Random pool + per-seq disjoint block tables with given context."""
+    q = jnp.asarray(rng.standard_normal((N, C, H, D)), jnp.float32)
+    k_pool = jnp.asarray(rng.standard_normal((NB, KH, bs, D)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((NB, KH, bs, D)), jnp.float32)
+    # assign disjoint blocks per sequence
+    perm = rng.permutation(NB)
+    tables = np.full((N, MB), -1, np.int64)
+    pos = 0
+    start_pos, n_tokens = [], []
+    for i, ctx in enumerate(ctx_lens):
+        nblk = -(-ctx // bs)
+        assert nblk <= MB and pos + nblk <= NB
+        tables[i, :nblk] = perm[pos:pos + nblk]
+        pos += nblk
+        n_tok = min(C, ctx)           # last n_tok positions are "this chunk"
+        start_pos.append(ctx - n_tok)
+        n_tokens.append(n_tok)
+    return (q, k_pool, v_pool, jnp.asarray(tables, jnp.int32),
+            jnp.asarray(start_pos, jnp.int32), jnp.asarray(n_tokens, jnp.int32))
+
+
+CASES = [
+    # N, C, H, KH, D, bs, MB, NB, ctx_lens
+    (3, 1, 4, 4, 64, 16, 4, 16, [1, 17, 50]),        # pure decode, MHA
+    (3, 1, 8, 2, 64, 16, 4, 16, [5, 33, 64]),        # pure decode, GQA
+    (2, 8, 4, 2, 64, 16, 6, 16, [8, 40]),            # prefill chunks, GQA
+    (4, 4, 4, 1, 128, 8, 8, 32, [4, 7, 30, 64]),     # MQA, ragged mix
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+def test_pallas_matches_xla(case, monkeypatch):
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    N, C, H, KH, D, bs, MB, NB, ctx_lens = case
+    rng = np.random.default_rng(0)
+    q, kp, vp, tbl, sp, nt = _build_case(rng, N, C, H, KH, D, bs, MB, NB,
+                                         ctx_lens)
+    ref = pa.paged_attention_xla(q, kp, vp, tbl, sp, nt)
+    out = pa.paged_attention(q, kp, vp, tbl, sp, nt)
+    # compare only valid rows (dead rows are unspecified)
+    for i in range(N):
+        v = int(nt[i])
+        np.testing.assert_allclose(np.asarray(out)[i, :v],
+                                   np.asarray(ref)[i, :v],
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_decode_matches_full_attention(monkeypatch):
+    """Paged decode of one new token == dense causal attention at that row."""
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    rng = np.random.default_rng(1)
+    H, KH, D, bs = 4, 2, 64, 8
+    ctx = 21                                          # 20 cached + 1 new
+    q1 = jnp.asarray(rng.standard_normal((1, 1, H, D)), jnp.float32)
+    # a dense context [S, KH, D], then page it into a shuffled pool
+    k_ctx = rng.standard_normal((ctx, KH, D)).astype(np.float32)
+    v_ctx = rng.standard_normal((ctx, KH, D)).astype(np.float32)
+    MB = -(-ctx // bs)
+    NB = MB + 3
+    pool_ids = rng.permutation(NB)[:MB]
+    k_pool = np.zeros((NB, KH, bs, D), np.float32)
+    v_pool = np.zeros((NB, KH, bs, D), np.float32)
+    for b in range(MB):
+        lo, hi = b * bs, min((b + 1) * bs, ctx)
+        k_pool[pool_ids[b], :, :hi - lo] = k_ctx[lo:hi].transpose(1, 0, 2)
+        v_pool[pool_ids[b], :, :hi - lo] = v_ctx[lo:hi].transpose(1, 0, 2)
+    tables = np.full((1, MB), -1, np.int64)
+    tables[0, :MB] = pool_ids
+    out = pa.paged_attention(q1, jnp.asarray(k_pool), jnp.asarray(v_pool),
+                             jnp.asarray(tables, jnp.int32),
+                             jnp.asarray([ctx - 1], jnp.int32),
+                             jnp.asarray([1], jnp.int32))
+    # dense reference over the unshuffled context
+    from deepspeed_tpu.models.transformer import attention_reference
+
+    ref = attention_reference(q1, jnp.asarray(k_ctx)[None],
+                              jnp.asarray(v_ctx)[None], causal=True)
+    np.testing.assert_allclose(np.asarray(out)[0, 0], np.asarray(ref)[0, 0],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_padded_rows_never_write_pool():
+    """Regression: padded tokens (n_tokens < C) must not scatter K/V into
+    the pool — a -1 write sentinel would wrap to pool block NB-1 (JAX
+    normalizes negative scatter indices before the bounds check)."""
+    from deepspeed_tpu.inference.v2.paged_model import PagedCausalLM
+    from deepspeed_tpu.models.transformer import CausalLM, TINY_TEST
+
+    model = CausalLM(TINY_TEST)
+    params = model.init(jax.random.PRNGKey(0))
+    bs, NB, MB = 4, 8, 4
+    paged = PagedCausalLM(model, bs, MB)
+    L = TINY_TEST.num_layers
+    kv = {"k": jnp.zeros((L, NB, TINY_TEST.kv_heads, bs, TINY_TEST.head_dim)),
+          "v": jnp.zeros((L, NB, TINY_TEST.kv_heads, bs, TINY_TEST.head_dim))}
+    # one seq using block 0 only, chunk padded C=8 with n_tokens=3;
+    # block NB-1 belongs to nobody and must stay zero
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    tables = jnp.asarray([[0, -1, -1, -1]], jnp.int32)
+    _, new_kv = paged.forward(params, kv, tokens,
+                              jnp.asarray([0], jnp.int32),
+                              jnp.asarray([3], jnp.int32), tables)
+    assert float(jnp.abs(new_kv["k"][:, NB - 1]).max()) == 0.0
+    assert float(jnp.abs(new_kv["v"][:, NB - 1]).max()) == 0.0
+    # ...and the real tokens did land in block 0
+    assert float(jnp.abs(new_kv["k"][:, 0, :, :3]).max()) > 0.0
+
+
+def test_dead_blocks_no_contribution(monkeypatch):
+    """Garbage in unallocated/dead blocks never leaks into the output."""
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    rng = np.random.default_rng(2)
+    q, kp, vp, tbl, sp, nt = _build_case(rng, 2, 1, 4, 2, 64, 16, 4, 16,
+                                         [10, 20])
+    out1 = pa.paged_attention(q, kp, vp, tbl, sp, nt)
+    # poison every pool block not referenced by a live table entry
+    live = set()
+    tbl_np = np.asarray(tbl)
+    for i in range(2):
+        nblk = -(-int(sp[i] + nt[i]) // 16)
+        live.update(tbl_np[i, :nblk].tolist())
+    kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+    for b in range(kp2.shape[0]):
+        if b not in live:
+            kp2[b] = 1e6
+            vp2[b] = 1e6
+    # also poison dead slots inside the last live block
+    for i in range(2):
+        ctx = int(sp[i] + nt[i])
+        last_b = tbl_np[i, (ctx - 1) // 16]
+        kp2[last_b, :, ctx % 16 or 16:] = 1e6
+        vp2[last_b, :, ctx % 16 or 16:] = 1e6
+    out2 = pa.paged_attention(q, jnp.asarray(kp2), jnp.asarray(vp2),
+                              tbl, sp, nt)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=2e-5, rtol=2e-5)
