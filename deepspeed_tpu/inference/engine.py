@@ -109,6 +109,12 @@ class InferenceEngine:
         else:
             shardings = self.plan.params(params)
             params = jax.tree.map(jax.device_put, params, shardings)
+        if self.config.quant.enabled:
+            # ZeRO-Inference weight-only quantization (inference/quantization
+            # .py): int8/int4 params in HBM, dequant fused into consumers
+            from .quantization import quantize_param_tree
+
+            params = quantize_param_tree(params, bits=self.config.quant.bits)
         self.params = params
         self._decode_jit = jax.jit(self.module.decode_step)
         self._prefill_jit = jax.jit(self.module.prefill)

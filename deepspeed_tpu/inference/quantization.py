@@ -1,0 +1,134 @@
+"""ZeRO-Inference weight-only quantization: int8/int4 params, dequant on use.
+
+Counterpart of reference ``deepspeed/inference/quantization/``
+(``quantization.py``, ``layers.py``: 4/8-bit weight-only quantization with
+dequant-on-the-fly linear layers — the "ZeRO-Inference 20× cheaper serving"
+path). The torch design swaps nn.Linear for QuantizedLinear modules; the
+TPU-native design needs no module surgery: param leaves become
+``QuantTensor`` pytree nodes (int8 payload + f32 block scales in HBM,
+int4 optionally nibble-packed) that dequantize lazily at their point of
+use inside jit — XLA fuses the ``q * scale`` multiply into the consuming
+matmul, so HBM holds 1/4 (int8) or 1/8 (int4) of the fp32 bytes and the
+MXU still sees bf16/f32 operands.
+
+``QuantTensor`` duck-types exactly the array surface the model layer code
+touches (``astype``, ``.T``, ``[indices]``, ``shape``): embedding lookups
+index the int8 rows *before* dequantizing, so a [V, H] table never
+materializes in fp.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quantizer import (choose_block, dequantize_blockwise, pack_int4,
+                             quantize_blockwise, unpack_int4)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantTensor:
+    """Blockwise-quantized array leaf. ``q`` int8 (or nibble-packed uint8
+    when ``packed``); ``scales`` f32 [..., N/block]."""
+    q: Any
+    scales: Any
+    block: int
+    bits: int
+    packed: bool
+    out_dtype: Any
+
+    # -- pytree -------------------------------------------------------------
+    def tree_flatten(self):
+        return (self.q, self.scales), (self.block, self.bits, self.packed,
+                                       self.out_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scales = children
+        return cls(q, scales, *aux)
+
+    # -- array duck-typing (the surface models/transformer.py touches) ------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        s = tuple(self.q.shape)
+        return s[:-1] + (s[-1] * 2,) if self.packed else s
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return self.out_dtype
+
+    def _values(self):
+        return unpack_int4(self.q) if self.packed else self.q
+
+    def dequantize(self, dtype=None):
+        return dequantize_blockwise(self._values(), self.scales,
+                                    block=self.block,
+                                    dtype=dtype or self.out_dtype)
+
+    def astype(self, dtype):
+        return self.dequantize(dtype)
+
+    @property
+    def T(self):
+        return self.dequantize().T
+
+    def __getitem__(self, idx):
+        """Row indexing (embedding lookup): gather int8 rows + their scales,
+        dequantize only the gathered rows. Last-dim indexing unsupported."""
+        return dequantize_blockwise(self._values()[idx], self.scales[idx],
+                                    block=self.block, dtype=self.out_dtype)
+
+    def __matmul__(self, other):
+        return self.dequantize() @ other
+
+    def __rmatmul__(self, other):
+        return other @ self.dequantize()
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.q.shape) * self.q.dtype.itemsize
+                   + np.prod(self.scales.shape) * self.scales.dtype.itemsize)
+
+
+def quantize_array(x, bits: int = 8, block: Optional[int] = None,
+                   pack: bool = True) -> QuantTensor:
+    block = block or choose_block(x.shape[-1])
+    q, s = quantize_blockwise(x, bits=bits, block=block)
+    packed = bits == 4 and pack and q.shape[-1] % 2 == 0
+    if packed:
+        q = pack_int4(q)
+    return QuantTensor(q=q, scales=s, block=block, bits=bits, packed=packed,
+                       out_dtype=x.dtype)
+
+
+def quantize_param_tree(params, bits: int = 8, min_dims: int = 2,
+                        min_size: int = 4096):
+    """Weight-only quantization of a param pytree: matrices become
+    QuantTensors, small/1-D leaves (norms, biases) stay fp (reference
+    layers.py quantizes Linear/Embedding weights only)."""
+    def one(leaf):
+        if leaf.ndim < min_dims or leaf.size < min_size:
+            return leaf
+        return quantize_array(leaf, bits=bits)
+
+    return jax.tree.map(one, params)
+
+
+def tree_nbytes(params) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantTensor)):
+        if isinstance(leaf, QuantTensor):
+            total += leaf.nbytes
+        else:
+            total += int(np.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize)
+    return total

@@ -4,7 +4,9 @@ Counterpart of reference ``inference/v2/ragged/ragged_manager.py``
 (``DSStateManager``), ``sequence_descriptor.py`` (``DSSequenceDescriptor``)
 and ``kv_cache.py`` (``BlockedKVCache``): tracks per-sequence seen-token
 counts and KV block ownership, allocates blocks on demand, and owns the
-device-side paged cache tensors [L, num_blocks, block_size, KH, D].
+device-side paged cache tensors [L, num_blocks, KH, block_size, D] (the
+per-(block, kv-head) slab is the trailing [block_size, D] — the layout the
+Pallas paged-attention index maps depend on, ops/paged_attention.py).
 """
 
 from __future__ import annotations

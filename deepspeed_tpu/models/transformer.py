@@ -273,6 +273,12 @@ class CausalLM:
 
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
+        # ZeRO++ hooks (parallel/zeropp.py, set by the training engine):
+        # explicit quantized all-gather of fsdp-sharded weights. layer_
+        # runs on each scan iteration's layer params, global_ on the
+        # non-stacked leaves (embeddings, final norm, lm head).
+        self.layer_transform = None
+        self.global_transform = None
 
     # -- init ---------------------------------------------------------------
     def init(self, rng) -> Dict[str, Any]:
@@ -453,6 +459,16 @@ class CausalLM:
         With ``return_aux``, returns (logits, moe_aux_loss)."""
         cfg = self.cfg
         B, T = tokens.shape
+        if self.global_transform is not None:
+            # gather the non-stacked weights once per step (ZeRO++ qwZ);
+            # keys are dotted paths to keep leaves unambiguous
+            flat = {f"{grp}.{k}": v for grp in ("embed", "final_norm", "lm_head")
+                    for k, v in params.get(grp, {}).items()}
+            flat = self.global_transform(flat)
+            params = dict(params)
+            for grp in ("embed", "final_norm", "lm_head"):
+                if grp in params:
+                    params[grp] = {k: flat[f"{grp}.{k}"] for k in params[grp]}
         x = params["embed"]["wte"][tokens].astype(cfg.dtype)
         if cfg.position == "learned":
             pos = positions if positions is not None else jnp.arange(T)
@@ -496,6 +512,8 @@ class CausalLM:
 
             def layer_fn(carry, layer_slice, micro_idx):
                 lp, key = layer_slice
+                if self.layer_transform is not None:
+                    lp = self.layer_transform(lp)
                 # distinct dropout mask per microbatch
                 key = jax.random.fold_in(key, micro_idx)
                 return block(carry, lp, cos, sin, key, deterministic)
@@ -508,6 +526,8 @@ class CausalLM:
         else:
             def scan_fn(carry, layer_params_and_key):
                 lp, key = layer_params_and_key
+                if self.layer_transform is not None:
+                    lp = self.layer_transform(lp)
                 x, aux = block(carry, lp, cos, sin, key, deterministic)
                 return x, aux
 

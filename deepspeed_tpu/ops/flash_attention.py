@@ -41,12 +41,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-try:
+from .pallas_utils import HAS_PALLAS as _HAS_PALLAS
+from .pallas_utils import on_tpu as _on_tpu
+if _HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    _HAS_PALLAS = False
 
 NEG_INF = -1e30
 LANES = 128        # scratch lane width for row statistics (VPU register shape)
@@ -56,13 +55,6 @@ STAT_LANES = 8     # lane width of the saved lse residual (min tileable, 16x
 # Test hook: force the Pallas path in interpreter mode off-TPU so CI (CPU)
 # exercises the same kernel code the TPU runs.
 _FORCE_INTERPRET = False
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
 
 
 # ----------------------------------------------------------------- fwd kernel

@@ -40,12 +40,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-try:
+from .pallas_utils import HAS_PALLAS as _HAS_PALLAS
+from .pallas_utils import on_tpu as _on_tpu
+if _HAS_PALLAS:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-    _HAS_PALLAS = True
-except Exception:  # pragma: no cover
-    _HAS_PALLAS = False
 
 NEG_INF = -1e30
 LANES = 128
@@ -53,13 +52,6 @@ LANES = 128
 # Test hook: force the Pallas path in interpreter mode off-TPU (same pattern
 # as ops/flash_attention.py).
 _FORCE_INTERPRET = False
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
-        return False
 
 
 def _use_interpret() -> bool:

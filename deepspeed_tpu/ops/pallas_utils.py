@@ -1,0 +1,25 @@
+"""Shared Pallas availability / platform probing for the kernel modules.
+
+Each kernel module (flash_attention, paged_attention, quantizer) keeps its
+own ``_FORCE_INTERPRET`` test hook (tests monkeypatch per module), but the
+import guard and platform probe live here so a detection fix lands once.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax.experimental import pallas as pl                    # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu             # noqa: F401
+    HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    pl = pltpu = None
+    HAS_PALLAS = False
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False

@@ -80,28 +80,33 @@ _FSDP_PREFERRED = ("embed",)
 
 def _assign_fsdp(mesh_axes: list, shape: Tuple[int, ...], mesh: Mesh,
                  logical: Optional[Sequence[Optional[str]]] = None,
-                 fsdp_axis: str = topo.FSDP_AXIS) -> list:
-    """Shard one not-yet-sharded dim over the fsdp axis (must divide).
+                 fsdp_axes: Tuple[str, ...] = (topo.FSDP_AXIS,)) -> list:
+    """Shard one not-yet-sharded dim over the fsdp axis group (must divide).
 
     Preference: a dim with a logical name in ``_FSDP_PREFERRED`` (see above),
-    else the largest eligible dim (memory balance).
+    else the largest eligible dim (memory balance). ``fsdp_axes`` longer
+    than one (e.g. ``('fsdp', 'data')``) shards the dim over the product —
+    the ZeRO++ hpZ "primary partition": optimizer state spread over more
+    devices than the weight-gather group (reference zero/config.py:256).
     """
-    fsdp = mesh.shape.get(fsdp_axis, 1)
-    if fsdp <= 1:
+    axes = tuple(a for a in fsdp_axes if mesh.shape.get(a, 1) > 1)
+    size = math.prod(mesh.shape.get(a, 1) for a in axes)
+    if size <= 1:
         return mesh_axes
+    entry = axes if len(axes) > 1 else axes[0]
     logical = logical or [None] * len(shape)
     for name in _FSDP_PREFERRED:
         for i, (ax, dim, lname) in enumerate(zip(mesh_axes, shape, logical)):
-            if ax is None and lname == name and dim % fsdp == 0:
-                mesh_axes[i] = fsdp_axis
+            if ax is None and lname == name and dim % size == 0:
+                mesh_axes[i] = entry
                 return mesh_axes
-    # fallback: unsharded, divisible by fsdp size; pick the largest
+    # fallback: unsharded, divisible by the axis-group size; pick the largest
     best, best_size = None, 0
     for i, (ax, dim) in enumerate(zip(mesh_axes, shape)):
-        if ax is None and dim % fsdp == 0 and dim > best_size:
+        if ax is None and dim % size == 0 and dim > best_size:
             best, best_size = i, dim
     if best is not None:
-        mesh_axes[best] = fsdp_axis
+        mesh_axes[best] = entry
     return mesh_axes
 
 
@@ -110,7 +115,8 @@ def shard_spec_for(shape: Tuple[int, ...],
                    mesh: Mesh,
                    zero_stage: int = 0,
                    rules: Optional[Dict[str, Optional[str]]] = None,
-                   force_fsdp: bool = False) -> PartitionSpec:
+                   force_fsdp: bool = False,
+                   fsdp_axes: Tuple[str, ...] = (topo.FSDP_AXIS,)) -> PartitionSpec:
     """PartitionSpec for one parameter.
 
     ``force_fsdp`` is used for optimizer state / gradients under stages 1-2,
@@ -126,25 +132,28 @@ def shard_spec_for(shape: Tuple[int, ...],
             if n <= 1 or shape[i] % n != 0:
                 mesh_axes[i] = None
     if zero_stage >= 3 or force_fsdp:
-        mesh_axes = _assign_fsdp(mesh_axes, shape, mesh, logical)
+        mesh_axes = _assign_fsdp(mesh_axes, shape, mesh, logical, fsdp_axes)
     return PartitionSpec(*mesh_axes)
 
 
 def tree_shardings(params_or_shapes, spec_tree, mesh: Mesh, zero_stage: int = 0,
-                   rules=None, force_fsdp: bool = False):
+                   rules=None, force_fsdp: bool = False,
+                   fsdp_axes: Tuple[str, ...] = (topo.FSDP_AXIS,)):
     """Tree of NamedShardings matching a param (or ShapeDtypeStruct) tree.
 
     ``spec_tree`` mirrors the param tree with ParamSpec leaves (or None).
     """
     def one(leaf, lspec):
         shape = leaf.shape if hasattr(leaf, "shape") else tuple(leaf)
-        ps = shard_spec_for(shape, lspec, mesh, zero_stage, rules, force_fsdp)
+        ps = shard_spec_for(shape, lspec, mesh, zero_stage, rules, force_fsdp,
+                            fsdp_axes)
         return NamedSharding(mesh, ps)
 
     if spec_tree is None:
         return jax.tree.map(
             lambda l: NamedSharding(
-                mesh, shard_spec_for(l.shape, None, mesh, zero_stage, rules, force_fsdp)),
+                mesh, shard_spec_for(l.shape, None, mesh, zero_stage, rules,
+                                     force_fsdp, fsdp_axes)),
             params_or_shapes)
     return jax.tree.map(one, params_or_shapes, spec_tree,
                         is_leaf=lambda x: isinstance(x, ParamSpec) or x is None)
@@ -158,12 +167,16 @@ class ZeroShardingPlan:
     """
 
     def __init__(self, topology: topo.MeshTopology, zero_stage: int,
-                 spec_tree=None, rules=None):
+                 spec_tree=None, rules=None, hpz: bool = False):
         self.topo = topology
         self.mesh = topology.mesh
         self.stage = zero_stage
         self.spec_tree = spec_tree
         self.rules = rules
+        # ZeRO++ hpZ: optimizer state sharded over fsdp×data (the "primary"
+        # partition spanning all DP replicas) while params/grads stay on the
+        # fsdp axis only, so weight gathers ride the small group.
+        self.hpz = hpz
 
     def params(self, shapes):
         return tree_shardings(shapes, self.spec_tree, self.mesh, self.stage,
@@ -180,8 +193,11 @@ class ZeroShardingPlan:
         # param spec tree is replicated per moment key.
         spec = (None if self.spec_tree is None
                 else {k: self.spec_tree for k in moment_shapes})
+        axes = ((topo.FSDP_AXIS, topo.DATA_AXIS) if self.hpz
+                else (topo.FSDP_AXIS,))
         return tree_shardings(moment_shapes, spec, self.mesh, self.stage,
-                              self.rules, force_fsdp=self.stage >= 1)
+                              self.rules, force_fsdp=self.stage >= 1,
+                              fsdp_axes=axes)
 
     def batch(self):
         return self.topo.batch_sharding()

@@ -1,0 +1,162 @@
+"""ZeRO++ — quantized ZeRO-3 collectives (qwZ, qgZ) and hpZ sharding.
+
+Counterpart of the reference's ZeRO++ machinery:
+
+- qwZ — quantized weight all-gather (`runtime/zero/partition_parameters.py:679``
+  ``CUDAQuantizer``: int8 block quant around the stage-3 param all-gather);
+- qgZ — quantized gradient reduce (``runtime/comm/coalesced_collectives.py:31``
+  ``all_to_all_quant_reduce``: quantize, all-to-all, dequantize, reduce —
+  replacing the fp reduce-scatter);
+- hpZ — hierarchical partitioning (``zero/config.py:256-272``): weight
+  shards gathered over a *small* group while optimizer state shards over a
+  larger one (see ``ZeroShardingPlan.opt_state``'s hpz extension).
+
+TPU-native formulation: under GSPMD the stage-3 weight all-gather is
+implicit (XLA inserts it per layer inside the scan). To quantize it, the
+gather is made *explicit* for exactly the weight leaves: a ``shard_map``
+over the mesh wraps each scan iteration's layer params, all-gathering the
+int8 payload + f32 block scales over the ``fsdp`` axis and dequantizing in
+VMEM-adjacent fused ops. The backward (via ``jax.custom_vjp``) is the
+gradient reduce: quantize → ``lax.all_to_all`` → dequantize → sum when qgZ
+is on (the all_to_all_quant_reduce pattern), else a plain
+``lax.psum_scatter``. Comm rides ICI with 1/4 (int8) or 1/8 (packed int4)
+of the fp32 byte volume.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from ..ops.quantizer import (choose_block, dequantize_blockwise, pack_int4,
+                             quantize_blockwise, unpack_int4)
+from . import topology as topo
+
+
+def _gather_dim(spec: PartitionSpec, axis: str) -> Optional[int]:
+    """Index of the dim sharded over ``axis`` in a PartitionSpec (None if
+    the leaf isn't sharded over it)."""
+    for i, entry in enumerate(spec):
+        names = entry if isinstance(entry, tuple) else (entry,)
+        if axis in names:
+            return i
+    return None
+
+
+def _without_axis(spec: PartitionSpec, axis: str) -> PartitionSpec:
+    out = []
+    for entry in spec:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if n != axis)
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return PartitionSpec(*out)
+
+
+def quantized_all_gather(x, axis_name: str, gdim: int, *, qw_bits: Optional[int],
+                         qg_bits: Optional[int], out_dtype):
+    """All-gather ``x`` (one device's shard) over ``axis_name`` along dim
+    ``gdim``, int-quantized on the wire; backward is the (optionally
+    quantized) gradient reduce-scatter. Must run inside shard_map."""
+
+    @jax.custom_vjp
+    def gather(x):
+        return _fwd(x)[0]
+
+    def _fwd(x):
+        if qw_bits is None:
+            return lax.all_gather(x, axis_name, axis=gdim, tiled=True), None
+        block = choose_block(x.shape[-1])
+        q, s = quantize_blockwise(x, bits=qw_bits, block=block)
+        if qw_bits == 4 and q.shape[-1] % 2 == 0:
+            payload = pack_int4(q)
+            payload = lax.all_gather(payload, axis_name, axis=gdim, tiled=True)
+            q_full = unpack_int4(payload)
+        else:
+            q_full = lax.all_gather(q, axis_name, axis=gdim, tiled=True)
+        # s has x's rank (last dim = n_blocks), so the gather dim carries over
+        s_full = lax.all_gather(s, axis_name, axis=gdim, tiled=True)
+        return dequantize_blockwise(q_full, s_full, block=block,
+                                    dtype=out_dtype), None
+
+    def _bwd(_, g):
+        world = lax.axis_size(axis_name)
+        if qg_bits is None:
+            return (lax.psum_scatter(g, axis_name, scatter_dimension=gdim,
+                                     tiled=True),)
+        # all_to_all_quant_reduce: split my full gradient into per-owner
+        # chunks, quantize each, exchange, dequantize, and sum the world
+        # partial contributions of my shard.
+        chunks = jnp.stack(jnp.split(g, world, axis=gdim), axis=0)
+        block = choose_block(chunks.shape[-1])
+        q, s = quantize_blockwise(chunks, bits=qg_bits, block=block)
+        # stacked [world, ...] exchange: slice j goes to device j, received
+        # slices stack back on dim 0 (one partial contribution per peer)
+        if qg_bits == 4 and q.shape[-1] % 2 == 0:
+            payload = pack_int4(q)
+            payload = lax.all_to_all(payload, axis_name, split_axis=0,
+                                     concat_axis=0)
+            q = unpack_int4(payload)
+        else:
+            q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+        s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+        parts = dequantize_blockwise(q, s, block=block, dtype=jnp.float32)
+        return (jnp.sum(parts, axis=0).astype(g.dtype),)
+
+    gather.defvjp(lambda x: (_fwd(x)[0], None), _bwd)
+    return gather(x)
+
+
+def make_quantized_gather_transform(mesh: Mesh, leaf_specs: Dict[str, Any],
+                                    *, qw_bits: Optional[int] = 8,
+                                    qg_bits: Optional[int] = None,
+                                    dtype=jnp.float32,
+                                    axis: str = topo.FSDP_AXIS):
+    """Build a transform(dict-of-arrays) -> dict-of-arrays that explicitly
+    all-gathers every fsdp-sharded leaf with quantized payloads.
+
+    ``leaf_specs``: leaf name → PartitionSpec of that leaf (per-layer view,
+    i.e. without the stacked-layers dim). Leaves without an fsdp-sharded
+    dim pass through untouched (XLA handles them as before).
+    """
+    if mesh.shape.get(axis, 1) <= 1:
+        return None
+
+    gathered: Dict[str, int] = {}
+    for name, spec in leaf_specs.items():
+        gd = _gather_dim(spec, axis)
+        if gd is not None:
+            gathered[name] = gd
+    if not gathered:
+        return None
+
+    in_specs = {name: leaf_specs[name] for name in leaf_specs}
+    out_specs = {name: (_without_axis(leaf_specs[name], axis)
+                        if name in gathered else leaf_specs[name])
+                 for name in leaf_specs}
+
+    from jax import shard_map
+
+    def body(lp: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for name, w in lp.items():
+            if name in gathered:
+                out[name] = quantized_all_gather(
+                    w, axis, gathered[name], qw_bits=qw_bits,
+                    qg_bits=qg_bits, out_dtype=w.dtype)
+            else:
+                out[name] = w
+        return out
+
+    def transform(lp: Dict[str, Any]) -> Dict[str, Any]:
+        fn = shard_map(body, mesh=mesh,
+                       in_specs=({k: in_specs[k] for k in lp},),
+                       out_specs={k: out_specs[k] for k in lp},
+                       check_vma=False)
+        return fn(lp)
+
+    return transform

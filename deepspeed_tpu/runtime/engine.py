@@ -90,7 +90,21 @@ class DeepSpeedTpuEngine:
         self.zero_stage = self.config.zero_optimization.stage
         spec_tree = (self.module.param_specs()
                      if hasattr(self.module, "param_specs") else None)
-        self.plan = ZeroShardingPlan(self.topology, self.zero_stage, spec_tree)
+        hpz_size = self.config.zero_optimization.zero_hpz_partition_size
+        if hpz_size > 1:
+            # hpZ maps the secondary (weight-shard) group onto the fsdp mesh
+            # axis and the primary partition onto fsdp×data; the configured
+            # group size must therefore equal the fsdp axis size — honoring
+            # an arbitrary size would need a different mesh, so reject
+            # rather than silently reinterpret (reference zero/config.py:256).
+            fsdp_size = self.topology.mesh.shape.get("fsdp", 1)
+            if hpz_size != fsdp_size:
+                raise ValueError(
+                    f"zero_hpz_partition_size={hpz_size} must equal the mesh "
+                    f"fsdp axis size ({fsdp_size}); size the mesh's fsdp axis "
+                    "to the intended secondary-partition group")
+        self.plan = ZeroShardingPlan(
+            self.topology, self.zero_stage, spec_tree, hpz=hpz_size > 1)
 
         # -- precision -----------------------------------------------------
         self.precision = self.config.precision
@@ -125,6 +139,9 @@ class DeepSpeedTpuEngine:
         # -- state init (sharded from birth — zero.Init role) --------------
         self._rng = rng if rng is not None else jax.random.PRNGKey(self.config.seed)
         self.state = self._init_state()
+
+        # -- ZeRO++ (qwZ/qgZ explicit quantized collectives) ---------------
+        self._setup_zeropp()
 
         # -- data ----------------------------------------------------------
         self.training_dataloader = None
@@ -195,6 +212,43 @@ class DeepSpeedTpuEngine:
         if oc is None or str(oc.device.value) == "none":
             return None
         return oc
+
+    def _setup_zeropp(self):
+        """ZeRO++ qwZ/qgZ: install explicit quantized-collective transforms
+        on the model (reference partition_parameters.py:679 CUDAQuantizer +
+        coalesced_collectives.py:31 all_to_all_quant_reduce; see
+        parallel/zeropp.py for the TPU formulation)."""
+        zc = self.config.zero_optimization
+        if not (zc.zero_quantized_weights or zc.zero_quantized_gradients):
+            return
+        if self.zero_stage < 3:
+            raise ValueError(
+                "zero_quantized_weights/gradients (ZeRO++) require "
+                f"zero_optimization.stage=3, got stage={self.zero_stage}")
+        if not isinstance(self.module, CausalLM):
+            raise ValueError("ZeRO++ transforms require a framework CausalLM "
+                             "(custom modules: wire parallel/zeropp.py "
+                             "make_quantized_gather_transform directly)")
+        from jax.sharding import PartitionSpec
+
+        from ..parallel.zeropp import make_quantized_gather_transform
+
+        qw = 8 if zc.zero_quantized_weights else None
+        qg = 8 if zc.zero_quantized_gradients else None
+        # per-layer view: strip the stacked-layers leading dim from each spec
+        layer_specs = {k: PartitionSpec(*ns.spec[1:])
+                       for k, ns in self._param_shardings["layers"].items()}
+        self.module.layer_transform = make_quantized_gather_transform(
+            self.mesh, layer_specs, qw_bits=qw, qg_bits=qg)
+        g_specs = {}
+        for grp in ("embed", "final_norm", "lm_head"):
+            for k, ns in self._param_shardings.get(grp, {}).items():
+                g_specs[f"{grp}.{k}"] = ns.spec
+        self.module.global_transform = make_quantized_gather_transform(
+            self.mesh, g_specs, qw_bits=qw, qg_bits=qg)
+        if self.module.layer_transform or self.module.global_transform:
+            log_dist(f"ZeRO++ enabled: qwZ={bool(qw)} qgZ={bool(qg)}",
+                     ranks=[0])
 
     def _init_state(self) -> TrainState:
         self._model_dtype_override()

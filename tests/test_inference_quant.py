@@ -1,0 +1,90 @@
+"""ZeRO-Inference weight-only quantization (int8/int4 QuantTensor params).
+
+Mirrors reference tests/unit/inference/quantization/test_intX_quantization.py:
+quantized model output stays close to fp, memory shrinks accordingly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.quantization import (QuantTensor,
+                                                  quantize_param_tree,
+                                                  tree_nbytes)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.models.transformer import TINY_TEST, CausalLM
+from deepspeed_tpu.parallel import topology as topo
+
+
+@pytest.fixture(scope="module")
+def fp_model():
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY_TEST, hidden_size=128, num_heads=4,
+                              num_kv_heads=4, intermediate_size=256,
+                              vocab_size=512)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def test_quant_tree_structure_and_bytes(fp_model):
+    model, params = fp_model
+    q8 = quantize_param_tree(params, bits=8)
+    assert isinstance(q8["layers"]["wq"], QuantTensor)
+    # 1-D norm weights stay fp
+    assert not isinstance(q8["layers"]["attn_norm_w"], QuantTensor)
+    fp_bytes = tree_nbytes(params)
+    assert tree_nbytes(q8) < 0.4 * fp_bytes
+    q4 = quantize_param_tree(params, bits=4)
+    assert q4["layers"]["wq"].packed
+    assert tree_nbytes(q4) < 0.25 * fp_bytes
+
+
+@pytest.mark.parametrize("bits,tol", [(8, 0.08), (4, 0.6)])
+def test_quantized_forward_close(fp_model, bits, tol):
+    model, params = fp_model
+    qp = quantize_param_tree(params, bits=bits)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, model.cfg.vocab_size, size=(2, 16)), jnp.int32)
+    fp = np.asarray(model.apply(params, toks), np.float32)
+    q = np.asarray(model.apply(qp, toks), np.float32)
+    # relative error on logits
+    rel = np.abs(q - fp).mean() / (np.abs(fp).mean() + 1e-9)
+    assert rel < tol, f"relative logit error {rel} at {bits} bits"
+    if bits == 8:
+        # argmax (greedy decision) preserved for most positions
+        agree = (fp.argmax(-1) == q.argmax(-1)).mean()
+        assert agree > 0.9
+
+
+def test_engine_quant_config(fp_model):
+    model, params = fp_model
+    topo.reset_topology()
+    engine = deepspeed_tpu.init_inference(
+        model, params=params, dtype="fp32", quant={"enabled": True, "bits": 8})
+    assert isinstance(engine.params["layers"]["wq"], QuantTensor)
+    toks = np.random.default_rng(1).integers(0, model.cfg.vocab_size,
+                                             size=(1, 8))
+    out = engine.generate(toks, max_new_tokens=4)
+    assert out.shape == (1, 12)
+    fp_logits = np.asarray(model.apply(params, jnp.asarray(toks, jnp.int32)))
+    q_logits = np.asarray(engine.forward(toks))
+    rel = np.abs(q_logits - fp_logits).mean() / (np.abs(fp_logits).mean() + 1e-9)
+    assert rel < 0.08
+    topo.reset_topology()
+
+
+def test_quant_tensor_scan_slicing(fp_model):
+    """QuantTensor leaves survive lax.scan slicing over the layer dim."""
+    model, params = fp_model
+    qp = quantize_param_tree(params, bits=8)
+    stacked = qp["layers"]["wq"]
+
+    def body(carry, layer_qt):
+        return carry + jnp.sum(layer_qt.astype(jnp.float32)), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros(()), stacked)
+    expect = jnp.sum(stacked.astype(jnp.float32))
+    np.testing.assert_allclose(float(total), float(expect), rtol=1e-5)
