@@ -37,6 +37,36 @@ def initialize(args=None,
     from .runtime.engine import DeepSpeedTpuEngine
 
     config = config if config is not None else config_params
+
+    # ZeRO-Infinity parameter streaming: params on NVMe/host DRAM, layer
+    # groups paged through HBM (runtime/zero_infinity.py). Selected — like
+    # the reference's swap-tensor path — by offload_param in the config.
+    cfg_obj = load_config(config)
+    op = cfg_obj.zero_optimization.offload_param
+    if op is not None and str(op.device.value) in ("cpu", "nvme"):
+        from .runtime.zero_infinity import ZeroInfinityEngine
+
+        unsupported = {"optimizer": optimizer, "training_data": training_data,
+                       "lr_scheduler": lr_scheduler,
+                       "model_parameters": model_parameters}
+        bad = [k for k, v in unsupported.items() if v is not None]
+        if bad:
+            raise ValueError(
+                f"offload_param (ZeRO-Infinity streaming) does not accept "
+                f"{bad}; the streaming engine owns its optimizer and data "
+                "path (runtime/zero_infinity.py)")
+        if cfg_obj.zero_optimization.stage < 3:
+            raise ValueError("offload_param requires zero_optimization.stage=3")
+        if (cfg_obj.gradient_accumulation_steps or 1) > 1:
+            raise ValueError("offload_param streaming does not support "
+                             "gradient_accumulation_steps > 1 yet")
+        if isinstance(model, str):
+            from .models import build_model
+
+            model = build_model(model)
+        engine = ZeroInfinityEngine(model, cfg_obj, rng=rng)
+        return engine, None, None, None
+
     engine = DeepSpeedTpuEngine(args=args,
                                 model=model,
                                 optimizer=optimizer,

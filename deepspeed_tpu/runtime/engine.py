@@ -718,11 +718,18 @@ class DeepSpeedTpuEngine:
 
     # ---------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True, exclude_frozen_parameters=False):
+                        save_latest=True, exclude_frozen_parameters=False,
+                        async_save=False):
         from .checkpointing import save_checkpoint as _save
 
         return _save(self, save_dir, tag=tag, client_state=client_state or {},
-                     save_latest=save_latest)
+                     save_latest=save_latest, async_save=async_save)
+
+    def wait_pending_checkpoint(self):
+        """Join an async_save's background writes (+ cross-host barrier)."""
+        from .checkpointing import wait_pending_save
+
+        wait_pending_save(self)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,

@@ -1,0 +1,106 @@
+"""Multi-host-safe sharded + async checkpointing.
+
+Mirrors the reference's per-rank zero-shard checkpoint layout tests
+(tests/unit/checkpoint/): each process writes only the shards it owns,
+nothing is gathered to one host, and async saves don't block the step
+loop."""
+
+import glob
+import itertools
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.parallel.topology as topo
+from deepspeed_tpu.models import build_model
+
+
+def make_engine(stage=3, mesh=None):
+    topo.reset_topology()
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "mesh": mesh or {"data": -1, "fsdp": 2},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=config)
+    return engine
+
+
+def train(engine, steps, seed=0):
+    rng = np.random.default_rng(seed)
+    dp = engine.topology.get_data_parallel_world_size()
+    batch = {"input_ids": rng.integers(0, 256, size=(2 * dp, 33),
+                                       dtype=np.int64)}
+    return [float(engine.train_batch(itertools.repeat(batch)))
+            for _ in range(steps)]
+
+
+def test_sharded_save_writes_per_shard_files(tmp_path):
+    engine = make_engine()
+    train(engine, 2)
+    tag_dir = engine.save_checkpoint(str(tmp_path))
+    shard_files = glob.glob(os.path.join(tag_dir, "params", "*.shard_*.npy"))
+    assert shard_files, "stage-3 save produced no per-shard files"
+    # a sharded leaf's shard files are strictly smaller than the full leaf
+    wte = engine.state.params["embed"]["wte"]
+    wte_shards = glob.glob(os.path.join(tag_dir, "params",
+                                        "embed.wte.shard_*.npy"))
+    assert wte_shards
+    for f in wte_shards:
+        assert np.load(f).size < wte.size
+
+
+def test_no_full_gather_on_save(tmp_path, monkeypatch):
+    """The save path must never device_get a sharded array whole (raises on
+    non-fully-addressable arrays in real multi-host meshes)."""
+    engine = make_engine()
+    train(engine, 1)
+    real_device_get = jax.device_get
+
+    def guarded(x):
+        if isinstance(x, jax.Array) and not x.is_fully_replicated:
+            raise AssertionError("full-array device_get of a sharded leaf")
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", guarded)
+    engine.save_checkpoint(str(tmp_path))
+
+
+def test_sharded_roundtrip_cross_mesh(tmp_path):
+    engine = make_engine(mesh={"data": -1, "fsdp": 2})
+    losses_a = train(engine, 3)
+    engine.save_checkpoint(str(tmp_path))
+    ref_params = [np.asarray(l) for l in jax.tree.leaves(engine.state.params)]
+
+    engine2 = make_engine(mesh={"data": -1, "fsdp": 4})
+    engine2.load_checkpoint(str(tmp_path))
+    for a, b in zip(ref_params, jax.tree.leaves(engine2.state.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+    # identical forward trajectory after resume
+    cont_a = train(engine, 2, seed=7)
+    cont_b = train(engine2, 2, seed=7)
+    np.testing.assert_allclose(cont_a, cont_b, rtol=1e-4, atol=1e-5)
+
+
+def test_async_save_does_not_block_and_is_durable(tmp_path):
+    engine = make_engine()
+    train(engine, 2)
+    snap = [np.asarray(l) for l in jax.tree.leaves(engine.state.params)]
+    tag_dir = engine.save_checkpoint(str(tmp_path), async_save=True)
+    # step loop continues while writes are in flight (donation-safe: shard
+    # bytes were snapshot before save_checkpoint returned)
+    train(engine, 2)
+    engine.wait_pending_checkpoint()
+
+    engine2 = make_engine()
+    engine2.load_checkpoint(str(tmp_path))
+    for a, b in zip(snap, jax.tree.leaves(engine2.state.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+    assert os.path.basename(tag_dir).startswith("global_step")

@@ -1,0 +1,116 @@
+"""ZeRO-Infinity parameter streaming: model bigger than the device budget.
+
+Mirrors the reference's swap-tensor tests (tests/unit/runtime/zero/
+test_zero_nvme_offload.py pattern): params live off-device, stream through
+in layer groups, training converges, and I/O counters prove streaming."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import TINY_TEST, CausalLM
+from deepspeed_tpu.parallel import topology as topo
+
+
+CFG = dataclasses.replace(TINY_TEST, num_layers=8, tie_embeddings=False,
+                          num_kv_heads=4)
+
+
+def make_engine(tmp_path, device="nvme", group_layers=2):
+    topo.reset_topology()
+    from deepspeed_tpu.runtime.config import load_config
+    from deepspeed_tpu.runtime.zero_infinity import ZeroInfinityEngine
+
+    config = load_config({
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": device,
+                              "nvme_path": str(tmp_path / "swap")},
+        },
+        "steps_per_print": 10**9,
+    })
+    return ZeroInfinityEngine(CausalLM(CFG), config,
+                              group_layers=group_layers)
+
+
+def batch():
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, 256, size=(4, 33), dtype=np.int64)}
+
+
+def test_initialize_selects_streaming_engine(tmp_path):
+    topo.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(CFG),
+        config={
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+            "zero_optimization": {
+                "stage": 3,
+                "offload_param": {"device": "nvme",
+                                  "nvme_path": str(tmp_path / "swap")}},
+        })
+    from deepspeed_tpu.runtime.zero_infinity import ZeroInfinityEngine
+
+    assert isinstance(engine, ZeroInfinityEngine)
+    engine.close()
+
+
+def test_streaming_forward_matches_monolithic(tmp_path):
+    """The streamed group-by-group forward == one whole-model loss."""
+    engine = make_engine(tmp_path, group_layers=3)   # uneven split: 3+3+2
+    data = batch()
+
+    # assemble the full param tree from the store
+    layers = {}
+    for k in engine._layer_keys:
+        parts = [engine.store.get(f"layers.{k}.g{gi}")
+                 for gi in range(len(engine.groups))]
+        layers[k] = jnp.asarray(np.concatenate(parts, axis=0))
+    params = {"embed": dict(engine._edge_params["embed"]),
+              "layers": layers,
+              "final_norm": dict(engine._edge_params["final_norm"]),
+              "lm_head": dict(engine._edge_params["lm_head"])}
+    model = CausalLM(CFG)
+    mono = float(model.loss(params, data))
+
+    reads_before = engine.store.reads
+    streamed = engine.train_batch(dict(data))
+    assert engine.store.reads > reads_before, "no streaming reads happened"
+    np.testing.assert_allclose(streamed, mono, rtol=1e-5)
+    engine.close()
+
+
+@pytest.mark.parametrize("device", ["cpu", "nvme"])
+def test_streaming_training_converges(tmp_path, device):
+    engine = make_engine(tmp_path, device=device)
+    data = batch()
+    losses = [engine.train_batch(dict(data)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.5, f"no convergence: {losses}"
+    if device == "nvme":
+        # reads: params+moments per group per step; writes prove page-out
+        assert engine.store.reads > len(engine.groups) * 8
+        assert engine.store.writes > len(engine.groups) * 8
+    engine.close()
+
+
+def test_device_budget_accounting(tmp_path):
+    """Full param bytes exceed what any single step keeps on device: the
+    resident set is O(2 groups + edges), not O(model)."""
+    engine = make_engine(tmp_path, group_layers=2)
+    group_bytes = engine.param_bytes // len(engine.groups)
+    edge_bytes = sum(int(np.prod(v.shape)) * 4
+                     for grp in engine._edge_params.values()
+                     for v in grp.values())
+    resident_budget = 2 * group_bytes + edge_bytes
+    assert engine.param_bytes + edge_bytes > resident_budget, (
+        "model must exceed the streaming resident set for the test to mean "
+        "anything")
+    assert len(engine.groups) == 4
+    engine.close()
