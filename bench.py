@@ -171,8 +171,12 @@ def main():
 
     n_params = model.num_params()
     tokens = global_batch * seq
-    # 6ND fwd+bwd (+remat recompute ≈ 2ND when enabled) model FLOPs
-    flops_per_step = (8 if cfg.remat else 6) * n_params * tokens
+    # model FLOPs from the flops profiler's analytic counting (6/8ND plus
+    # the attention quadratic term — deepspeed_tpu/profiling)
+    from deepspeed_tpu.profiling import train_step_flops
+
+    flops_per_step = train_step_flops(cfg, global_batch, seq)
+    flops_6nd = (8 if cfg.remat else 6) * n_params * tokens
     mfu = flops_per_step / dt / (detect_peak() * n_dev)
     tokens_per_sec_chip = tokens / dt / n_dev
 
@@ -188,6 +192,7 @@ def main():
             "n_devices": n_dev,
             "platform": jax.devices()[0].platform,
             "final_loss": float(loss),
+            "mfu_6nd": round(flops_6nd / dt / (detect_peak() * n_dev), 4),
             "serving": serving,
         },
     }))

@@ -1,0 +1,193 @@
+"""Autotuner — search (zero_stage, micro_batch, mesh split) for throughput.
+
+Counterpart of reference ``autotuning/autotuner.py`` (``Autotuner`` :42,
+``tune`` :404, ``model_info_profile_run`` :663): the torch version forks
+launcher experiments across nodes and fits a model-based tuner. The
+TPU-native design is simpler and faster for the same capability: every
+candidate is one in-process engine build (XLA compile) + a few timed
+steps on the live mesh, because jit teardown is free — no process
+launches, no result scraping.
+
+Search space (reference ``_generate_experiments``):
+- ZeRO stage ∈ {0, 1, 2, 3} (user-constrained via base config);
+- micro batch per device ∈ powers of two up to
+  ``num_tuning_micro_batch_sizes`` values (the reference's
+  micro-batch sweep);
+- mesh split: pure DP vs fsdp vs hybrids over the device count.
+
+Results land in ``autotuning.results_dir`` as one JSON table
+(reference exps/results dirs), and ``tune()`` returns the best config
+merged into the base. Metric: tokens/sec (throughput, the reference's
+default) or step latency.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class Autotuner:
+    def __init__(self, model, base_config: Dict[str, Any],
+                 seq_len: Optional[int] = None):
+        self.model = model
+        self.base = dict(base_config)
+        self.at_cfg = self.base.get("autotuning", {})
+        self.seq_len = seq_len or 128
+        self.results: List[Dict[str, Any]] = []
+
+    # ----------------------------------------------------------- model info
+    def model_info_profile_run(self) -> Dict[str, Any]:
+        """Reference autotuner.py:663 — param count + per-token activation
+        estimate used to prune the search space."""
+        cfg = getattr(self.model, "cfg", None)
+        n_params = (self.model.num_params()
+                    if hasattr(self.model, "num_params") else 0)
+        act_per_token = 0
+        if cfg is not None:
+            act_per_token = (2 * cfg.hidden_size
+                             + cfg.intermediate_size) * cfg.num_layers
+        return {"num_params": n_params,
+                "activation_bytes_per_token": 4 * act_per_token}
+
+    # ------------------------------------------------------------ candidates
+    def _mesh_candidates(self) -> List[Dict[str, int]]:
+        n = len(jax.devices())
+        meshes = [{"data": -1, "fsdp": 1}]
+        f = 2
+        while f <= n:
+            meshes.append({"data": -1, "fsdp": f})
+            f *= 2
+        return meshes
+
+    def _micro_batch_candidates(self) -> List[int]:
+        base_mb = int(self.base.get("train_micro_batch_size_per_gpu", 1))
+        k = int(self.at_cfg.get("num_tuning_micro_batch_sizes", 3))
+        out = []
+        mb = max(1, base_mb)
+        for _ in range(k):
+            out.append(mb)
+            mb *= 2
+        return out
+
+    def _stage_candidates(self) -> List[int]:
+        zo = self.base.get("zero_optimization", {})
+        if "stage" in zo:
+            return [int(zo["stage"])]
+        return [0, 1, 2, 3]
+
+    # -------------------------------------------------------------- running
+    def _run_candidate(self, stage: int, micro: int,
+                       mesh: Dict[str, int]) -> Dict[str, Any]:
+        import deepspeed_tpu
+        from ..parallel import topology as topo
+
+        start = int(self.at_cfg.get("start_profile_step", 3))
+        end = int(self.at_cfg.get("end_profile_step", 5))
+        cfg = dict(self.base)
+        cfg["train_micro_batch_size_per_gpu"] = micro
+        cfg["zero_optimization"] = dict(self.base.get("zero_optimization", {}),
+                                        stage=stage)
+        cfg["mesh"] = mesh
+        cfg.setdefault("steps_per_print", 10**9)
+        record = {"zero_stage": stage, "micro_batch": micro, "mesh": mesh}
+        topo.reset_topology()
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(model=self.model,
+                                                       config=cfg)
+            dp = engine.topology.get_data_parallel_world_size()
+            vocab = getattr(self.model.cfg, "vocab_size", 1024)
+            rng = np.random.default_rng(0)
+            batch = {"input_ids": rng.integers(
+                0, vocab, size=(micro * dp, self.seq_len + 1),
+                dtype=np.int64)}
+            it = itertools.repeat(batch)
+            for _ in range(start):            # warmup/compile
+                engine.train_batch(it)
+            engine._sync()
+            t0 = time.perf_counter()
+            for _ in range(max(1, end - start)):
+                engine.train_batch(it)
+            engine._sync()
+            dt = (time.perf_counter() - t0) / max(1, end - start)
+            tokens = micro * dp * self.seq_len
+            record.update(status="ok", step_time_s=dt,
+                          tokens_per_sec=tokens / dt)
+        except Exception as e:                # OOM/invalid combo → pruned
+            record.update(status="error", error=str(e)[:200],
+                          tokens_per_sec=0.0)
+        finally:
+            topo.reset_topology()
+        return record
+
+    # ----------------------------------------------------------------- tune
+    def tune(self, max_trials: Optional[int] = None) -> Dict[str, Any]:
+        """Reference autotuner.py:404: run the experiment grid, write the
+        results table, return the best full config."""
+        metric = self.at_cfg.get("metric", "throughput")
+        trials = list(itertools.product(self._stage_candidates(),
+                                        self._micro_batch_candidates(),
+                                        self._mesh_candidates()))
+        max_trials = max_trials or int(self.at_cfg.get("tuner_num_trials", 50))
+        early_stop = int(self.at_cfg.get("tuner_early_stopping", 5))
+        best_metric, since_best = float("-inf"), 0
+        for stage, micro, mesh in trials[:max_trials]:
+            rec = self._run_candidate(stage, micro, mesh)
+            self.results.append(rec)
+            logger.info(f"autotune: {rec}")
+            score = self._score(rec, metric)
+            if score > best_metric:
+                best_metric, since_best = score, 0
+            else:
+                since_best += 1
+                if since_best >= early_stop:
+                    logger.info("autotune: early stop "
+                                f"({early_stop} trials without improvement)")
+                    break
+        self._write_results()
+        best = self.best(metric)
+        merged = dict(self.base)
+        merged["train_micro_batch_size_per_gpu"] = best["micro_batch"]
+        merged["zero_optimization"] = dict(
+            self.base.get("zero_optimization", {}), stage=best["zero_stage"])
+        merged["mesh"] = best["mesh"]
+        return merged
+
+    @staticmethod
+    def _score(rec: Dict[str, Any], metric: str) -> float:
+        if rec["status"] != "ok":
+            return float("-inf")
+        if metric == "latency":
+            return -rec.get("step_time_s", float("inf"))
+        return rec["tokens_per_sec"]
+
+    def best(self, metric: Optional[str] = None) -> Dict[str, Any]:
+        metric = metric or self.at_cfg.get("metric", "throughput")
+        ok = [r for r in self.results if r["status"] == "ok"]
+        if not ok:
+            raise RuntimeError("autotuning: no candidate ran successfully")
+        return max(ok, key=lambda r: self._score(r, metric))
+
+    def _write_results(self):
+        out_dir = self.at_cfg.get("results_dir", "autotuning_results")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, "autotuning_results.json")
+        with open(path, "w") as fh:
+            json.dump({"model_info": self.model_info_profile_run(),
+                       "experiments": self.results}, fh, indent=2)
+        logger.info(f"autotune: wrote {len(self.results)} experiments → {path}")
+
+
+def autotune(model, base_config: Dict[str, Any],
+             seq_len: Optional[int] = None, **kw) -> Dict[str, Any]:
+    """One-call tuning: returns the base config with the best
+    (stage, micro_batch, mesh) substituted."""
+    return Autotuner(model, base_config, seq_len=seq_len).tune(**kw)

@@ -120,9 +120,46 @@ def main(argv=None):
     parser.add_argument("--launcher", type=str, default="ssh",
                         choices=["ssh", "pdsh", "local"])
     parser.add_argument("--force_multi", action="store_true")
-    parser.add_argument("user_script", type=str)
+    parser.add_argument("--autotune", "--autotuning", type=str, default=None,
+                        metavar="MODEL:CONFIG.json",
+                        help="run the autotuner (autotuning/autotuner.py) for "
+                             "MODEL (registered name) with the given base "
+                             "config instead of launching a script; prints "
+                             "the best config JSON")
+    parser.add_argument("user_script", type=str, nargs="?")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.autotune:
+        # reference runner.py:360 run_autotuning entry. Tuning runs
+        # IN-PROCESS on this host's devices — reject multi-node flags and a
+        # user_script rather than silently ignoring them.
+        conflicting = []
+        if args.hostfile != DLTS_HOSTFILE:
+            conflicting.append("--hostfile")
+        if args.include or args.exclude:
+            conflicting.append("--include/--exclude")
+        if args.user_script:
+            conflicting.append("user_script")
+        if conflicting:
+            parser.error(f"--autotune tunes on this host's devices and is "
+                         f"incompatible with {', '.join(conflicting)}; run it "
+                         "on the target hardware without a script")
+        import json as _json
+
+        from ..autotuning import autotune
+        from ..models import build_model
+
+        model_name, _, cfg_path = args.autotune.partition(":")
+        base = {}
+        if cfg_path:
+            with open(cfg_path) as fh:
+                base = _json.load(fh)
+        best = autotune(build_model(model_name), base)
+        print(_json.dumps(best, indent=2))
+        return
+    if args.user_script is None:
+        parser.error("user_script is required (or pass --autotune)")
 
     info = _env_rank_info()
     if info is not None:

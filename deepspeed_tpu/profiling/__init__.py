@@ -1,0 +1,2 @@
+from .flops_profiler import (FlopsProfiler, get_model_profile,  # noqa: F401
+                             model_flops_breakdown, train_step_flops)

@@ -1,0 +1,220 @@
+"""Flops profiler — per-module FLOPs/params tree + compiled-program counts.
+
+Counterpart of reference ``profiling/flops_profiler/profiler.py``
+(``FlopsProfiler`` :28, ``print_model_profile`` :282, ``get_model_profile``
+:848): the torch version monkey-patches ``torch.nn.functional`` to count
+MACs at runtime. Under XLA both better sources exist statically:
+
+- **analytic model FLOPs** from the TransformerConfig (the 6ND counting
+  plus the attention quadratic term and optional remat recompute factor)
+  — the "model FLOPs" MFU should be measured against;
+- **compiled-program FLOPs** from XLA's own ``compiled.cost_analysis()``
+  — what the hardware actually executes (includes rematerialized
+  recompute, fused elementwise, collectives' math).
+
+`FlopsProfiler.profile_engine` prints the reference-style tree with both,
+plus the per-phase wall-clock breakdown from the engine's timer set, and
+achieved-vs-peak TFLOPS. Wired to ``flops_profiler.enabled`` /
+``profile_step`` in the config (consumed in engine.train_batch).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+# ------------------------------------------------------------- analytic side
+
+def _linear_flops(tokens: int, d_in: int, d_out: int) -> int:
+    return 2 * tokens * d_in * d_out
+
+
+def model_flops_breakdown(cfg, batch_size: int, seq_len: int) -> Dict[str, Any]:
+    """Per-module forward-FLOPs/params tree for a CausalLM config
+    (reference print_model_profile's tree, computed analytically)."""
+    T = batch_size * seq_len
+    h, m, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    nh, kvh, hd, L = cfg.num_heads, cfg.kv_heads, cfg.head_dim, cfg.num_layers
+
+    attn_proj = (_linear_flops(T, h, nh * hd) + 2 * _linear_flops(T, h, kvh * hd)
+                 + _linear_flops(T, nh * hd, h))
+    # scores QK^T + PV: 2 matmuls of [T, S] x heads
+    attn_core = 2 * 2 * batch_size * seq_len * seq_len * nh * hd
+    n_mats = 3 if cfg.activation == "silu" else 2
+    E = cfg.moe_num_experts
+    mlp = n_mats * _linear_flops(T, h, m)
+    mlp_params = n_mats * h * m
+    if E > 0:
+        # top-k routing sends each token through k experts + the router
+        mlp = mlp * cfg.moe_top_k + _linear_flops(T, h, E)
+        mlp_params = mlp_params * E + h * E         # experts + router table
+    norms = 2 * 5 * T * h          # rmsnorm ~5 ops/elem, 2 per layer
+    layer = {
+        "attention": {"flops": attn_proj + attn_core,
+                      "params": h * nh * hd + 2 * h * kvh * hd + nh * hd * h,
+                      "children": {
+                          "qkv_o_proj": {"flops": attn_proj},
+                          "sdpa": {"flops": attn_core}}},
+        "mlp": {"flops": mlp, "params": mlp_params},
+        "norms": {"flops": norms,
+                  "params": 2 * h if cfg.norm == "rmsnorm" else 4 * h},
+    }
+    layer_flops = sum(c["flops"] for c in layer.values())
+    layer_params = sum(c.get("params", 0) for c in layer.values())
+    unembed = _linear_flops(T, h, v)
+    tree = {
+        "embed": {"flops": 0, "params": v * h
+                  + (cfg.max_seq_len * h if cfg.position == "learned" else 0)},
+        "layers": {"flops": L * layer_flops, "params": L * layer_params,
+                   "children": {"layer (x%d)" % L: {"flops": layer_flops,
+                                                    "children": layer}}},
+        "final_norm": {"flops": 5 * T * h, "params": h},
+        # (matches CausalLM.num_params — linear/final-norm biases are
+        # excluded there too)
+        "lm_head": {"flops": unembed,
+                    "params": 0 if cfg.tie_embeddings else h * v},
+    }
+    fwd = sum(n["flops"] for n in tree.values())
+    params = sum(n["params"] for n in tree.values())
+    return {"tree": tree, "fwd_flops": fwd, "params": params,
+            "batch_size": batch_size, "seq_len": seq_len}
+
+
+def train_step_flops(cfg, batch_size: int, seq_len: int,
+                     remat: Optional[bool] = None) -> int:
+    """Model FLOPs of one fwd+bwd step: 3× forward, +1× when remat
+    recomputes the forward (the 6ND/8ND counting with the attention term)."""
+    prof = model_flops_breakdown(cfg, batch_size, seq_len)
+    remat = cfg.remat if remat is None else remat
+    return prof["fwd_flops"] * (4 if remat else 3)
+
+
+def get_model_profile(model, batch_size: int = 1, seq_len: int = 128,
+                      as_string: bool = False):
+    """Reference get_model_profile parity: (flops, macs, params) of one
+    forward."""
+    prof = model_flops_breakdown(model.cfg, batch_size, seq_len)
+    flops, params = prof["fwd_flops"], prof["params"]
+    macs = flops // 2
+    if as_string:
+        return (_num(flops), _num(macs), _num(params))
+    return flops, macs, params
+
+
+def _num(n: float) -> str:
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000:
+            return f"{n:.2f} {unit}"
+        n /= 1000
+    return f"{n:.2f} E"
+
+
+# ------------------------------------------------------------- compiled side
+
+def compiled_flops(jitted, *args) -> Optional[float]:
+    """FLOPs XLA reports for the compiled program (None if unavailable)."""
+    try:
+        compiled = jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+# ------------------------------------------------------------------ profiler
+
+class FlopsProfiler:
+    """Engine-level profiler: reference FlopsProfiler surface
+    (start_profile/stop_profile/print_model_profile) over the two static
+    FLOPs sources plus the engine's wall-clock timers."""
+
+    def __init__(self, engine=None, model=None):
+        self.engine = engine
+        self.model = model or (engine.module if engine is not None else None)
+        self._t0 = None
+        self.step_time = None
+
+    def start_profile(self):
+        self._t0 = time.perf_counter()
+
+    def stop_profile(self):
+        if self._t0 is not None:
+            self.step_time = time.perf_counter() - self._t0
+            self._t0 = None
+
+    # -- report -------------------------------------------------------------
+    def profile_report(self, batch_size: int, seq_len: int,
+                       step_time: Optional[float] = None,
+                       peak_flops: Optional[float] = None) -> str:
+        cfg = self.model.cfg
+        prof = model_flops_breakdown(cfg, batch_size, seq_len)
+        step = train_step_flops(cfg, batch_size, seq_len)
+        lines = [
+            "-" * 72,
+            "Flops profiler (deepspeed_tpu; reference "
+            "profiling/flops_profiler/profiler.py)",
+            f"params:                {_num(prof['params'])}",
+            f"fwd flops:             {_num(prof['fwd_flops'])}",
+            f"train step flops:      {_num(step)} "
+            f"({'4x' if cfg.remat else '3x'} fwd)",
+        ]
+        xla = None
+        detailed = (self.engine is None
+                    or self.engine.config.flops_profiler.detailed)
+        if self.engine is not None and detailed:
+            # lower().compile() bypasses the jit executable cache — a full
+            # recompile of the micro program. Cache the number on the engine
+            # (one extra compile, ever) and let detailed=False skip it for
+            # models where a second compile is too expensive.
+            xla = getattr(self.engine, "_profiled_xla_flops", None)
+            if xla is None:
+                try:
+                    rng = np.random.default_rng(0)
+                    dp = self.engine.topology.get_data_parallel_world_size()
+                    micro = self.engine.train_micro_batch_size_per_gpu()
+                    batch = {"input_ids": jax.numpy.asarray(rng.integers(
+                        0, cfg.vocab_size, size=(micro * dp, seq_len + 1)))}
+                    xla = compiled_flops(self.engine._micro_fn,
+                                         self.engine.state, batch,
+                                         jax.random.PRNGKey(0))
+                    self.engine._profiled_xla_flops = xla
+                except Exception:
+                    xla = None
+        if xla:
+            lines.append(f"XLA compiled flops:    {_num(xla)} (micro program, "
+                         "incl. remat/fusions)")
+        st = step_time or self.step_time
+        if st:
+            achieved = step / st
+            lines.append(f"step time:             {st * 1e3:.2f} ms")
+            lines.append(f"achieved model TFLOPS: {achieved / 1e12:.2f}")
+            if peak_flops:
+                lines.append(f"MFU vs peak:           {achieved / peak_flops:.2%}")
+        lines.append("-" * 72)
+        lines.append("per-module forward breakdown:")
+        lines.extend(self._tree_lines(prof["tree"], prof["fwd_flops"]))
+        lines.append("-" * 72)
+        return "\n".join(lines)
+
+    def _tree_lines(self, tree: Dict[str, Any], total: int,
+                    indent: int = 1) -> List[str]:
+        out = []
+        for name, node in tree.items():
+            f = node.get("flops", 0)
+            p = node.get("params", 0)
+            out.append("  " * indent
+                       + f"{name}: {_num(f)} flops ({f / max(total, 1):.1%})"
+                       + (f", {_num(p)} params" if p else ""))
+            if "children" in node:
+                out.extend(self._tree_lines(node["children"], total,
+                                            indent + 1))
+        return out
+
+    def print_model_profile(self, batch_size: int, seq_len: int, **kw):
+        print(self.profile_report(batch_size, seq_len, **kw))

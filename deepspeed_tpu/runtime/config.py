@@ -194,7 +194,9 @@ class CSVConfig(DSConfigModel):
 class FlopsProfilerConfig(DSConfigModel):
     enabled: bool = False
     recompute_fwd_factor: float = 0.0
-    profile_step: int = 1
+    # reference default is 1; here step 1 pays the XLA compile, which would
+    # make the timed achieved-TFLOPS meaningless, so default past warmup
+    profile_step: int = 3
     module_depth: int = -1
     top_modules: int = 1
     detailed: bool = True

@@ -665,12 +665,39 @@ class DeepSpeedTpuEngine:
                     loader = RepeatingLoader(loader)
                 self._data_iter = iter(loader)
             it = self._data_iter
+        fp = self.config.flops_profiler
+        profiling = (fp.enabled and isinstance(self.module, CausalLM)
+                     and self.global_steps + 1 == fp.profile_step)
+        if profiling:
+            if self.global_steps == 0:
+                logger.warning("flops_profiler.profile_step=1 times the "
+                               "first step, which includes XLA compilation")
+            self._sync()
+            t0 = time.perf_counter()
         losses = []
+        seq_len = None
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(it)
+            if profiling and seq_len is None and isinstance(batch, dict):
+                seq_len = int(np.asarray(batch["input_ids"]).shape[-1]) - 1
             losses.append(self.forward(batch))
             self.backward()
         self.step()
+        if profiling:
+            self._sync()
+            dt = time.perf_counter() - t0
+            from ..profiling import FlopsProfiler
+
+            prof = FlopsProfiler(engine=self)
+            report = prof.profile_report(
+                batch_size=self.train_batch_size(),
+                seq_len=seq_len or self.module.cfg.max_seq_len,
+                step_time=dt)
+            if fp.output_file:
+                with open(fp.output_file, "w") as fh:
+                    fh.write(report)
+            else:
+                print(report)
         return jnp.mean(jnp.stack(losses))
 
     def eval_batch(self, batch):

@@ -1,0 +1,135 @@
+"""Flops profiler + autotuner.
+
+Mirrors reference tests/unit/profiling/flops_profiler/test_flops_profiler.py
+(counted flops sanity vs analytic expectation) and
+tests/unit/autotuning/test_autotuning.py (experiment generation/selection)."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.models.transformer import TINY_TEST, CausalLM
+from deepspeed_tpu.parallel import topology as topo
+from deepspeed_tpu.profiling import (FlopsProfiler, get_model_profile,
+                                     model_flops_breakdown, train_step_flops)
+
+
+# ------------------------------------------------------------ flops profiler
+
+def test_breakdown_matches_hand_count():
+    cfg = TINY_TEST          # h=64, m=128, L=2, nh=4, kvh=2, v=256, silu
+    B, T = 2, 16
+    prof = model_flops_breakdown(cfg, B, T)
+    tok = B * T
+    h, m, v = 64, 128, 256
+    attn_proj = 2 * tok * (h * 64 + 2 * h * 32 + 64 * h)
+    attn_core = 4 * B * T * T * 64
+    mlp = 3 * 2 * tok * h * m
+    norms = 10 * tok * h
+    per_layer = attn_proj + attn_core + mlp + norms
+    expect = 2 * per_layer + 5 * tok * h + 2 * tok * h * v
+    assert prof["fwd_flops"] == expect
+    # params: wte + layers + final_norm (tied embeddings)
+    assert prof["params"] == cfg.num_params()
+
+
+def test_breakdown_params_parity_moe_and_layernorm():
+    moe = dataclasses.replace(TINY_TEST, moe_num_experts=4, num_kv_heads=2)
+    gpt2 = dataclasses.replace(TINY_TEST, norm="layernorm", activation="gelu",
+                               position="learned", use_bias=True)
+    for cfg in (moe, gpt2, TINY_TEST):
+        prof = model_flops_breakdown(cfg, 2, 16)
+        assert prof["params"] == cfg.num_params()
+
+
+def test_train_step_flops_remat_factor():
+    cfg = TINY_TEST
+    no_remat = train_step_flops(cfg, 2, 16, remat=False)
+    remat = train_step_flops(cfg, 2, 16, remat=True)
+    assert remat == no_remat // 3 * 4
+
+
+def test_get_model_profile_parity_surface():
+    model = build_model("tiny")
+    flops, macs, params = get_model_profile(model, batch_size=1, seq_len=32)
+    assert flops == 2 * macs and params > 0
+    s = get_model_profile(model, 1, 32, as_string=True)
+    assert all(isinstance(x, str) for x in s)
+
+
+def test_engine_profile_report(capsys):
+    topo.reset_topology()
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "flops_profiler": {"enabled": True, "profile_step": 2},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=config)
+    rng = np.random.default_rng(0)
+    dp = engine.topology.get_data_parallel_world_size()
+    batch = {"input_ids": rng.integers(0, 256, size=(2 * dp, 33),
+                                       dtype=np.int64)}
+    import itertools
+
+    it = itertools.repeat(batch)
+    engine.train_batch(it)
+    engine.train_batch(it)   # profile_step=2 → report printed here
+    out = capsys.readouterr().out
+    assert "Flops profiler" in out
+    assert "achieved model TFLOPS" in out
+    assert "XLA compiled flops" in out
+    assert "attention" in out
+    topo.reset_topology()
+
+
+def test_report_mfu_consistency():
+    """Profiler's achieved TFLOPS must equal step_flops/step_time — the
+    same formula bench.py's MFU uses (agreement by construction)."""
+    model = build_model("tiny")
+    prof = FlopsProfiler(model=model)
+    report = prof.profile_report(batch_size=4, seq_len=32, step_time=0.1,
+                                 peak_flops=1e12)
+    step = train_step_flops(model.cfg, 4, 32)
+    assert f"{step / 0.1 / 1e12:.2f}" in report
+    assert f"{step / 0.1 / 1e12:.2%}" in report
+
+
+# ----------------------------------------------------------------- autotuner
+
+def test_autotuner_selects_best_and_writes_table(tmp_path):
+    base = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True, "results_dir": str(tmp_path),
+                       "num_tuning_micro_batch_sizes": 2,
+                       "start_profile_step": 1, "end_profile_step": 2},
+        "zero_optimization": {"stage": 2},   # constrain the stage axis
+    }
+    tuner = Autotuner(build_model("tiny"), base, seq_len=32)
+    best_cfg = tuner.tune(max_trials=6)
+    ok = [r for r in tuner.results if r["status"] == "ok"]
+    assert len(ok) >= 2
+    best = tuner.best()
+    assert best["tokens_per_sec"] == max(r["tokens_per_sec"] for r in ok)
+    assert best_cfg["train_micro_batch_size_per_gpu"] == best["micro_batch"]
+    assert best_cfg["zero_optimization"]["stage"] == 2
+    table = json.load(open(tmp_path / "autotuning_results.json"))
+    assert table["model_info"]["num_params"] > 0
+    assert len(table["experiments"]) == len(tuner.results)
+    topo.reset_topology()
+
+
+def test_autotuner_model_info():
+    info = Autotuner(build_model("tiny"), {}).model_info_profile_run()
+    assert info["num_params"] == TINY_TEST.num_params()
+    assert info["activation_bytes_per_token"] > 0
