@@ -1,0 +1,358 @@
+"""Block-sparse self-attention (training) — the sparse-attention suite.
+
+Counterpart of reference ``ops/sparse_attention/`` — ``sparsity_config.py``
+(Dense/Fixed/Variable/BigBird/BSLongformer layout builders, 727 LoC),
+``sparse_self_attention.py``, and the triton ``matmul.py``/``softmax.py``
+block-sparse kernels. The layouts are head × block-row × block-col boolean
+matrices with identical semantics to the reference (local windows, global
+representative blocks, sliding windows, random blocks).
+
+TPU-native compute: instead of triton SDD/DSD kernels, each query block
+gathers only its admitted KV blocks (the layout is static, so the gather
+indices are compile-time constants padded to the densest row) and runs a
+dense softmax-attention over that packed [L_max · block] context — XLA maps
+the batched per-block matmuls onto the MXU, and FLOPs/memory scale with the
+layout density rather than T². Rows are padded to ``L_max`` so shapes stay
+static under jit; the pad fraction is bounded by the densest row (for the
+shipped patterns global rows dominate, L_max ≈ window + globals + randoms).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------- layouts
+class SparsityConfig:
+    """Base layout builder (reference sparsity_config.py:10)."""
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+        self.num_layout_heads = num_heads if different_layout_per_head else 1
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block:
+            raise ValueError(f"seq_len {seq_len} must be divisible by "
+                             f"block {self.block}")
+        nb = seq_len // self.block
+        return np.zeros((self.num_heads, nb, nb), dtype=bool)
+
+    def _propagate_first_head(self, layout: np.ndarray) -> np.ndarray:
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks admitted (reference :63) — the parity/testing baseline."""
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[:] = True
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Local windows + fixed global representative blocks (reference :95)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_local_blocks=4, num_global_blocks=1,
+                 attention="bidirectional", horizontal_global_attention=False,
+                 num_different_global_patterns=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if num_local_blocks % num_global_blocks:
+            raise ValueError("num_local_blocks must be divisible by "
+                             "num_global_blocks")
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError(attention)
+        if attention != "bidirectional" and horizontal_global_attention:
+            raise ValueError("horizontal global attention needs "
+                             "bidirectional attention")
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError("multiple global patterns need "
+                             "different_layout_per_head=True")
+        if num_different_global_patterns > num_local_blocks // num_global_blocks:
+            raise ValueError("num_different_global_patterns cannot exceed "
+                             "num_local_blocks // num_global_blocks")
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        for h in range(self.num_layout_heads):
+            # local windows
+            for lo in range(0, nb, self.num_local_blocks):
+                hi = min(lo + self.num_local_blocks, nb)
+                win = np.ones((hi - lo, hi - lo), dtype=bool)
+                if self.attention == "unidirectional":
+                    win = np.tril(win)
+                layout[h, lo:hi, lo:hi] |= win
+            # global representatives: last num_global_blocks of each window
+            # (shifted per head by the global-pattern index)
+            first = self.num_local_blocks - (
+                1 + h % self.num_different_global_patterns
+            ) * self.num_global_blocks
+            end = nb - nb % self.num_local_blocks
+            starts = list(range(first, end, self.num_local_blocks))
+            if end < nb:   # short trailing window
+                starts.append(min(end + first, nb - self.num_global_blocks))
+            for s in starts:
+                cols = slice(s, s + self.num_global_blocks)
+                first_row = 0 if self.attention == "bidirectional" else s
+                layout[h, first_row:, cols] = True
+                if self.horizontal_global_attention:
+                    layout[h, cols, :] = True
+        return self._propagate_first_head(layout)
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Random + variable-size local windows + global blocks (reference :239)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=0, local_window_blocks=(4,),
+                 global_block_indices=(0,), global_block_end_indices=None,
+                 attention="bidirectional", horizontal_global_attention=False,
+                 seed=0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError(attention)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = list(local_window_blocks)
+        self.global_block_indices = list(global_block_indices)
+        self.global_block_end_indices = (
+            list(global_block_end_indices)
+            if global_block_end_indices is not None else None)
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.seed = seed    # reference uses the global `random` module;
+        #                     a seed keeps layouts reproducible across hosts
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        rng = np.random.default_rng(self.seed)
+        for h in range(self.num_layout_heads):
+            # random blocks per row
+            for row in range(nb):
+                top = nb if self.attention == "bidirectional" else row + 1
+                k = min(self.num_random_blocks, top)
+                if k:
+                    cols = rng.choice(top, size=k, replace=False)
+                    layout[h, row, cols] = True
+            # variable local windows: sizes cycle through the list, last
+            # size repeats (reference set_local_layout)
+            lo = 0
+            i = 0
+            while lo < nb:
+                size = self.local_window_blocks[
+                    min(i, len(self.local_window_blocks) - 1)]
+                hi = min(lo + size, nb)
+                win = np.ones((hi - lo, hi - lo), dtype=bool)
+                if self.attention == "unidirectional":
+                    win = np.tril(win)
+                layout[h, lo:hi, lo:hi] |= win
+                lo, i = hi, i + 1
+            # global blocks
+            for gi, start in enumerate(self.global_block_indices):
+                if start >= nb:
+                    continue
+                end = (self.global_block_end_indices[gi]
+                       if self.global_block_end_indices else start + 1)
+                end = min(end, nb)
+                first_row = 0 if self.attention == "bidirectional" else start
+                layout[h, first_row:, start:end] = True
+                if self.horizontal_global_attention:
+                    layout[h, start:end, :] = True
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self._propagate_first_head(layout)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """Random + sliding window + global ITC blocks (reference :411)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=1, num_sliding_window_blocks=3,
+                 num_global_blocks=1, attention="bidirectional", seed=0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError(attention)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.seed = seed
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        for name, need in (("random", self.num_random_blocks),
+                           ("window", self.num_sliding_window_blocks),
+                           ("global", self.num_global_blocks)):
+            if nb < need:
+                raise ValueError(f"{name} blocks {need} > num blocks {nb}")
+        rng = np.random.default_rng(self.seed)
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_layout_heads):
+            for row in range(nb):
+                top = nb if self.attention == "bidirectional" else row + 1
+                cols = rng.choice(top, size=min(self.num_random_blocks, top),
+                                  replace=False)
+                layout[h, row, cols] = True
+                layout[h, row, max(0, row - w):min(row + w + 1, nb)] = True
+            layout[h, :self.num_global_blocks, :] = True
+            layout[h, :, :self.num_global_blocks] = True
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self._propagate_first_head(layout)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Block-sparse Longformer: sliding window + global indices (ref :546)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_sliding_window_blocks=3, global_block_indices=(0,),
+                 global_block_end_indices=None, attention="bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = list(global_block_indices)
+        if global_block_end_indices is not None:
+            if len(global_block_indices) != len(global_block_end_indices):
+                raise ValueError("global start/end index lists must match")
+            for s, e in zip(global_block_indices, global_block_end_indices):
+                if s >= e:
+                    raise ValueError(f"global start {s} >= end {e}")
+        self.global_block_end_indices = (
+            list(global_block_end_indices)
+            if global_block_end_indices is not None else None)
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        w = self.num_sliding_window_blocks // 2
+        for h in range(self.num_layout_heads):
+            for row in range(nb):
+                layout[h, row, max(0, row - w):min(row + w + 1, nb)] = True
+            for gi, start in enumerate(self.global_block_indices):
+                if start >= nb:
+                    continue
+                end = (min(self.global_block_end_indices[gi], nb)
+                       if self.global_block_end_indices else start + 1)
+                layout[h, :, start:end] = True
+                layout[h, start:end, :] = True
+        if self.attention == "unidirectional":
+            layout = np.tril(layout)
+        return self._propagate_first_head(layout)
+
+
+# ---------------------------------------------------------------- compute
+def _pack_layout(layout: np.ndarray):
+    """Static gather plan: per (head, q-block) the admitted kv-block
+    indices padded to the densest row. Returns (col_idx [H,nb,L], valid
+    [H,nb,L])."""
+    H, nb, _ = layout.shape
+    counts = layout.sum(-1)
+    L = max(1, int(counts.max()))
+    col_idx = np.zeros((H, nb, L), dtype=np.int32)
+    valid = np.zeros((H, nb, L), dtype=bool)
+    for h in range(H):
+        for i in range(nb):
+            cols = np.nonzero(layout[h, i])[0]
+            col_idx[h, i, :cols.size] = cols
+            valid[h, i, :cols.size] = True
+    return col_idx, valid
+
+
+def sparse_attention(q, k, v, layout: np.ndarray, block: int,
+                     causal: bool = False, key_padding_mask=None,
+                     scale: Optional[float] = None):
+    """Block-sparse attention over a static layout.
+
+    q/k/v: [B, H, T, D]; layout: bool [H, T//block, T//block];
+    key_padding_mask: optional bool [B, T] (True = keep). Returns
+    [B, H, T, D]. FLOPs ∝ layout density (the reference's SDD/softmax/DSD
+    triton pipeline collapsed into one gathered dense attention)."""
+    B, H, T, D = q.shape
+    nb = T // block
+    if layout.shape != (H, nb, nb):
+        raise ValueError(f"layout {layout.shape} != {(H, nb, nb)}")
+    col_idx_np, valid_np = _pack_layout(layout)
+    col_idx = jnp.asarray(col_idx_np)
+    valid = jnp.asarray(valid_np)
+    L = col_idx.shape[-1]
+    scale = scale if scale is not None else 1.0 / float(np.sqrt(D))
+
+    qb = q.reshape(B, H, nb, block, D)
+    kb = k.reshape(B, H, nb, block, D)
+    vb = v.reshape(B, H, nb, block, D)
+    heads = jnp.arange(H)[:, None, None]
+    kg = kb[:, heads, col_idx]            # [B, H, nb, L, block, D]
+    vg = vb[:, heads, col_idx]
+
+    scores = jnp.einsum("bhipd,bhilqd->bhiplq", qb, kg) * scale
+
+    mask = valid[None, :, :, None, :, None]            # [1,H,nb,1,L,1]
+    if causal:
+        q_pos = (jnp.arange(nb)[:, None] * block
+                 + jnp.arange(block)[None, :])          # [nb, block]
+        k_pos = (col_idx[..., None] * block
+                 + jnp.arange(block))                   # [H, nb, L, block]
+        causal_ok = (q_pos[None, :, :, None, None]
+                     >= k_pos[:, :, None, :, :])        # [H,nb,block,L,block]
+        mask = mask & causal_ok[None]
+    if key_padding_mask is not None:
+        kp = key_padding_mask.reshape(B, nb, block)     # [B, nb, block]
+        kp_g = kp[:, col_idx]                           # [B, H, nb, L, block]
+        mask = mask & kp_g[:, :, :, None, :, :]
+
+    scores = jnp.where(mask, scores, -1e30)
+    flat = scores.reshape(B, H, nb, block, L * block)
+    probs = jax.nn.softmax(flat, axis=-1).reshape(scores.shape)
+    # rows with no admitted keys (fully masked) produce uniform junk —
+    # zero them instead
+    any_valid = mask.any(axis=(-2, -1), keepdims=True)
+    probs = jnp.where(any_valid, probs, 0.0)
+    out = jnp.einsum("bhiplq,bhilqd->bhipd", probs, vg)
+    return out.reshape(B, H, T, D)
+
+
+class SparseSelfAttention:
+    """API-parity wrapper (reference sparse_self_attention.py): holds a
+    sparsity config, builds/caches the layout per sequence length."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value, key_padding_mask=None):
+        T = query.shape[-2]
+        layout = self.get_layout(T)
+        causal = getattr(self.sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+        return sparse_attention(query, key, value, layout,
+                                self.sparsity_config.block, causal=causal,
+                                key_padding_mask=key_padding_mask)

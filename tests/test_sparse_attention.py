@@ -1,0 +1,160 @@
+"""Sparse attention tests (reference tests/unit/ops/sparse_attention/):
+layout-builder semantics per pattern, dense-layout parity with exact
+attention, causal masking, padding masks, and gradient flow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparseSelfAttention, VariableSparsityConfig,
+    sparse_attention)
+
+
+def dense_attention(q, k, v, causal=False, key_padding_mask=None):
+    D = q.shape[-1]
+    scores = jnp.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+    T = q.shape[2]
+    if causal:
+        scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores, -1e30)
+    if key_padding_mask is not None:
+        scores = jnp.where(key_padding_mask[:, None, None, :], scores, -1e30)
+    return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(scores, -1), v)
+
+
+def rand_qkv(B=2, H=4, T=64, D=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+# ----------------------------------------------------------------- layouts
+def test_fixed_layout_local_and_global():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(16 * 8)
+    assert layout.shape == (2, 8, 8)
+    # local window: block rows 0-3 see each other
+    assert layout[0, :4, :4].all()
+    assert not layout[0, 0, 5]          # outside window, not global
+    # global: last block of each window is a column everyone sees
+    assert layout[0, :, 3].all() and layout[0, :, 7].all()
+
+
+def test_fixed_layout_unidirectional_is_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(16 * 8)
+    assert not np.triu(layout[0], k=1).any()
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    layout = cfg.make_layout(16 * 8)
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()   # global ITC
+    for row in range(1, 7):                                  # sliding window
+        assert layout[0, row, row - 1:row + 2].all()
+    assert layout[0].sum(-1).min() >= 3                      # + randoms
+
+
+def test_longformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 5])
+    layout = cfg.make_layout(16 * 8)
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()
+    assert layout[0, :, 5].all() and layout[0, 5, :].all()
+
+
+def test_variable_layout_windows_and_random():
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                 local_window_blocks=[2, 4],
+                                 global_block_indices=[0])
+    layout = cfg.make_layout(16 * 8)
+    assert layout[0, :2, :2].all()       # first window (2 blocks)
+    assert layout[0, 2:6, 2:6].all()     # second window (4 blocks)
+    assert layout[0, :, 0].all()         # global column
+
+
+def test_different_layout_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = cfg.make_layout(16 * 8)
+    assert not np.array_equal(layout[0], layout[1])
+    same = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4)
+    layout2 = same.make_layout(16 * 8)
+    assert np.array_equal(layout2[0], layout2[3])
+
+
+# ----------------------------------------------------------------- compute
+@pytest.mark.parametrize("causal", [False, True])
+def test_dense_layout_matches_exact_attention(causal):
+    q, k, v = rand_qkv()
+    layout = DenseSparsityConfig(num_heads=4, block=16).make_layout(64)
+    if causal:
+        layout = np.tril(layout)
+    out = sparse_attention(q, k, v, layout, block=16, causal=causal)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_key_padding_mask():
+    q, k, v = rand_qkv()
+    mask = np.ones((2, 64), dtype=bool)
+    mask[:, 48:] = False
+    layout = DenseSparsityConfig(num_heads=4, block=16).make_layout(64)
+    out = sparse_attention(q, k, v, layout, block=16,
+                           key_padding_mask=jnp.asarray(mask))
+    ref = dense_attention(q, k, v, key_padding_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_sparse_pattern_restricts_attention():
+    """A token outside every admitted block must receive zero weight: move
+    one value vector and verify out-of-window queries don't change."""
+    q, k, v = rand_qkv(T=128)
+    cfg = BSLongformerSparsityConfig(num_heads=4, block=16,
+                                     num_sliding_window_blocks=1,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(128)
+    out1 = sparse_attention(q, k, v, layout, block=16)
+    # perturb v in block 5; queries in block 2 (window = self only,
+    # globals = block 0) must be unaffected
+    v2 = v.at[:, :, 80:96, :].add(100.0)
+    out2 = sparse_attention(q, k, v2, layout, block=16)
+    np.testing.assert_allclose(np.asarray(out1)[:, :, 32:48],
+                               np.asarray(out2)[:, :, 32:48], rtol=1e-5)
+    assert not np.allclose(np.asarray(out1)[:, :, 80:96],
+                           np.asarray(out2)[:, :, 80:96])
+
+
+def test_gradients_flow():
+    q, k, v = rand_qkv(T=64)
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                              attention="unidirectional")
+    layout = cfg.make_layout(64)
+
+    def loss(q, k, v):
+        return sparse_attention(q, k, v, layout, block=16, causal=True).sum()
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(gq)).sum() > 0
+
+
+def test_sparse_self_attention_wrapper():
+    q, k, v = rand_qkv()
+    att = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                            attention="unidirectional"))
+    out = att(q, k, v)
+    assert out.shape == q.shape
+    assert 64 in att._layouts
