@@ -46,6 +46,11 @@ def initialize(args=None,
     if op is not None and str(op.device.value) in ("cpu", "nvme"):
         from .runtime.zero_infinity import ZeroInfinityEngine
 
+        if cfg_obj.hybrid_engine.enabled:
+            raise ValueError(
+                "hybrid_engine is not supported with offload_param "
+                "(ZeRO-Infinity streaming owns the parameter lifecycle)")
+
         unsupported = {"optimizer": optimizer, "training_data": training_data,
                        "lr_scheduler": lr_scheduler,
                        "model_parameters": model_parameters}
@@ -67,16 +72,24 @@ def initialize(args=None,
         engine = ZeroInfinityEngine(model, cfg_obj, rng=rng)
         return engine, None, None, None
 
-    engine = DeepSpeedTpuEngine(args=args,
-                                model=model,
-                                optimizer=optimizer,
-                                model_parameters=model_parameters,
-                                training_data=training_data,
-                                lr_scheduler=lr_scheduler,
-                                mesh=mesh,
-                                collate_fn=collate_fn,
-                                config=config,
-                                rng=rng)
+    engine_cls = DeepSpeedTpuEngine
+    if cfg_obj.hybrid_engine.enabled:
+        # RLHF train↔generate engine (reference __init__.py:158 selects
+        # DeepSpeedHybridEngine the same way)
+        from .runtime.hybrid_engine import DeepSpeedTpuHybridEngine
+
+        engine_cls = DeepSpeedTpuHybridEngine
+
+    engine = engine_cls(args=args,
+                        model=model,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mesh=mesh,
+                        collate_fn=collate_fn,
+                        config=config,
+                        rng=rng)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

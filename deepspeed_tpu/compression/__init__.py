@@ -1,0 +1,17 @@
+"""Compression suite (reference ``deepspeed/compression/``): QAT,
+sparse/row/head/channel pruning, scheduler, post-training cleanup."""
+
+from .compress import (CompressionTransform, init_compression,
+                       redundancy_clean, student_initialization)
+from .basic_transforms import (channel_prune, head_prune, quantize_weight,
+                               row_prune, sparse_prune)
+from .utils import (asym_quantize, binary_quantize, quantize_activation,
+                    sym_quantize, ternary_quantize, topk_binarize)
+
+__all__ = [
+    "CompressionTransform", "init_compression", "redundancy_clean",
+    "student_initialization",
+    "quantize_weight", "sparse_prune", "row_prune", "head_prune",
+    "channel_prune", "sym_quantize", "asym_quantize", "ternary_quantize",
+    "binary_quantize", "topk_binarize", "quantize_activation",
+]

@@ -1,0 +1,89 @@
+"""Structured/unstructured pruning + weight QAT as pure weight transforms.
+
+Counterpart of reference ``compression/basic_layer.py`` (LinearLayer_Compress
+:121 — sparse/row/head/channel pruning masks + weight quantization inside
+``forward``). The torch version subclasses nn.Linear and mutates modules;
+the TPU-native form is a pure function per technique applied to the weight
+pytree inside the jitted step (masks are recomputed from the live fp32
+masters each application, exactly like the reference's per-forward
+``get_mask``; gradients reach the masters through the mask product and the
+quantizer STE).
+
+All transforms treat the trailing two axes as (in_features, out_features)
+and broadcast over leading axes — the stacked-layer [L, in, out] layout of
+models/transformer.py works unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .utils import quantizer_for, topk_binarize
+
+
+def quantize_weight(w, bits: int, mode: str = "symmetric",
+                    num_groups: int = 1):
+    """QAT fake-quant (reference LinearLayer_Compress weight_quantization)."""
+    return quantizer_for(bits, mode)(w, num_groups)
+
+
+def sparse_prune(w, ratio: float, method: str = "l1"):
+    """Unstructured magnitude pruning keeping the top (1-ratio) fraction
+    (reference sparse_pruning_enabled path; method 'topk' learns through
+    the STE, 'l1' is the plain magnitude mask)."""
+    keep = 1.0 - ratio
+    if method not in ("l1", "topk"):
+        raise ValueError(f"sparse pruning method {method!r} (want l1|topk)")
+    return topk_binarize(w, keep)
+
+
+def row_prune(w, ratio: float):
+    """Structured row pruning: zero the lowest-L1 input rows (reference
+    row_pruning; rows = axis -2)."""
+    norms = jnp.sum(jnp.abs(w), axis=-1, keepdims=True)       # [..., in, 1]
+    n_rows = w.shape[-2]
+    k = max(1, int(round((1.0 - ratio) * n_rows)))
+    thresh = jnp.sort(norms, axis=-2)[..., n_rows - k:n_rows - k + 1, :]
+    return w * (norms >= thresh).astype(w.dtype)
+
+
+def channel_prune(w, ratio: float):
+    """Structured output-channel pruning (reference channel_pruning;
+    channels = axis -1)."""
+    norms = jnp.sum(jnp.abs(w), axis=-2, keepdims=True)       # [..., 1, out]
+    n_ch = w.shape[-1]
+    k = max(1, int(round((1.0 - ratio) * n_ch)))
+    thresh = jnp.sort(norms, axis=-1)[..., :, n_ch - k:n_ch - k + 1]
+    return w * (norms >= thresh).astype(w.dtype)
+
+
+def head_prune(w, ratio: float, num_heads: int, axis: str = "in"):
+    """Attention-head pruning (reference head_pruning on the attention
+    output projection): group the chosen axis into heads, zero the
+    lowest-L1 heads."""
+    if axis not in ("in", "out"):
+        raise ValueError("head_prune axis must be 'in' or 'out'")
+    dim = -2 if axis == "in" else -1
+    size = w.shape[dim]
+    if size % num_heads:
+        raise ValueError(f"axis size {size} not divisible by "
+                         f"{num_heads} heads")
+    head_dim = size // num_heads
+    lead = w.shape[:-2]
+    if axis == "in":
+        g = w.reshape(*lead, num_heads, head_dim, w.shape[-1])
+        norms = jnp.sum(jnp.abs(g), axis=(-2, -1), keepdims=True)
+        head_axis = -3
+    else:
+        g = w.reshape(*lead, w.shape[-2], num_heads, head_dim)
+        norms = jnp.sum(jnp.abs(g), axis=(-3, -1), keepdims=True)
+        head_axis = -2
+    k = max(1, int(round((1.0 - ratio) * num_heads)))
+    sorted_norms = jnp.sort(norms, axis=head_axis)
+    idx = [slice(None)] * norms.ndim
+    idx[head_axis] = slice(num_heads - k, num_heads - k + 1)
+    thresh = sorted_norms[tuple(idx)]
+    mask = (norms >= thresh).astype(w.dtype)
+    return (g * mask).reshape(w.shape)

@@ -1,0 +1,180 @@
+"""Config-driven compression: QAT + pruning over the parameter pytree.
+
+Counterpart of reference ``compression/compress.py`` (``init_compression``
+:100, ``redundancy_clean`` :148) and ``compression/scheduler.py``
+(schedule offsets). The reference walks the nn.Module graph replacing
+layers with ``*_Compress`` subclasses; the TPU-native design compiles the
+techniques into ONE pure function ``transform(params, global_step) →
+params`` that the engine applies to the master weights inside the jitted
+micro step — QAT/pruning become part of the forward program, gradients
+reach the fp32 masters through the STE/mask, and nothing is mutated.
+
+Config surface (reference ``compression_training`` schema kept):
+
+    "compression_training": {
+      "weight_quantization": {
+        "shared_parameters": {"enabled": true, "schedule_offset": 0, ...},
+        "different_groups": {
+          "wq1": {"params": {"target_bits": 8, "quantization_period": 0},
+                   "modules": ["layers.*"]}}},
+      "sparse_pruning":  {"shared_parameters": {...}, "different_groups":
+          {"sp1": {"params": {"dense_ratio": 0.5}, "modules": ["..."]}}},
+      "row_pruning" / "head_pruning" / "channel_pruning": same shape
+    }
+
+``modules`` patterns are matched (fnmatch) against dotted pytree paths
+(e.g. ``layers.wq``); ``["*"]`` matches every ≥2-D leaf.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from .basic_transforms import (channel_prune, head_prune, quantize_weight,
+                               row_prune, sparse_prune)
+
+TECHNIQUES = ("weight_quantization", "sparse_pruning", "row_pruning",
+              "head_pruning", "channel_pruning")
+
+
+def _leaf_path(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def _matches(path: str, patterns: List[str]) -> bool:
+    return any(fnmatch.fnmatch(path, pat) or pat in path
+               for pat in patterns)
+
+
+class CompressionTransform:
+    """One compiled plan: leaf path → ordered list of (offset, fn)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config.get("compression_training", config) or {}
+        self.plans: List[Tuple[str, int, List[str], Callable]] = []
+        for technique in TECHNIQUES:
+            tc = self.config.get(technique)
+            if not tc:
+                continue
+            shared = tc.get("shared_parameters", {})
+            if not shared.get("enabled", False):
+                continue
+            offset = int(shared.get("schedule_offset", 0))
+            for gname, group in (tc.get("different_groups") or {}).items():
+                params = group.get("params", {})
+                modules = group.get("modules", ["*"])
+                fn = self._technique_fn(technique, params)
+                self.plans.append((technique, offset, modules, fn))
+                logger.info(f"compression: {technique}/{gname} offset="
+                            f"{offset} modules={modules}")
+
+    @staticmethod
+    def _technique_fn(technique: str, p: Dict[str, Any]) -> Callable:
+        if technique == "weight_quantization":
+            bits = int(p.get("target_bits", 8))
+            mode = p.get("quantization_type", "symmetric")
+            groups = int(p.get("quantize_groups", 1))
+            return lambda w: quantize_weight(w, bits, mode, groups)
+        if technique == "sparse_pruning":
+            ratio = 1.0 - float(p.get("dense_ratio", 0.5))
+            method = p.get("method", "l1")
+            return lambda w: sparse_prune(w, ratio, method)
+        if technique == "row_pruning":
+            ratio = 1.0 - float(p.get("dense_ratio", 0.5))
+            return lambda w: row_prune(w, ratio)
+        if technique == "channel_pruning":
+            ratio = 1.0 - float(p.get("dense_ratio", 0.5))
+            return lambda w: channel_prune(w, ratio)
+        if technique == "head_pruning":
+            ratio = 1.0 - float(p.get("dense_ratio", 0.5))
+            heads = int(p["num_heads"])
+            axis = p.get("axis", "in")
+            return lambda w: head_prune(w, ratio, heads, axis)
+        raise ValueError(technique)
+
+    def __bool__(self) -> bool:
+        return bool(self.plans)
+
+    def __call__(self, params, global_step):
+        """Apply every matching technique whose offset has passed; the
+        step gate is a traced jnp.where so one compiled program serves the
+        whole run (reference scheduler.py check_compress_schedule)."""
+        if not self.plans:
+            return params
+        flat = jax.tree_util.tree_flatten_with_path(params)
+        leaves, treedef = flat[0], flat[1]
+        out = []
+        for path, leaf in leaves:
+            name = _leaf_path(path)
+            new = leaf
+            if hasattr(leaf, "ndim") and leaf.ndim >= 2:
+                for technique, offset, modules, fn in self.plans:
+                    if _matches(name, modules):
+                        applied = fn(new)
+                        gate = jnp.asarray(global_step >= offset)
+                        new = jnp.where(gate, applied, new)
+            out.append(new)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def clean(self, params):
+        """Permanently bake the compression into the weights (reference
+        redundancy_clean :148 — post-training cleanup for export)."""
+        flat = jax.tree_util.tree_flatten_with_path(params)
+        leaves, treedef = flat[0], flat[1]
+        out = []
+        for path, leaf in leaves:
+            name = _leaf_path(path)
+            new = leaf
+            if hasattr(leaf, "ndim") and leaf.ndim >= 2:
+                for technique, _offset, modules, fn in self.plans:
+                    if _matches(name, modules):
+                        new = fn(new)
+            out.append(new)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def init_compression(engine_or_config, deepspeed_config: Optional[Dict] = None):
+    """Reference compress.py:100. Pass an engine (attaches the transform to
+    its step programs) or a config dict (returns the bare transform)."""
+    if deepspeed_config is None:
+        return CompressionTransform(engine_or_config)
+    transform = CompressionTransform(deepspeed_config)
+    engine = engine_or_config
+    engine.set_compression(transform)
+    return transform
+
+
+def redundancy_clean(params, deepspeed_config: Dict[str, Any]):
+    """Reference compress.py:148: apply the configured masks/quantization
+    permanently to a parameter pytree."""
+    return CompressionTransform(deepspeed_config).clean(params)
+
+
+def student_initialization(teacher_params, keep_layers: List[int],
+                           layers_key: str = "layers"):
+    """Layer-reduction distillation init (reference compression
+    ``layer_reduction`` / helper.py student_initialization): build a
+    shallower student by keeping the listed teacher layer indices. With the
+    stacked-layer layout ([L, ...] leaves under ``layers``) this is one
+    gather per leaf instead of a module-graph rewrite."""
+    idx = jnp.asarray(keep_layers, dtype=jnp.int32)
+
+    def take(leaf):
+        return jnp.take(leaf, idx, axis=0)
+
+    out = dict(teacher_params)
+    out[layers_key] = jax.tree.map(take, teacher_params[layers_key])
+    return out

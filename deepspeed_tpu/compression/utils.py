@@ -1,0 +1,118 @@
+"""Compression primitives: STE quantizers and magnitude binarizers.
+
+Counterpart of reference ``compression/utils.py`` (``SymQuantizer``,
+``AsymQuantizer``, ``TernaryQuantizer``, ``BinaryQuantizer``,
+``TopKBinarizer`` — torch autograd.Functions with straight-through
+backward). The TPU-native form is ``jax.custom_vjp`` functions: forward
+quantizes/masks, backward passes gradients straight through to the fp32
+master weights, so the whole QAT step stays inside one jitted program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(fwd_fn):
+    """Wrap an elementwise transform with a straight-through gradient."""
+
+    @jax.custom_vjp
+    def f(x, *args):
+        return fwd_fn(x, *args)
+
+    def f_fwd(x, *args):
+        return fwd_fn(x, *args), len(args)
+
+    def f_bwd(n_args, g):
+        return (g,) + (None,) * n_args
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _group_reshape(x, num_groups):
+    """[*, n] → [num_groups, n//num_groups] view over the flattened array."""
+    flat = x.reshape(-1)
+    pad = (-flat.size) % num_groups
+    if pad:
+        raise ValueError(
+            f"size {flat.size} not divisible into {num_groups} groups")
+    return flat.reshape(num_groups, -1)
+
+
+def _sym_quant(x, bits, num_groups):
+    q = 2.0 ** (bits - 1) - 1
+    g = _group_reshape(x, num_groups)
+    scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / q
+    scale = jnp.where(scale == 0, 1.0, scale)
+    return (jnp.round(g / scale) * scale).reshape(x.shape)
+
+
+def _asym_quant(x, bits, num_groups):
+    q = 2.0 ** bits - 1
+    g = _group_reshape(x, num_groups)
+    lo = jnp.min(g, axis=-1, keepdims=True)
+    hi = jnp.max(g, axis=-1, keepdims=True)
+    scale = jnp.where(hi > lo, (hi - lo) / q, 1.0)
+    return (jnp.round((g - lo) / scale) * scale + lo).reshape(x.shape)
+
+
+def _ternary(x, num_groups):
+    g = _group_reshape(x, num_groups)
+    thresh = 0.7 * jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    mask = jnp.abs(g) > thresh
+    alpha = jnp.sum(jnp.abs(g) * mask, axis=-1, keepdims=True) / \
+        jnp.maximum(1, jnp.sum(mask, axis=-1, keepdims=True))
+    return (jnp.sign(g) * alpha * mask).reshape(x.shape)
+
+
+def _binary(x, num_groups):
+    g = _group_reshape(x, num_groups)
+    alpha = jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    return (jnp.sign(g) * alpha).reshape(x.shape)
+
+
+sym_quantize = _ste(_sym_quant)
+asym_quantize = _ste(_asym_quant)
+ternary_quantize = _ste(_ternary)
+binary_quantize = _ste(_binary)
+
+
+def quantizer_for(bits: int, mode: str = "symmetric"):
+    if bits == 1:
+        return lambda x, groups: binary_quantize(x, groups)
+    if bits == 2:
+        return lambda x, groups: ternary_quantize(x, groups)
+    fn = sym_quantize if mode == "symmetric" else asym_quantize
+    return lambda x, groups: fn(x, bits, groups)
+
+
+def _topk_mask(x, ratio):
+    """Keep the top-``ratio`` fraction by |value| (reference TopKBinarizer:
+    the mask itself; gradients pass through via the STE wrapper)."""
+    flat = jnp.abs(x).reshape(-1)
+    k = jnp.maximum(1, jnp.round(ratio * flat.size)).astype(jnp.int32)
+    # threshold = k-th largest magnitude
+    thresh = jnp.sort(flat)[jnp.maximum(0, flat.size - k)]
+    return (jnp.abs(x) >= thresh).astype(x.dtype)
+
+
+def topk_binarize(x, ratio):
+    return _ste(lambda v, r: v * _topk_mask(v, r))(x, ratio)
+
+
+def quantize_activation(x, bits: int = 8, mode: str = "symmetric"):
+    """Dynamic-range activation fake-quant (reference QuantAct with dynamic
+    calibration; the momentum-updated static range is an inference-time
+    latency trick that does not apply to an XLA-fused fake-quant)."""
+    q = 2.0 ** (bits - 1) - 1 if mode == "symmetric" else 2.0 ** bits - 1
+    if mode == "symmetric":
+        scale = jnp.max(jnp.abs(x)) / q
+        scale = jnp.where(scale == 0, 1.0, scale)
+        return _ste(lambda v, s: jnp.round(v / s) * s)(x, scale)
+    lo, hi = jnp.min(x), jnp.max(x)
+    scale = jnp.where(hi > lo, (hi - lo) / q, 1.0)
+    return _ste(lambda v, s, l: jnp.round((v - l) / s) * s + l)(x, scale, lo)

@@ -1,0 +1,13 @@
+"""Elastic training (reference ``deepspeed/elasticity/``)."""
+
+from .elasticity import (ElasticityConfigError, ElasticityError,
+                         ElasticityIncompatibleWorldSize,
+                         compute_elastic_config, elasticity_enabled,
+                         get_compatible_chips_v01, get_compatible_chips_v02)
+
+__all__ = [
+    "ElasticityError", "ElasticityConfigError",
+    "ElasticityIncompatibleWorldSize", "compute_elastic_config",
+    "elasticity_enabled", "get_compatible_chips_v01",
+    "get_compatible_chips_v02",
+]

@@ -1,0 +1,256 @@
+"""Elastic training: compatible batch-size / chip-count computation.
+
+Counterpart of reference ``elasticity/elasticity.py`` (``compute_elastic_config``
+:233, ``_get_compatible_gpus_v01`` :83, ``_get_compatible_gpus_v02`` :126) and
+``elasticity/config.py``. The contract: given a max acceptable global batch
+and a set of candidate micro-batch sizes, pick ONE global batch size that is
+simultaneously reachable (micro × gas × chips) on as many chip counts as
+possible — then a job can scale up/down across those chip counts *without
+changing the global batch*, so training convergence is unaffected; gradient
+accumulation absorbs the difference.
+
+TPU-native notes: "GPUs" in the reference maps to TPU chips; v0.2's
+``num_gpus_per_node`` maps to chips-per-host (a v5e host has 4 or 8).
+Restart-based elasticity pairs this with the universal checkpoint
+(``runtime/checkpointing.py``): a run checkpointed on mesh A resumes on any
+mesh B whose chip count is in ``valid_chips`` — the engine re-derives
+micro/gas from the fixed global batch (reference's DSElasticAgent restart
+role; no torch-elastic agent is needed in the restart model).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+
+class ElasticityError(Exception):
+    """Base error for elasticity problems (reference config.py:10)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Bad or missing elasticity configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current chip count is not in the valid set for the elastic config."""
+
+
+LATEST_VERSION = 0.2
+
+
+def highly_composite_numbers(limit: int) -> List[int]:
+    """All highly composite numbers ≤ limit (1, 2, 4, 6, 12, 24, ...) — the
+    scaling factors used to grow a base batch while keeping many divisors
+    (⇒ many compatible chip counts). Computed, not tabulated."""
+    out, best = [], 0
+    n = 1
+    while n <= limit:
+        d = _n_divisors(n)
+        if d > best:
+            out.append(n)
+            best = d
+        n += 1
+    return out
+
+
+def _n_divisors(n: int) -> int:
+    count, i = 1, 2
+    while i * i <= n:
+        if n % i == 0:
+            e = 0
+            while n % i == 0:
+                n //= i
+                e += 1
+            count *= e + 1
+        i += 1
+    if n > 1:
+        count *= 2
+    return count
+
+
+def _candidate_batch_sizes(bases: Sequence[int], max_batch: int) -> List[int]:
+    """Largest HCN multiple of each base that stays ≤ max_batch."""
+    hcn = highly_composite_numbers(max(1, max_batch // max(1, min(bases))))
+    out = set()
+    for base in bases:
+        if base >= max_batch:
+            out.add(base)
+            continue
+        factor = 1
+        for h in hcn:
+            if h * base <= max_batch:
+                factor = h
+            else:
+                break
+        out.add(factor * base)
+    return sorted(out)
+
+
+def _valid_chips(batch_size: int, micro_batches: Sequence[int],
+                 min_chips: int, max_chips: int) -> List[int]:
+    """All chip counts n with batch_size = micro × gas × n for some micro in
+    the candidate set and integer gas ≥ 1, within [min_chips, max_chips]."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        slots = batch_size // micro      # micro-batch slots = chips × gas
+        for n in range(1, int(math.isqrt(slots)) + 1):
+            if slots % n == 0:
+                for c in (n, slots // n):
+                    if min_chips <= c <= max_chips:
+                        valid.add(c)
+    return sorted(valid)
+
+
+def get_compatible_chips_v01(micro_batches: Sequence[int],
+                             max_acceptable_batch_size: int,
+                             min_chips: Optional[int] = None,
+                             max_chips: Optional[int] = None,
+                             prefer_larger: bool = True
+                             ) -> Tuple[int, List[int]]:
+    """v0.1 (reference :83): among candidate batch sizes (each micro batch
+    and their lcm, scaled by highly composite factors), pick the one valid
+    on the most chip counts; prefer_larger breaks ties."""
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_acceptable_batch_size // min(micro_batches)
+    if any(mb > max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityConfigError(
+            "every micro batch must be <= max_acceptable_batch_size "
+            f"({max_acceptable_batch_size}); got {list(micro_batches)}")
+
+    lcm = math.lcm(*[int(m) for m in micro_batches])
+    candidates = _candidate_batch_sizes(list(micro_batches) + [lcm],
+                                        max_acceptable_batch_size)
+    best_batch, best_chips = min(micro_batches), []
+    for batch in candidates:
+        chips = _valid_chips(batch, micro_batches, min_chips, max_chips)
+        better = len(chips) > len(best_chips) or (
+            len(chips) == len(best_chips)
+            and (batch > best_batch if prefer_larger else batch < best_batch))
+        if better:
+            best_batch, best_chips = batch, chips
+    return int(best_batch), best_chips
+
+
+def get_compatible_chips_v02(micro_batches: Sequence[int],
+                             max_acceptable_batch_size: int,
+                             current_num_chips: int,
+                             min_chips: Optional[int] = None,
+                             max_chips: Optional[int] = None,
+                             prefer_larger: bool = True,
+                             chips_per_host: int = 1,
+                             model_parallel_size: int = 1
+                             ) -> Tuple[int, List[int], Optional[int]]:
+    """v0.2 (reference :126): host-granular scaling with model parallelism —
+    chips are added/removed whole hosts at a time and the DP world is
+    chips / model_parallel_size. Returns (batch, valid_chip_counts, micro)."""
+    if chips_per_host % model_parallel_size:
+        raise ElasticityConfigError(
+            f"chips_per_host ({chips_per_host}) must be divisible by "
+            f"model_parallel_size ({model_parallel_size})")
+    dp_per_host = chips_per_host // model_parallel_size
+
+    def pick_micro(batch: int) -> Optional[int]:
+        chosen = None
+        for micro in micro_batches:
+            if (batch // current_num_chips) % micro == 0:
+                if chosen is None or (prefer_larger and micro > chosen):
+                    chosen = micro
+        return chosen
+
+    batch, valid_hosts = get_compatible_chips_v01(
+        micro_batches,
+        int(max_acceptable_batch_size / dp_per_host),
+        int((min_chips or 1) / chips_per_host) or 1,
+        int((max_chips or 10**6) / chips_per_host) or 1,
+        prefer_larger=prefer_larger)
+    batch = int(batch) * dp_per_host
+    valid_dp = [h * dp_per_host for h in valid_hosts]
+    if current_num_chips // model_parallel_size in valid_dp:
+        return batch, valid_dp, pick_micro(batch)
+
+    # Current world not in the preferred set: fall back to the largest
+    # batch ≤ max reachable on exactly this world (reference :206).
+    current_dp = (current_num_chips // chips_per_host) * dp_per_host
+    fallback = [int(max_acceptable_batch_size // (m * current_dp)) * m *
+                current_dp
+                for m in micro_batches if m * current_dp
+                <= max_acceptable_batch_size]
+    if not fallback:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro batch in {list(micro_batches)} fits "
+            f"max_acceptable_batch_size={max_acceptable_batch_size} on "
+            f"{current_num_chips} chips")
+    batch = max(fallback) if prefer_larger else min(fallback)
+    return batch, [int(current_dp)], pick_micro(batch)
+
+
+def elasticity_enabled(ds_config: Dict[str, Any]) -> bool:
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def compute_elastic_config(ds_config: Dict[str, Any],
+                           world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Reference :233. Given a config with an ``elasticity`` block, return
+    (final_batch_size, valid_chips[, micro_batch]). Deterministic for a
+    given config so schedulers and the runtime agree."""
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"expected a config dict, got {type(ds_config)}")
+    if "elasticity" not in ds_config:
+        raise ElasticityConfigError(
+            "'elasticity' is missing from the config; add it to run an "
+            "elastic job")
+    ec = ds_config["elasticity"]
+    if not ec.get("enabled", False):
+        raise ElasticityConfigError("elasticity.enabled is false")
+    version = float(ec.get("version", 0.2))
+    if version > LATEST_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {version} > latest supported "
+            f"{LATEST_VERSION}")
+    micro_batches = ec.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = ec.get("max_train_batch_size", 2000)
+    mp_size = int(ec.get("model_parallel_size", 1))
+    if mp_size > 1 and version != 0.2:
+        raise ElasticityConfigError(
+            f"elasticity v{version} does not support model parallelism "
+            f"(model_parallel_size={mp_size} needs version 0.2)")
+
+    if world_size == 0 and os.environ.get("WORLD_SIZE", "").isnumeric():
+        world_size = int(os.environ["WORLD_SIZE"])
+
+    if version == 0.1:
+        batch, valid = get_compatible_chips_v01(
+            micro_batches, max_batch,
+            ec.get("min_gpus", 1), ec.get("max_gpus", 10000),
+            prefer_larger=ec.get("prefer_larger_batch", True))
+        micro = None
+        if world_size > 0:
+            if world_size not in valid:
+                raise ElasticityIncompatibleWorldSize(
+                    f"world size {world_size} not in valid chip counts "
+                    f"{valid}")
+            micro = next(m for m in sorted(micro_batches, reverse=True)
+                         if batch % (m * world_size) == 0)
+    else:
+        if world_size == 0:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs the current world size (argument or "
+                "WORLD_SIZE env)")
+        batch, valid, micro = get_compatible_chips_v02(
+            micro_batches, max_batch, world_size,
+            ec.get("min_gpus", 1), ec.get("max_gpus", 10000),
+            prefer_larger=ec.get("prefer_larger_batch", True),
+            chips_per_host=int(ec.get("num_gpus_per_node", 1)),
+            model_parallel_size=mp_size)
+    logger.info(f"elasticity: batch={batch} valid_chips={valid} "
+                f"micro={micro}")
+    if return_microbatch:
+        return batch, valid, micro
+    return batch, valid

@@ -1,0 +1,284 @@
+"""1-bit optimizers: error-compensated compressed-communication Adam/LAMB.
+
+Counterpart of the reference's ``runtime/fp16/onebit/`` suite — OnebitAdam
+(``runtime/fp16/onebit/adam.py``), ZeroOneAdam (``zoadam.py``), OnebitLamb
+(``lamb.py``) — whose core idea is: after a full-precision warmup, the
+*momentum* (not the gradient) is synchronized across data-parallel workers in
+compressed form (sign + per-tensor scale) with an error-feedback buffer
+carrying the quantization residual into the next step, cutting DP gradient
+traffic ~32x on the reference's NCCL/MPI backends
+(``runtime/comm/nccl.py:16`` compressed_allreduce).
+
+TPU-native formulation
+----------------------
+The reference moves sign *bit* matrices through a two-phase
+gather/scatter over NCCL. On TPU the collectives are XLA all-reduces over
+ICI, and the natural compressed wire format is **int8**: each worker
+quantizes its error-compensated momentum to ``sign ∈ {-1,+1}`` (int8) plus
+one fp32 scale per tensor, ``lax.psum``s the int8 sign tensor (1 byte/elem
+on the wire vs 4 — the scalar scales ride a second, negligible psum), and
+reconstructs the average as ``(Σ signs / n) · mean(scale)``. Error feedback
+is per-worker state: the optimizer's ``e`` moment carries a leading
+data-parallel axis and is sharded over the ``data`` mesh axis.
+
+These optimizers therefore run *inside* ``shard_map`` over the data axis:
+the engine computes **unreduced per-worker gradients** (no GSPMD psum) and
+hands them to ``warmup_step_local`` / ``compressed_step_local``, which own
+all cross-worker communication — exactly the reference's contract where the
+1-bit optimizer takes over gradient averaging from the engine
+(``runtime/engine.py:1194`` skips the engine allreduce for these types).
+
+Documented divergences from the reference (design, not omission):
+- int8 wire format (4x) instead of packed 1-bit (32x): XLA all-reduce has no
+  sub-byte dtype; the error-feedback algebra is identical.
+- ZeroOneAdam's *local-step* intervals (skipping sync entirely for k steps)
+  cannot be expressed under SPMD with replicated parameters — every worker
+  must hold identical params. Its variance-freeze policy and compressed
+  momentum sync are implemented; sync happens at every optimizer boundary.
+- Gradient clipping / the reported ``grad_norm`` use the root-mean of
+  per-worker squared norms, ``sqrt(psum(‖g_i‖²)/n)`` — an upper bound on
+  the true norm of the averaged gradient (equality when workers agree).
+  Computing the exact averaged-grad norm would need a full-precision psum
+  of the gradients, which is exactly the traffic these optimizers remove;
+  the reference has the same property (its FP16_Optimizer wrapper clips by
+  the *local* norm, which also differs from the averaged-grad norm).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .optimizers import Optimizer, OptimizerState, _tmap, _unzip
+
+AXIS = "data"
+
+
+def _sign_compress_psum(c, dp: int):
+    """Error-feedback sign compression + int8 all-reduce over the data axis.
+
+    A *shared* scale (pmean of the per-worker mean-abs — one scalar psum) is
+    used so worker ``i``'s wire contribution is exactly ``sign(c_i)·scale``:
+    the reconstructed average ``(Σ signs)·scale/n`` is then the exact mean of
+    the contributions and ``err_i = c_i − sign(c_i)·scale`` is the exact
+    residual — the reference's server-average semantics
+    (runtime/comm/nccl.py compressed_allreduce) with O(1) extra memory
+    instead of an all-gather. Returns ``(avg, err)``; runs inside shard_map.
+    """
+    scale = lax.pmean(jnp.mean(jnp.abs(c)), AXIS)
+    sign = jnp.where(c >= 0, jnp.int8(1), jnp.int8(-1))
+    # int8 sums saturate at |Σ| = dp; widen only when dp could overflow.
+    wire = sign if dp <= 127 else sign.astype(jnp.int16)
+    sign_sum = lax.psum(wire, AXIS)
+    quantized = sign.astype(c.dtype) * scale
+    avg = sign_sum.astype(c.dtype) * (scale / dp)
+    return avg, c - quantized
+
+
+class OneBitOptimizer(Optimizer):
+    """Base for compressed-comm optimizers.
+
+    Contract with the engine (runtime/engine.py onebit path):
+    - ``dp_size`` is set by the engine before ``init`` (data-parallel world).
+    - ``init(params)`` creates the ``e`` error moment with a leading
+      ``dp_size`` axis (engine shards it over the ``data`` mesh axis).
+    - ``warmup_step_local`` / ``compressed_step_local`` run inside
+      ``shard_map``: ``grads`` are this worker's unreduced gradients and the
+      ``e`` leaves arrive with a leading axis of 1 (this worker's slice).
+    - The engine dispatches warmup vs compressed on ``freeze_step``
+      (host-side — two compiled programs, no traced branch around
+      collectives).
+    """
+
+    dp_moment_keys = frozenset({"e"})
+    dp_size = 1
+    freeze_step = 0
+
+    def _error_init(self, params):
+        return _tmap(
+            lambda p: jnp.zeros((self.dp_size,) + p.shape, p.dtype), params)
+
+    def step(self, params, grads, state, lr):
+        raise TypeError(
+            f"{type(self).__name__} communicates inside its step and must "
+            "run under the engine's shard_map data-parallel path; plain "
+            "step() is not supported (reference onebit optimizers likewise "
+            "bypass the engine allreduce)")
+
+
+class OneBitAdam(OneBitOptimizer):
+    """1-bit Adam (reference ``runtime/fp16/onebit/adam.py``).
+
+    Warmup (``step < freeze_step``): exact Adam on full-precision
+    ``pmean``-averaged gradients, building up the variance estimate.
+    Compression stage: the variance is frozen; each worker folds its local
+    gradient into the momentum, adds its error residual, sign-compresses,
+    int8-all-reduces, and applies the reconstructed averaged momentum.
+    """
+
+    name = "onebitadam"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100000, bias_correction=True,
+                 **_):
+        self.lr, self.betas, self.eps = lr, tuple(betas), eps
+        self.weight_decay = weight_decay
+        self.freeze_step = int(freeze_step)
+        self.bias_correction = bias_correction
+
+    def init(self, params):
+        zeros = _tmap(jnp.zeros_like, params)
+        return OptimizerState(
+            step=jnp.zeros((), jnp.int32),
+            moments={"m": zeros, "v": _tmap(jnp.zeros_like, params),
+                     "e": self._error_init(params)})
+
+    def _corrections(self, tf):
+        if not self.bias_correction:
+            return 1.0, 1.0
+        b1, b2 = self.betas
+        return 1.0 - b1 ** tf, 1.0 - b2 ** tf
+
+    def warmup_step_local(self, params, grads, state, lr):
+        b1, b2 = self.betas
+        t = state.step + 1
+        c1, c2 = self._corrections(t.astype(jnp.float32))
+        wd = self.weight_decay
+
+        def upd(p, g_local, m, v, e):
+            g = lax.pmean(g_local, AXIS)
+            if wd:  # classic Adam L2 (reference adam.py warmup path)
+                g = g + wd * p
+            m2 = b1 * m + (1 - b1) * g
+            v2 = b2 * v + (1 - b2) * jnp.square(g)
+            update = (m2 / c1) / (jnp.sqrt(v2 / c2) + self.eps)
+            return p - lr * update, m2, v2, e
+
+        out = _tmap(upd, params, grads, state.moments["m"],
+                    state.moments["v"], state.moments["e"])
+        new_p, new_m, new_v, new_e = _unzip(out, 4)
+        return new_p, OptimizerState(
+            step=t, moments={"m": new_m, "v": new_v, "e": new_e})
+
+    def compressed_step_local(self, params, grads, state, lr):
+        b1, _ = self.betas
+        t = state.step + 1
+        wd = self.weight_decay
+        dp = self.dp_size
+
+        def upd(p, g, m, v, e):
+            c = b1 * m + (1 - b1) * g + e[0]
+            m2, err = _sign_compress_psum(c, dp)
+            update = m2 / (jnp.sqrt(v) + self.eps)   # v frozen at freeze_step
+            if wd:
+                update = update + wd * p
+            return p - lr * update, m2, v, err[None]
+
+        out = _tmap(upd, params, grads, state.moments["m"],
+                    state.moments["v"], state.moments["e"])
+        new_p, new_m, new_v, new_e = _unzip(out, 4)
+        return new_p, OptimizerState(
+            step=t, moments={"m": new_m, "v": new_v, "e": new_e})
+
+
+class ZeroOneAdam(OneBitAdam):
+    """0/1 Adam (reference ``runtime/fp16/onebit/zoadam.py``): variance
+    updates are frozen after ``var_freeze_step``; momentum sync is
+    1-bit-compressed past that point. Local-step sync skipping does not map
+    to SPMD replicated params (see module docstring) — the accepted
+    ``local_step_*`` knobs are recorded but sync runs every boundary."""
+
+    name = "zerooneadam"
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, var_freeze_step=100000,
+                 var_update_scaler=16, local_step_scaler=32678,
+                 local_step_clipper=16, bias_correction=True, **_):
+        super().__init__(lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay,
+                         freeze_step=var_freeze_step,
+                         bias_correction=bias_correction)
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+
+
+class OneBitLamb(OneBitOptimizer):
+    """1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py``): warmup runs
+    exact LAMB on pmean grads while recording each tensor's trust ratio; the
+    compression stage applies the frozen ratios (the reference's "scaling
+    coefficients", lamb.py fused-lamb freeze) to updates built from the
+    compressed averaged momentum and frozen variance."""
+
+    name = "onebitlamb"
+    dp_moment_keys = frozenset({"e"})
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
+                 weight_decay=0.0, freeze_step=100000, max_coeff=10.0,
+                 min_coeff=0.01, **_):
+        self.lr, self.betas, self.eps = lr, tuple(betas), eps
+        self.weight_decay = weight_decay
+        self.freeze_step = int(freeze_step)
+        self.max_coeff, self.min_coeff = max_coeff, min_coeff
+
+    def init(self, params):
+        return OptimizerState(
+            step=jnp.zeros((), jnp.int32),
+            moments={"m": _tmap(jnp.zeros_like, params),
+                     "v": _tmap(jnp.zeros_like, params),
+                     "ratio": _tmap(lambda p: jnp.ones((), p.dtype), params),
+                     "e": self._error_init(params)})
+
+    def warmup_step_local(self, params, grads, state, lr):
+        b1, b2 = self.betas
+        t = state.step + 1
+        tf = t.astype(jnp.float32)
+        c1, c2 = 1.0 - b1 ** tf, 1.0 - b2 ** tf
+
+        def upd(p, g_local, m, v, r, e):
+            g = lax.pmean(g_local, AXIS)
+            m2 = b1 * m + (1 - b1) * g
+            v2 = b2 * v + (1 - b2) * jnp.square(g)
+            u = (m2 / c1) / (jnp.sqrt(v2 / c2) + self.eps) \
+                + self.weight_decay * p
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            trust = jnp.where(
+                u_norm > 0, jnp.where(p_norm > 0, p_norm / u_norm, 1.0), 1.0)
+            trust = jnp.clip(trust, self.min_coeff, self.max_coeff)
+            return p - lr * trust * u, m2, v2, trust.astype(r.dtype), e
+
+        out = _tmap(upd, params, grads, state.moments["m"],
+                    state.moments["v"], state.moments["ratio"],
+                    state.moments["e"])
+        new_p, new_m, new_v, new_r, new_e = _unzip(out, 5)
+        return new_p, OptimizerState(
+            step=t, moments={"m": new_m, "v": new_v, "ratio": new_r,
+                             "e": new_e})
+
+    def compressed_step_local(self, params, grads, state, lr):
+        b1, _ = self.betas
+        t = state.step + 1
+        dp = self.dp_size
+
+        def upd(p, g, m, v, r, e):
+            c = b1 * m + (1 - b1) * g + e[0]
+            m2, err = _sign_compress_psum(c, dp)
+            u = m2 / (jnp.sqrt(v) + self.eps) + self.weight_decay * p
+            return p - lr * r * u, m2, v, r, err[None]
+
+        out = _tmap(upd, params, grads, state.moments["m"],
+                    state.moments["v"], state.moments["ratio"],
+                    state.moments["e"])
+        new_p, new_m, new_v, new_r, new_e = _unzip(out, 5)
+        return new_p, OptimizerState(
+            step=t, moments={"m": new_m, "v": new_v, "ratio": new_r,
+                             "e": new_e})
+
+
+ONEBIT_OPTIMIZERS = {
+    "onebitadam": OneBitAdam,
+    "zerooneadam": ZeroOneAdam,
+    "onebitlamb": OneBitLamb,
+}

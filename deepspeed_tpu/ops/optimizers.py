@@ -228,17 +228,24 @@ OPTIMIZERS = {
     "lion": Lion,
     "sgd": SGD,
     "adagrad": Adagrad,
-    "onebitadam": FusedAdam,   # compression rides the comm layer on TPU
-    "zerooneadam": FusedAdam,
-    "onebitlamb": Lamb,
 }
+
+# 1-bit optimizers (ops/onebit.py) — real error-compensated compressed-comm
+# implementations; resolved lazily to avoid a circular import at load time.
+_ONEBIT_KEYS = ("onebitadam", "zerooneadam", "onebitlamb")
 
 
 def build_optimizer(type_name: str, params: Optional[dict] = None) -> Optimizer:
     key = type_name.lower().replace("_", "")
-    if key not in OPTIMIZERS:
-        raise ValueError(f"Unknown optimizer {type_name!r}; known: {sorted(OPTIMIZERS)}")
     kwargs = dict(params or {})
     kwargs.pop("torch_adam", None)
     kwargs.pop("adam_w_mode", None) if key == "adamw" else None
+    if key in _ONEBIT_KEYS:
+        from .onebit import ONEBIT_OPTIMIZERS
+
+        return ONEBIT_OPTIMIZERS[key](**kwargs)
+    if key not in OPTIMIZERS:
+        raise ValueError(
+            f"Unknown optimizer {type_name!r}; "
+            f"known: {sorted(OPTIMIZERS) + sorted(_ONEBIT_KEYS)}")
     return OPTIMIZERS[key](**kwargs)

@@ -259,6 +259,19 @@ class ElasticityConfig(DSConfigModel):
     version: float = 0.2
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    model_parallel_size: int = 1     # v0.2 (reference elasticity/config.py)
+    num_gpus_per_node: int = 1       # chips per host, v0.2 host granularity
+
+
+class HybridEngineConfig(DSConfigModel):
+    """RLHF train↔generate engine (reference runtime/hybrid_engine.py +
+    config get_hybrid_engine_config)."""
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
 
 
 class AutotuningConfig(DSConfigModel):
@@ -319,6 +332,7 @@ class DeepSpeedTpuConfig(DSConfigModel):
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
+    hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 1234

@@ -1,0 +1,14 @@
+"""Data efficiency pipeline (reference ``runtime/data_pipeline/``):
+curriculum learning scheduler + sampler, offline data analyzer, and
+random-LTD token dropping re-designed as JAX transforms."""
+
+from .curriculum_scheduler import CurriculumScheduler
+from .data_sampler import DeepSpeedDataSampler
+from .data_analyzer import DataAnalyzer
+from .random_ltd import (RandomLTDScheduler, apply_random_ltd,
+                         sample_token_indices)
+
+__all__ = [
+    "CurriculumScheduler", "DeepSpeedDataSampler", "DataAnalyzer",
+    "RandomLTDScheduler", "apply_random_ltd", "sample_token_indices",
+]

@@ -1,0 +1,106 @@
+"""Curriculum difficulty scheduler.
+
+Counterpart of reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler``): maps a global step to a difficulty value under
+one of four schedules — ``fixed_linear``, ``fixed_root``, ``fixed_discrete``,
+``custom`` — with the same config schema (min/max difficulty,
+``schedule_config`` with ``total_curriculum_step`` / ``difficulty_step`` /
+``root_degree`` or ``difficulty``/``max_step`` lists). Difficulty is
+quantized to ``difficulty_step`` multiples; on TPU that keeps the set of
+jit-compiled sequence lengths small (the reference quantizes for Tensor
+Core alignment — same knob, different hardware rationale).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires '{key}'")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        self.current_difficulty = self.min_difficulty
+        sc = dict(config.get("schedule_config", {}))
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+
+        if self.schedule_type == FIXED_DISCRETE:
+            if not sc.get("difficulty") or "max_step" not in sc:
+                raise ValueError("fixed_discrete needs schedule_config "
+                                 "{difficulty: [...], max_step: [...]}")
+            if len(sc["difficulty"]) != len(sc["max_step"]) + 1:
+                raise ValueError("fixed_discrete: len(difficulty) must be "
+                                 "len(max_step) + 1 (last difficulty holds)")
+        elif self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            if "total_curriculum_step" not in sc or "difficulty_step" not in sc:
+                raise ValueError(
+                    f"{self.schedule_type} needs schedule_config "
+                    "{total_curriculum_step, difficulty_step}")
+            if self.schedule_type == FIXED_ROOT and "root_degree" not in sc:
+                raise ValueError("fixed_root needs schedule_config.root_degree")
+        elif self.schedule_type != CUSTOM:
+            raise ValueError(f"unknown curriculum schedule {self.schedule_type!r}")
+        self.schedule_config = sc
+
+    # -- reference API ----------------------------------------------------
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.current_difficulty = int(difficulty)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty,
+                "schedule_type": self.schedule_type,
+                "min_difficulty": self.min_difficulty,
+                "max_difficulty": self.max_difficulty}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = int(state["current_difficulty"])
+
+    # -- schedule math ----------------------------------------------------
+    def _root_difficulty(self, step: int, degree: float) -> int:
+        sc = self.schedule_config
+        frac = min(1.0, (step / sc["total_curriculum_step"]) ** (1.0 / degree))
+        span = self.max_difficulty - self.min_difficulty
+        raw = self.min_difficulty + frac * span
+        quant = sc["difficulty_step"]
+        return min(self.max_difficulty,
+                   int(raw / quant) * int(quant)
+                   if raw >= self.min_difficulty + quant
+                   else self.min_difficulty)
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.schedule_type == FIXED_LINEAR:
+            return self._root_difficulty(global_step, 1.0)
+        if self.schedule_type == FIXED_ROOT:
+            return self._root_difficulty(
+                global_step, float(self.schedule_config["root_degree"]))
+        if self.schedule_type == FIXED_DISCRETE:
+            sc = self.schedule_config
+            for diff, max_step in zip(sc["difficulty"], sc["max_step"]):
+                if global_step <= max_step:
+                    return int(diff)
+            return int(sc["difficulty"][-1])
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom curriculum schedule requires "
+                               "set_custom_get_difficulty()")
+        return int(self.custom_get_difficulty(global_step))
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty

@@ -1,0 +1,100 @@
+"""Offline data analyzer.
+
+Counterpart of reference ``data_sampling/data_analyzer.py`` (``DataAnalyzer``):
+map-reduce metric computation over a dataset — each worker computes metric
+values for its index range (``run_map``), then a reduce pass merges the
+parts into ``<metric>_values.npy`` (sample → value) and
+``<metric>_index_by_value.npy`` (samples sorted easiest-first), the files
+the curriculum sampler consumes.
+
+The reference builds Megatron mmap ``.bin/.idx`` pairs because its samplers
+read them; the TPU-native pipeline keeps plain ``.npy`` (host-side numpy is
+the single-controller data plane)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+def metric_seqlen(sample: Any) -> int:
+    """Built-in metric: token count (reference's seqlen metric)."""
+    if isinstance(sample, dict):
+        sample = sample.get("input_ids", next(iter(sample.values())))
+    return int(np.asarray(sample).shape[-1] if np.asarray(sample).ndim
+               else 1)
+
+
+def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable[[Any], float]:
+    """Built-in metric factory: mean negative-log-frequency of the sample's
+    tokens (reference's vocabularyrarity)."""
+    logp = -np.log(np.clip(vocab_freq / max(1, vocab_freq.sum()), 1e-12, 1))
+
+    def fn(sample: Any) -> float:
+        if isinstance(sample, dict):
+            sample = sample.get("input_ids", next(iter(sample.values())))
+        ids = np.asarray(sample).reshape(-1)
+        return float(logp[ids].mean())
+
+    return fn
+
+
+class DataAnalyzer:
+    def __init__(self, dataset: Sequence[Any],
+                 metric_functions: Dict[str, Callable[[Any], float]],
+                 save_path: str,
+                 num_workers: int = 1, worker_id: int = 0,
+                 batch_size: int = 1024):
+        self.dataset = dataset
+        self.metric_functions = dict(metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+        os.makedirs(save_path, exist_ok=True)
+
+    def _worker_range(self):
+        n = len(self.dataset)
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def run_map(self) -> Dict[str, str]:
+        """Compute this worker's metric slices; write one part file each."""
+        lo, hi = self._worker_range()
+        out = {}
+        for name, fn in self.metric_functions.items():
+            values = np.asarray([fn(self.dataset[i]) for i in range(lo, hi)])
+            path = os.path.join(self.save_path,
+                                f"{name}_part{self.worker_id:05d}.npy")
+            np.save(path, values)
+            out[name] = path
+        return out
+
+    def run_reduce(self) -> Dict[str, Dict[str, str]]:
+        """Merge all part files: values array + easiest-first sample index
+        (the reference's index_to_sample_percentile_merged role)."""
+        out = {}
+        for name in self.metric_functions:
+            parts = sorted(
+                f for f in os.listdir(self.save_path)
+                if f.startswith(f"{name}_part") and f.endswith(".npy"))
+            if not parts:
+                raise FileNotFoundError(
+                    f"no map output for metric {name!r} in {self.save_path}")
+            values = np.concatenate(
+                [np.load(os.path.join(self.save_path, f)) for f in parts])
+            v_path = os.path.join(self.save_path, f"{name}_values.npy")
+            i_path = os.path.join(self.save_path,
+                                  f"{name}_index_by_value.npy")
+            np.save(v_path, values)
+            np.save(i_path, np.argsort(values, kind="stable"))
+            out[name] = {"values": v_path, "index_by_value": i_path}
+        return out
+
+    def run(self) -> Dict[str, Dict[str, str]]:
+        """Single-worker convenience: map then reduce."""
+        self.run_map()
+        return self.run_reduce()

@@ -1,0 +1,131 @@
+"""Curriculum-aware data sampler.
+
+Counterpart of reference ``data_sampling/data_sampler.py``
+(``DeepSpeedDataSampler``): yields per-step sample indices whose difficulty
+(per-sample metric values, e.g. sequence length) is within the curriculum
+schedulers' current thresholds, shuffled within the admitted pool, sharded
+over data-parallel ranks.
+
+TPU-native notes: the reference is a per-rank torch sampler coordinating
+through a process group and mmap'd Megatron index files. Under the JAX
+single-controller model one sampler instance produces the *global* batch
+index array (the loader device_puts the batch sharded over the data axis),
+so no cross-rank coordination is needed; metric values are plain numpy
+arrays (the analyzer writes ``.npy`` — data_analyzer.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+VALUE_BASED = "value"          # threshold compares metric values
+PERCENTILE_BASED = "percentile"  # threshold is a percentile of the pool
+
+
+class DeepSpeedDataSampler:
+    def __init__(self,
+                 data_efficiency_config: Dict[str, Any],
+                 one_epoch_total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_size: int,
+                 gradient_accumulation_steps: int = 1,
+                 metric_values: Optional[Dict[str, np.ndarray]] = None,
+                 drop_last: bool = True):
+        cfg = data_efficiency_config
+        self.seed = int(cfg.get("seed", 1234))
+        sampling = cfg.get("data_sampling", {})
+        self.num_epochs = int(sampling.get("num_epochs", 1000))
+        self.total_samples = one_epoch_total_samples * self.num_epochs
+        self.one_epoch_total_samples = one_epoch_total_samples
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.gradient_accumulation_steps = gradient_accumulation_steps
+        self.global_batch_size = (micro_batch_size * data_parallel_size
+                                  * gradient_accumulation_steps)
+        self.drop_last = drop_last
+        self.np_rng = np.random.default_rng(self.seed)
+        self.consumed_samples = 0
+        self.curriculum_step = 0
+
+        cl = sampling.get("curriculum_learning", {})
+        self.curriculum_enabled = bool(cl.get("enabled", False))
+        self.curriculum_schedulers: Dict[str, CurriculumScheduler] = {}
+        self.difficulty_type: Dict[str, str] = {}
+        self.metric_values: Dict[str, np.ndarray] = {}
+        if self.curriculum_enabled:
+            metrics = cl.get("metrics", {})
+            if not metrics:
+                raise ValueError("curriculum_learning.enabled requires "
+                                 "curriculum_learning.metrics")
+            for name, mcfg in metrics.items():
+                self.curriculum_schedulers[name] = CurriculumScheduler(mcfg)
+                self.difficulty_type[name] = mcfg.get("difficulty_type",
+                                                      VALUE_BASED)
+                values = (metric_values or {}).get(name)
+                if values is None:
+                    path = mcfg.get("metric_path")
+                    if path is None:
+                        raise ValueError(
+                            f"metric {name!r}: pass metric_values or set "
+                            "metric_path (a .npy written by DataAnalyzer)")
+                    values = np.load(path)
+                values = np.asarray(values)
+                if values.shape[0] != one_epoch_total_samples:
+                    raise ValueError(
+                        f"metric {name!r} has {values.shape[0]} values for "
+                        f"{one_epoch_total_samples} samples")
+                self.metric_values[name] = values
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    # -- curriculum pool --------------------------------------------------
+    def _admitted_pool(self) -> np.ndarray:
+        """Indices whose every metric is within its current difficulty."""
+        mask = np.ones(self.one_epoch_total_samples, dtype=bool)
+        for name, sched in self.curriculum_schedulers.items():
+            difficulty = sched.update_difficulty(self.curriculum_step)
+            values = self.metric_values[name]
+            if self.difficulty_type[name] == PERCENTILE_BASED:
+                cutoff = np.percentile(values, min(100, difficulty))
+                mask &= values <= cutoff
+            else:
+                mask &= values <= difficulty
+        pool = np.nonzero(mask)[0]
+        if pool.size == 0:    # degenerate config: admit the easiest sample
+            pool = np.array([int(np.argmin(
+                next(iter(self.metric_values.values()))))])
+        return pool
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"consumed_samples": self.consumed_samples,
+                "curriculum_step": self.curriculum_step,
+                "seed": self.seed}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.consumed_samples = int(state["consumed_samples"])
+        self.curriculum_step = int(state["curriculum_step"])
+        # re-derive the rng stream position deterministically
+        self.np_rng = np.random.default_rng(self.seed)
+        for _ in range(self.curriculum_step):
+            self.np_rng.integers(0, 2**31)
+
+    # -- iteration --------------------------------------------------------
+    def __iter__(self) -> Iterator[np.ndarray]:
+        """Yields global-batch index arrays (len = global_batch_size)."""
+        while self.consumed_samples < self.total_samples:
+            self.curriculum_step += 1
+            draw_seed = int(self.np_rng.integers(0, 2**31))
+            if self.curriculum_enabled:
+                pool = self._admitted_pool()
+            else:
+                pool = np.arange(self.one_epoch_total_samples)
+            rng = np.random.default_rng(draw_seed)
+            replace = pool.size < self.global_batch_size
+            batch = rng.choice(pool, size=self.global_batch_size,
+                               replace=replace)
+            self.consumed_samples += self.global_batch_size
+            yield batch

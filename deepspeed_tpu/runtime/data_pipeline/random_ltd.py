@@ -1,0 +1,92 @@
+"""Random-LTD (layer token drop) as JAX transforms.
+
+Counterpart of reference ``data_routing/basic_layer.py``
+(``RandomLayerTokenDrop``), ``data_routing/scheduler.py``
+(``RandomLTDScheduler``) and the CUDA sampling kernels
+``csrc/random_ltd/token_sort.cu`` / ``gather_scatter.cu``: each wrapped
+layer processes only a random subset of ``reserved`` tokens; the rest skip
+the layer (identity). Indices are sorted ascending so causal order is
+preserved for decoders (the reference's token_sort kernel exists for
+exactly this — on TPU it is one ``argsort`` the XLA compiler fuses).
+
+Everything here is functional and jit-safe: sampling is `jax.random`,
+gather/scatter are `take_along_axis` / indexed `.at[]` updates (autodiff
+flows through both, so no custom VJP is needed — the reference's
+GatherTokens/ScatterTokens autograd Functions exist only because torch
+needed explicit backward routing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng: jax.Array, num_layers: int, batch: int,
+                         seq: int, reserved: int) -> jax.Array:
+    """[num_layers, batch, reserved] random token indices, sorted ascending
+    per row (reference gpt_sample_tokens: independent draw per layer)."""
+    noise = jax.random.uniform(rng, (num_layers, batch, seq))
+    perm = jnp.argsort(noise, axis=-1)[..., :reserved]
+    return jnp.sort(perm, axis=-1)
+
+
+def gather_tokens(hidden: jax.Array, indices: jax.Array) -> jax.Array:
+    """hidden [B, T, H], indices [B, r] → [B, r, H]."""
+    return jnp.take_along_axis(hidden, indices[..., None], axis=1)
+
+
+def scatter_tokens(full: jax.Array, part: jax.Array,
+                   indices: jax.Array) -> jax.Array:
+    """Write the processed subset back into the full sequence."""
+    batch_idx = jnp.arange(full.shape[0])[:, None]
+    return full.at[batch_idx, indices].set(part)
+
+
+def apply_random_ltd(layer_fn: Callable[[jax.Array], jax.Array],
+                     hidden: jax.Array, indices: jax.Array) -> jax.Array:
+    """Run ``layer_fn`` on the sampled tokens only; others pass through
+    (reference basic_layer.py forward: gather → layer → scatter)."""
+    part = gather_tokens(hidden, indices)
+    out = layer_fn(part)
+    return scatter_tokens(hidden, out, indices)
+
+
+class RandomLTDScheduler:
+    """Reserved-sequence-length schedule (reference data_routing/scheduler.py):
+    grow from ``min_value`` to ``max_value`` by ``seq_per_step`` every
+    ``require_steps`` optimizer steps (fixed_linear)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("random_ltd_schedule", config)
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 2048))
+        sc = sched.get("schedule_config", {})
+        self.seq_per_step = int(sc.get("seq_per_step", 16))
+        self.require_steps = int(sc.get("require_steps", 100))
+        schedule_type = sched.get("schedule_type", "fixed_linear")
+        if schedule_type != "fixed_linear":
+            raise ValueError(
+                f"random-LTD supports fixed_linear schedules, got "
+                f"{schedule_type!r} (reference scheduler.py has the same)")
+        self.current_seq = self.min_value
+        self.global_step = 0
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def update_seq(self, global_step: int) -> int:
+        self.global_step = global_step
+        grown = (global_step // self.require_steps) * self.seq_per_step
+        self.current_seq = min(self.max_value, self.min_value + grown)
+        return self.current_seq
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"current_seq": self.current_seq,
+                "global_step": self.global_step}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self.current_seq = int(state["current_seq"])
+        self.global_step = int(state["global_step"])

@@ -80,9 +80,43 @@ class DeepSpeedTpuEngine:
             self.topology = topo.get_topology()
         else:
             self.topology = topo.MeshTopology.build(self.config.mesh)
+        # -- MiCS (reference runtime/zero/mics.py:55 MiCS_Init) -------------
+        # mics_shard_size=k shards params over a k-sized sub-group and
+        # replicates across the rest of the DP world. TPU-natively the
+        # sub-group IS the fsdp mesh axis (ICI-contiguous), replication is
+        # the data axis — so honoring the flag means shaping the mesh, after
+        # which the ZeRO-3 plan + XLA collectives do the rest (the
+        # hierarchical gather of mics.py:227 is XLA's collective scheduling
+        # over ICI/DCN; mics_hierarchical_params_gather needs no manual
+        # two-phase gather here).
+        zc0 = self.config.zero_optimization
+        if zc0.mics_shard_size and zc0.mics_shard_size > 0:
+            k = int(zc0.mics_shard_size)
+            if zc0.stage != 3:
+                raise ValueError(
+                    f"mics_shard_size={k} requires zero_optimization.stage=3 "
+                    "(MiCS is a ZeRO-3 variant, reference mics.py:55)")
+            fsdp_size = self.topology.mesh.shape.get("fsdp", 1)
+            if fsdp_size != k:
+                if mesh is None and fsdp_size == 1 \
+                        and self.topology.world_size % k == 0:
+                    # engine-built default mesh: reshape fsdp to the shard
+                    # group, data soaks up the replication factor
+                    self.topology = topo.MeshTopology.build(
+                        self.config.mesh, fsdp=k, data=-1)
+                else:
+                    raise ValueError(
+                        f"mics_shard_size={k} conflicts with the mesh fsdp "
+                        f"axis ({fsdp_size}); size the fsdp axis to the MiCS "
+                        "shard group (params shard over fsdp, replicate over "
+                        "data)")
+            log_dist(
+                f"MiCS: shard group={k} (fsdp axis), replication="
+                f"{self.topology.axis_size('data')} (data axis)", ranks=[0])
         topo.set_topology(self.topology)
         self.mesh = self.topology.mesh
 
+        self._apply_elasticity()
         self.config.resolve_batch_sizes(self.topology.get_data_parallel_world_size())
 
         # -- model ---------------------------------------------------------
@@ -123,6 +157,30 @@ class DeepSpeedTpuEngine:
         else:
             self.opt = build_optimizer(oc.type if oc else "Adam",
                                        oc.params if oc else {"lr": 1e-3})
+        # 1-bit optimizers take over gradient communication (ops/onebit.py):
+        # the engine computes unreduced per-worker grads under shard_map and
+        # the optimizer owns the (compressed) cross-worker reduction —
+        # reference runtime/engine.py:1194 likewise skips the engine
+        # allreduce for these optimizer types.
+        from ..ops.onebit import OneBitOptimizer
+
+        self._onebit = isinstance(self.opt, OneBitOptimizer)
+        if self._onebit:
+            bad_axes = {a: s for a, s in dict(self.mesh.shape).items()
+                        if a != "data" and s > 1}
+            if bad_axes:
+                raise ValueError(
+                    "1-bit optimizers require pure data parallelism (they "
+                    f"own the gradient reduction); mesh has {bad_axes}")
+            if self.zero_stage > 1:
+                raise ValueError(
+                    "1-bit optimizers require zero_optimization.stage <= 1 "
+                    "(reference onebit/adam.py compatibility constraint)")
+            if self._offload_config() is not None:
+                raise ValueError("1-bit optimizers are incompatible with "
+                                 "optimizer offload")
+            self.opt.dp_size = self.topology.get_data_parallel_world_size()
+
         base_lr = getattr(self.opt, "lr", 1e-3)
         sc = self.config.scheduler
         if lr_scheduler is not None:
@@ -149,6 +207,25 @@ class DeepSpeedTpuEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
+        # -- curriculum learning (seqlen curriculum; reference engine.py
+        # curriculum legacy path + data_pipeline/curriculum_scheduler.py) --
+        self.curriculum_scheduler = None
+        cl = self.config.curriculum_learning or {}
+        if cl.get("enabled"):
+            from .data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+
+        # -- compression (QAT/pruning baked into the step programs) --------
+        self._compression = None
+        if self.config.compression_training:
+            from ..compression import CompressionTransform
+
+            ct = CompressionTransform(
+                {"compression_training": self.config.compression_training})
+            if ct:
+                self._compression = ct
+
         # -- step programs -------------------------------------------------
         self._build_step_fns()
 
@@ -173,6 +250,54 @@ class DeepSpeedTpuEngine:
             f"gas={self.gradient_accumulation_steps()}", ranks=[0])
 
     # ------------------------------------------------------------------ setup
+    def _apply_elasticity(self):
+        """Elastic batch config (reference elasticity/elasticity.py:233 via
+        runtime/config.py elasticity hookup): validate the current chip
+        count against the elastic config's valid set and, with
+        ``ignore_non_elastic_batch_info``, adopt the elastic
+        (batch, micro, gas) for this world size. Scale-up/down is
+        restart-based: universal checkpoints resume on any valid mesh."""
+        ec = self.config.elasticity
+        if not ec.enabled:
+            return
+        from ..elasticity import (ElasticityConfigError,
+                                  ElasticityIncompatibleWorldSize,
+                                  compute_elastic_config)
+
+        batch_keys_set = any(
+            isinstance(v, int) for v in (self.config.train_batch_size,
+                                         self.config.train_micro_batch_size_per_gpu,
+                                         self.config.gradient_accumulation_steps))
+        if batch_keys_set and not ec.ignore_non_elastic_batch_info:
+            raise ElasticityConfigError(
+                "elasticity is enabled but batch sizes are also set; remove "
+                "them or set elasticity.ignore_non_elastic_batch_info "
+                "(reference elasticity adopts the same all-or-nothing rule)")
+        world = self.topology.world_size
+        batch, valid, micro = compute_elastic_config(
+            {"elasticity": {
+                "enabled": True,
+                "max_train_batch_size": ec.max_train_batch_size,
+                "micro_batch_sizes": list(ec.micro_batch_sizes),
+                "min_gpus": ec.min_gpus, "max_gpus": ec.max_gpus,
+                "version": ec.version,
+                "prefer_larger_batch": ec.prefer_larger_batch,
+                "model_parallel_size": ec.model_parallel_size,
+                "num_gpus_per_node": ec.num_gpus_per_node}},
+            world_size=world, return_microbatch=True)
+        dp = self.topology.get_data_parallel_world_size()
+        if micro is None or batch % (micro * dp):
+            raise ElasticityIncompatibleWorldSize(
+                f"elastic batch {batch} unreachable with dp={dp} and micro "
+                f"candidates {list(ec.micro_batch_sizes)}")
+        self.config.train_batch_size = batch
+        self.config.train_micro_batch_size_per_gpu = micro
+        self.config.gradient_accumulation_steps = batch // (micro * dp)
+        log_dist(
+            f"elasticity: batch={batch} micro={micro} "
+            f"gas={self.config.gradient_accumulation_steps} "
+            f"valid_chips={valid}", ranks=[0])
+
     def _resolve_model(self, model):
         if model is None:
             raise ValueError("model is required")
@@ -285,6 +410,21 @@ class DeepSpeedTpuEngine:
                 step=self.plan.replicated(),
                 moments={mk: kept_shard for mk in opt_shapes.moments})
             opt_state = jax.jit(self.opt.init, out_shardings=o_shard)(kept)
+        elif self._onebit:
+            # Error-feedback moments are per-worker state: leading dp axis,
+            # sharded over the data mesh axis (ops/onebit.py contract).
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            dspec = NamedSharding(self.mesh, PartitionSpec("data"))
+            rep = self.plan.replicated()
+            opt_shapes = jax.eval_shape(self.opt.init, params)
+            o_moments = {
+                k: jax.tree.map(
+                    lambda _: dspec if k in self.opt.dp_moment_keys else rep,
+                    sub)
+                for k, sub in opt_shapes.moments.items()}
+            o_shard = OptimizerState(step=rep, moments=o_moments)
+            opt_state = jax.jit(self.opt.init, out_shardings=o_shard)(params)
         else:
             opt_shapes = jax.eval_shape(self.opt.init, params)
             o_shard = OptimizerState(
@@ -292,9 +432,26 @@ class DeepSpeedTpuEngine:
                 moments=self.plan.opt_state(opt_shapes.moments))
             opt_state = jax.jit(self.opt.init, out_shardings=o_shard)(params)
 
-        g_shard = self.plan.grads(shapes)
-        grad_acc = jax.jit(lambda: jax.tree.map(jnp.zeros_like, shapes),
-                           out_shardings=g_shard)()
+        if self._onebit:
+            # Per-worker (unreduced) gradient accumulators: leading dp axis
+            # sharded over 'data' — each worker accumulates its own grads;
+            # the optimizer's compressed collective does the averaging.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            dp = self.topology.get_data_parallel_world_size()
+            acc_shapes = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct((dp,) + s.shape, s.dtype),
+                shapes)
+            g_shard = jax.tree.map(
+                lambda _: NamedSharding(self.mesh, PartitionSpec("data")),
+                acc_shapes)
+            grad_acc = jax.jit(
+                lambda: jax.tree.map(jnp.zeros_like, acc_shapes),
+                out_shardings=g_shard)()
+        else:
+            g_shard = self.plan.grads(shapes)
+            grad_acc = jax.jit(lambda: jax.tree.map(jnp.zeros_like, shapes),
+                               out_shardings=g_shard)()
 
         scale0 = (2.0 ** self.config.fp16.initial_scale_power
                   if self.dynamic_loss_scale else self._static_scale)
@@ -334,11 +491,15 @@ class DeepSpeedTpuEngine:
         self._state_shardings = state_shardings
         batch_sharding = plan.batch()
 
+        compression = self._compression
+
         def micro(state: TrainState, batch, rng):
             """fwd + bwd + accumulate (one micro batch)."""
             scale = state.scale_state.scale
 
             def loss_fn(params):
+                if compression is not None:   # QAT/pruning: STE to masters
+                    params = compression(params, state.global_step)
                 loss = module.loss(params, batch, rng)
                 return (loss * scale / (dp_size if predivide else 1.0)).astype(jnp.float32), loss
 
@@ -440,6 +601,104 @@ class DeepSpeedTpuEngine:
                        "loss_scale": state.scale_state.scale}
             return new_state, off_grads, metrics
 
+        if getattr(self, "_onebit", False):
+            # 1-bit optimizer path: the whole micro/update runs inside
+            # shard_map over the data axis so gradients stay per-worker
+            # (unreduced) and the optimizer owns the compressed collective
+            # (ops/onebit.py; reference onebit optimizers likewise take over
+            # the engine's allreduce). Two compiled update programs — full-
+            # precision warmup vs int8-compressed — dispatched host-side on
+            # freeze_step, so no traced branch wraps the collectives.
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            mesh = self.mesh
+            is_shard = lambda x: isinstance(x, jax.sharding.Sharding)  # noqa: E731
+            state_specs = jax.tree.map(lambda s: s.spec, state_shardings,
+                                       is_leaf=is_shard)
+
+            def micro_onebit(state: TrainState, batch, rng):
+                def shard_fn(state, batch, rng):
+                    scale = state.scale_state.scale
+
+                    def loss_fn(params):
+                        if compression is not None:
+                            params = compression(params, state.global_step)
+                        loss = module.loss(params, batch, rng)
+                        return (loss * scale).astype(jnp.float32), loss
+
+                    grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+                    grad_acc = jax.tree.map(
+                        lambda a, g: a + g[None].astype(a.dtype),
+                        state.grad_acc, grads)
+                    loss = lax.pmean(loss, "data")
+                    return state._replace(grad_acc=grad_acc), loss
+
+                return shard_map(
+                    shard_fn, mesh=mesh,
+                    in_specs=(state_specs, P("data"), P()),
+                    out_specs=(state_specs, P()),
+                    check_vma=False)(state, batch, rng)
+
+            opt_dp = self.topology.get_data_parallel_world_size()
+
+            def make_update_onebit(compressed: bool):
+                step_fn = (opt.compressed_step_local if compressed
+                           else opt.warmup_step_local)
+
+                def update_onebit(state: TrainState):
+                    def shard_fn(state):
+                        scale = state.scale_state.scale
+                        denom = scale * gas
+                        local = jax.tree.map(lambda a: a[0] / denom,
+                                             state.grad_acc)
+                        # Root-mean of per-worker squared norms: an upper
+                        # bound on the averaged-grad norm costing one scalar
+                        # psum (the exact norm would need the full-precision
+                        # gradient psum this path exists to avoid) — see
+                        # ops/onebit.py "Documented divergences".
+                        sumsq = sum(
+                            jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in jax.tree.leaves(local))
+                        gnorm = jnp.sqrt(lax.psum(sumsq, "data") / opt_dp)
+                        overflow = ~jnp.isfinite(gnorm)
+                        if clip > 0:
+                            coeff = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                            local = jax.tree.map(lambda g: g * coeff, local)
+                        lr = schedule(state.global_step)
+                        # No lax.cond around the optimizer here: its branches
+                        # would trap collectives inside a conditional. Run
+                        # the step unconditionally, select on overflow.
+                        new_p, new_opt = step_fn(state.params, local,
+                                                 state.opt_state, lr)
+                        pick = lambda n, o: jnp.where(overflow, o, n)  # noqa: E731
+                        new_p = jax.tree.map(pick, new_p, state.params)
+                        new_opt = jax.tree.map(pick, new_opt, state.opt_state)
+                        new_state = book_keeping(state, new_p,
+                                                 new_opt, overflow)
+                        metrics = {"grad_norm": gnorm, "lr": lr,
+                                   "overflow": overflow,
+                                   "loss_scale": state.scale_state.scale}
+                        return new_state, metrics
+
+                    return shard_map(
+                        shard_fn, mesh=mesh,
+                        in_specs=(state_specs,),
+                        out_specs=(state_specs,
+                                   {"grad_norm": P(), "lr": P(),
+                                    "overflow": P(), "loss_scale": P()}),
+                        check_vma=False)(state)
+
+                return update_onebit
+
+            micro = micro_onebit
+            update = make_update_onebit(compressed=True)
+            self._update_warm_raw = make_update_onebit(compressed=False)
+            self._update_warm_fn = jax.jit(
+                self._update_warm_raw,
+                out_shardings=(state_shardings, None),
+                donate_argnums=(0,))
+
         # NOTE: no in_shardings on any of these jits. The state/batch arrays
         # are committed with the plan's shardings already (init runs under
         # out_shardings; batches via device_put), so jit infers identical
@@ -471,7 +730,10 @@ class DeepSpeedTpuEngine:
                 donate_argnums=(0,))
 
         def eval_step(state: TrainState, batch, rng):
-            return module.loss(state.params, batch, None)
+            params = state.params
+            if compression is not None:
+                params = compression(params, state.global_step)
+            return module.loss(params, batch, None)
 
         self._eval_fn = jax.jit(eval_step)
 
@@ -530,6 +792,12 @@ class DeepSpeedTpuEngine:
                     in_shardings=(out_state_fmt,),
                     out_shardings=(out_state_fmt, None),
                     donate_argnums=(0,))
+                if getattr(self, "_onebit", False):
+                    self._update_warm_fn = jax.jit(
+                        self._update_warm_raw,
+                        in_shardings=(out_state_fmt,),
+                        out_shardings=(out_state_fmt, None),
+                        donate_argnums=(0,))
             log_dist("layout autotune: state pinned to XLA-preferred formats",
                      ranks=[0])
         except Exception as exc:  # pragma: no cover - depends on backend
@@ -605,6 +873,10 @@ class DeepSpeedTpuEngine:
             return
         if self._offload_plan is not None:
             metrics = self._offload_step()
+        elif self._onebit and self.global_steps < self.opt.freeze_step:
+            # Warmup phase: full-precision momentum/variance build-up
+            # (host-dispatched — see _build_step_fns onebit path).
+            self.state, metrics = self._update_warm_fn(self.state)
         else:
             self.state, metrics = self._update_fn(self.state)
         self.global_steps += 1
@@ -678,6 +950,8 @@ class DeepSpeedTpuEngine:
         seq_len = None
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(it)
+            if self.curriculum_scheduler is not None:
+                batch = self._apply_curriculum(batch)
             if profiling and seq_len is None and isinstance(batch, dict):
                 seq_len = int(np.asarray(batch["input_ids"]).shape[-1]) - 1
             losses.append(self.forward(batch))
@@ -699,6 +973,38 @@ class DeepSpeedTpuEngine:
             else:
                 print(report)
         return jnp.mean(jnp.stack(losses))
+
+    def _apply_curriculum(self, batch):
+        """Seqlen curriculum: truncate the token batch to the scheduled
+        difficulty (reference engine curriculum path; difficulty_step
+        quantization bounds the number of distinct compiled shapes)."""
+        difficulty = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+        if not isinstance(batch, dict) or "input_ids" not in batch:
+            return batch
+        ids = batch["input_ids"]
+        seq = int(np.asarray(ids).shape[-1]) - 1
+        if difficulty >= seq:
+            return batch
+        out = dict(batch)
+        for key in ("input_ids", "labels", "attention_mask"):
+            if key in out:
+                out[key] = np.asarray(out[key])[..., :difficulty + 1]
+        return out
+
+    def set_compression(self, transform):
+        """Attach a CompressionTransform after construction (the
+        ``init_compression(engine, config)`` path — reference
+        compression/compress.py:100) and rebuild the step programs."""
+        self._compression = transform if transform else None
+        self._build_step_fns()
+        self._layouts_tuned = False
+
+    def set_custom_curriculum_learning_schedule(self, schedule_fn):
+        """Reference engine.py set_custom_curriculum_learning_schedule."""
+        if self.curriculum_scheduler is None:
+            raise RuntimeError("curriculum_learning is not enabled")
+        self.curriculum_scheduler.set_custom_get_difficulty(schedule_fn)
 
     def eval_batch(self, batch):
         batch = self._device_batch(batch) if not self._is_device_batch(batch) else batch

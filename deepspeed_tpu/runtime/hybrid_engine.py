@@ -1,0 +1,143 @@
+"""Hybrid (RLHF) engine: one engine that trains AND generates.
+
+Counterpart of reference ``runtime/hybrid_engine.py``
+(``DeepSpeedHybridEngine`` :31): RLHF alternates generate-heavy rollout
+phases with ZeRO-3 training steps on the same weights. The torch version
+maintains a second set of injected inference modules, manually gathers
+ZeRO-3 partitions around ``generate`` (``GatheredParameters``), fuses/
+unfuses LoRA, and swaps module forwards in and out.
+
+TPU-native design: in a functional runtime the flip is a *sharding*
+operation, not a module surgery. Training owns fp32 masters sharded by the
+ZeRO plan; ``generate()`` feeds a bf16 view of those same masters to the
+compiled inference program whose in_shardings are the serving layout
+(TP-sharded / replicated) — XLA inserts exactly the all-gather the
+reference performs manually, and "releasing" the inference copy is
+dropping a reference (``release_inference_cache``). The serving view is
+cached and invalidated per optimizer step, mirroring the reference's
+``retake_inference_cache`` lifecycle. Latency accounting keeps the
+reference's generate/train split (hybrid_engine.py ``generate`` :174 /
+``step`` :430 stats).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .engine import DeepSpeedTpuEngine
+
+
+class DeepSpeedTpuHybridEngine(DeepSpeedTpuEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        hc = self.config.hybrid_engine
+        self._he_cfg = hc
+        self._infer_engine = None
+        self._infer_params_step = -1
+        self._in_train_mode = True
+        # reference perf stats (hybrid_engine.py:56)
+        self._generate_latency = 0.0
+        self._training_latency = 0.0
+        self._iters = 0
+        self._training_start_time = None
+        log_dist(
+            f"HybridEngine: max_out_tokens={hc.max_out_tokens} "
+            f"inference_tp_size={hc.inference_tp_size} "
+            f"release_inference_cache={hc.release_inference_cache}",
+            ranks=[0])
+
+    # ------------------------------------------------------------ inference
+    def _serving_module(self):
+        from ..models.transformer import CausalLM
+
+        if not isinstance(self.module, CausalLM):
+            raise ValueError("hybrid engine generate() needs a framework "
+                             "CausalLM (reference requires an injectable "
+                             "HF model the same way)")
+        dtype = (self.compute_dtype if self.compute_dtype != jnp.float32
+                 else jnp.bfloat16)
+        cfg = dataclasses.replace(self.module.cfg, dtype=dtype, remat=False)
+        return CausalLM(cfg), dtype
+
+    def _inference_engine(self):
+        if self._infer_engine is None:
+            from ..inference.engine import InferenceEngine
+
+            module, dtype = self._serving_module()
+            self._infer_engine = InferenceEngine(
+                model=module, params=self._cast_params(dtype),
+                mesh=self.topology,
+                config={"dtype": "bf16" if dtype == jnp.bfloat16
+                        else str(self.precision.value)})
+            self._infer_params_step = self.global_steps
+        return self._infer_engine
+
+    def _cast_params(self, dtype):
+        return jax.tree.map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype,
+                                                        jnp.floating) else p,
+            self.state.params)
+
+    def _sync_inference_params(self):
+        """Refresh the serving view iff a training step happened since the
+        last generate (reference gathers partitions at each generate; here
+        the gather is XLA resharding of the cast masters)."""
+        eng = self._inference_engine()
+        if self._infer_params_step != self.global_steps:
+            _, dtype = self._serving_module()
+            cast = self._cast_params(dtype)
+            eng.params = jax.tree.map(jax.device_put, cast,
+                                      eng.plan.params(cast))
+            self._infer_params_step = self.global_steps
+        return eng
+
+    # ------------------------------------------------------------------ API
+    def generate(self, input_ids, max_new_tokens: Optional[int] = None,
+                 **kwargs) -> Any:
+        """Rollout generate on the current training weights (reference
+        hybrid_engine.py:174)."""
+        t0 = time.perf_counter()
+        eng = self._sync_inference_params()
+        max_new = max_new_tokens or self._he_cfg.max_out_tokens
+        out = eng.generate(input_ids, max_new_tokens=max_new, **kwargs)
+        jax.block_until_ready(out)
+        self._generate_latency += time.perf_counter() - t0
+        self._iters += 1
+        if self._he_cfg.release_inference_cache:
+            self._infer_engine = None       # drop the serving copy + cache
+            self._infer_params_step = -1
+        return out
+
+    def eval(self):
+        """Flip to rollout mode (reference :382): start the generate phase
+        clock; training latency accumulates between train() and eval()."""
+        if self._in_train_mode and self._training_start_time is not None:
+            self._training_latency += time.perf_counter() - self._training_start_time
+            self._training_start_time = None
+        self._in_train_mode = False
+        return self
+
+    def train(self, mode: bool = True):
+        """Flip back to training (reference :418)."""
+        self._in_train_mode = mode
+        if mode and self._training_start_time is None:
+            self._training_start_time = time.perf_counter()
+        return self
+
+    def step(self):
+        metrics = super().step()
+        # a new optimizer step invalidates the cached serving view lazily
+        # (next generate re-syncs); nothing to un-fuse in a functional world
+        return metrics
+
+    def latency_stats(self):
+        """Reference's per-phase wall-clock split."""
+        return {"generate_latency_s": self._generate_latency,
+                "training_latency_s": self._training_latency,
+                "generate_iters": self._iters}

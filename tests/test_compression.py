@@ -1,0 +1,168 @@
+"""Compression suite tests (reference tests/unit/compression/test_compression.py):
+quantizer/pruner numerics, STE gradients, config-driven transform matching,
+QAT end-to-end through the engine, and redundancy_clean export."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (
+    CompressionTransform, channel_prune, head_prune, init_compression,
+    quantize_activation, quantize_weight, redundancy_clean, row_prune,
+    sparse_prune, student_initialization, sym_quantize, topk_binarize)
+from deepspeed_tpu.models import build_model
+
+
+# ------------------------------------------------------------- primitives
+def test_sym_quantize_levels_and_ste():
+    x = jnp.linspace(-1.0, 1.0, 64)
+    q = sym_quantize(x, 4, 1)
+    assert len(np.unique(np.asarray(q))) <= 2 ** 4
+    np.testing.assert_allclose(np.asarray(q), np.asarray(x), atol=0.15)
+    # STE: gradient of sum(quantize(x)) is all-ones
+    g = jax.grad(lambda v: sym_quantize(v, 4, 1).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_quantize_groups_independent_scales():
+    x = jnp.concatenate([jnp.ones(8) * 0.01, jnp.ones(8) * 100.0])
+    q1 = quantize_weight(x, 8, num_groups=1)
+    q2 = quantize_weight(x, 8, num_groups=2)
+    # one shared scale crushes the small half; per-group scales keep it
+    assert np.abs(np.asarray(q2[:8]) - 0.01).max() < 1e-4
+    assert np.abs(np.asarray(q1[:8]) - 0.01).max() > 1e-4
+
+
+def test_sparse_prune_ratio():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(32, 32)))
+    out = np.asarray(sparse_prune(w, ratio=0.75))
+    kept = (out != 0).mean()
+    assert 0.2 <= kept <= 0.3
+    # survivors are the largest magnitudes
+    assert np.abs(out).max() == np.abs(np.asarray(w)).max()
+
+
+def test_row_and_channel_prune_structured():
+    w = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16, 8)))
+    r = np.asarray(row_prune(w, ratio=0.5))
+    zero_rows = (np.abs(r).sum(-1) == 0).sum(axis=-1)
+    np.testing.assert_array_equal(zero_rows, 8)      # half the 16 rows, per layer
+    c = np.asarray(channel_prune(w, ratio=0.25))
+    zero_ch = (np.abs(c).sum(-2) == 0).sum(axis=-1)
+    np.testing.assert_array_equal(zero_ch, 2)        # quarter of 8 channels
+
+
+@pytest.mark.parametrize("axis", ["in", "out"])
+def test_head_prune(axis):
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(16, 16)))
+    out = np.asarray(head_prune(w, ratio=0.5, num_heads=4, axis=axis))
+    g = out.reshape(4, 4, 16) if axis == "in" else \
+        out.transpose(1, 0).reshape(4, 4, 16)
+    zeroed = sum(1 for h in range(4) if np.abs(g[h]).sum() == 0)
+    assert zeroed == 2
+
+
+def test_quantize_activation():
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(128,)))
+    q8 = quantize_activation(x, 8)
+    assert np.abs(np.asarray(q8) - np.asarray(x)).max() < 0.05
+    g = jax.grad(lambda v: quantize_activation(v, 8).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+# ---------------------------------------------------------------- transform
+def qat_config(offset=0):
+    return {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": offset},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 6, "quantize_groups": 1},
+                        "modules": ["layers.*"]}}}}}
+
+
+def test_transform_matches_modules_and_offset():
+    w = np.random.default_rng(0).normal(size=(8, 8)).astype(np.float32)
+    params = {"layers": {"w": jnp.asarray(w)},
+              "embed": {"wte": jnp.asarray(w)}}
+    t = CompressionTransform(qat_config(offset=5))
+    out_before = t(params, global_step=0)
+    out_after = t(params, global_step=10)
+    # before offset: untouched; after: layers quantized, embed untouched
+    np.testing.assert_allclose(np.asarray(out_before["layers"]["w"]), w)
+    assert not np.allclose(np.asarray(out_after["layers"]["w"]), w)
+    np.testing.assert_allclose(np.asarray(out_after["embed"]["wte"]), w)
+
+
+def test_redundancy_clean():
+    params = {"layers": {"w": jnp.asarray(
+        np.random.default_rng(0).normal(size=(16, 16)))}}
+    cleaned = redundancy_clean(params, {"compression_training": {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["*"]}}}}})
+    assert (np.asarray(cleaned["layers"]["w"]) == 0).mean() >= 0.45
+
+
+def test_student_initialization():
+    params = {"layers": {"w": jnp.arange(6, dtype=jnp.float32)[:, None]
+                         * jnp.ones((6, 3))},
+              "embed": {"wte": jnp.ones((4, 3))}}
+    student = student_initialization(params, keep_layers=[0, 3, 5])
+    assert student["layers"]["w"].shape == (3, 3)
+    np.testing.assert_allclose(np.asarray(student["layers"]["w"][:, 0]),
+                               [0.0, 3.0, 5.0])
+    assert student["embed"]["wte"].shape == (4, 3)
+
+
+# ------------------------------------------------------------ engine (QAT)
+def test_engine_qat_trains(devices8):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": -1, "fsdp": 2},
+        "steps_per_print": 10**9,
+    }
+    cfg.update(qat_config(offset=0))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=cfg)
+    assert engine._compression
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, size=(32, 33),
+                                       dtype=np.int64)}
+    losses = [float(engine.train_batch(itertools.repeat(batch)))
+              for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # exported (cleaned) layer weights quantize to <= 2^6 distinct levels
+    cleaned = engine._compression.clean(engine.state.params)
+    w = np.asarray(jax.tree.leaves(cleaned["layers"])[0])
+    assert len(np.unique(w[0] if w.ndim == 3 else w)) <= 2 ** 6
+
+
+def test_init_compression_engine_api(devices8):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": -1, "fsdp": 1},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=cfg)
+    assert engine._compression is None
+    init_compression(engine, qat_config(offset=0))
+    assert engine._compression
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, size=(16, 33),
+                                       dtype=np.int64)}
+    loss = engine.train_batch(itertools.repeat(batch))
+    assert np.isfinite(float(loss))

@@ -1,0 +1,212 @@
+"""Data efficiency pipeline tests (reference
+tests/unit/runtime/test_data_efficiency.py): curriculum schedules, the
+curriculum sampler's difficulty gating, the analyzer's map-reduce output,
+random-LTD gather/scatter + gradients, and engine seqlen curriculum."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler, DataAnalyzer, DeepSpeedDataSampler,
+    RandomLTDScheduler, apply_random_ltd, sample_token_indices)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import metric_seqlen
+
+
+# ---------------------------------------------------------------- scheduler
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == (8 + 28) // 8 * 8   # quantized midpoint
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10**6) == 64
+
+
+def test_fixed_root_grows_faster_early():
+    lin = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 512,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 1000,
+                            "difficulty_step": 8}})
+    root = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 512,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 1000,
+                            "difficulty_step": 8, "root_degree": 2}})
+    assert root.get_difficulty(100) > lin.get_difficulty(100)
+    assert root.get_difficulty(1000) == lin.get_difficulty(1000) == 512
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 3,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]}})
+    assert s.get_difficulty(3) == 1
+    assert s.get_difficulty(7) == 2
+    assert s.get_difficulty(11) == 3
+
+
+def test_custom_schedule():
+    s = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 100,
+        "schedule_type": "custom"})
+    s.set_custom_get_difficulty(lambda step: step * 2)
+    assert s.get_difficulty(21) == 42
+
+
+# ------------------------------------------------------------------ sampler
+def sampler_config(enabled=True):
+    return {
+        "seed": 7,
+        "data_sampling": {
+            "num_epochs": 4,
+            "curriculum_learning": {
+                "enabled": enabled,
+                "metrics": {
+                    "seqlen": {
+                        "min_difficulty": 4, "max_difficulty": 64,
+                        "schedule_type": "fixed_linear",
+                        "difficulty_type": "value",
+                        "schedule_config": {"total_curriculum_step": 10,
+                                            "difficulty_step": 4},
+                    }
+                },
+            },
+        },
+    }
+
+
+def test_sampler_gates_by_difficulty():
+    lengths = np.arange(1, 101)           # sample i has "seqlen" i+1
+    sampler = DeepSpeedDataSampler(
+        sampler_config(), one_epoch_total_samples=100, micro_batch_size=4,
+        data_parallel_size=2, gradient_accumulation_steps=1,
+        metric_values={"seqlen": lengths})
+    it = iter(sampler)
+    first = next(it)                       # step 1: difficulty near min (4)
+    assert first.shape == (8,)
+    assert lengths[first].max() <= 8
+    for _ in range(12):                    # run past total_curriculum_step
+        batch = next(it)
+    assert lengths[batch].max() > 8        # pool opened up
+
+
+def test_sampler_resume_deterministic():
+    lengths = np.arange(1, 101)
+    mk = lambda: DeepSpeedDataSampler(      # noqa: E731
+        sampler_config(), 100, 4, 2, 1, metric_values={"seqlen": lengths})
+    a = mk()
+    it_a = iter(a)
+    batches = [next(it_a) for _ in range(5)]
+    state = a.state_dict()
+
+    b = mk()
+    b.load_state_dict(state)
+    cont_a = next(it_a)
+    cont_b = next(iter(b))
+    np.testing.assert_array_equal(cont_a, cont_b)
+
+
+# ----------------------------------------------------------------- analyzer
+def test_analyzer_map_reduce(tmp_path):
+    data = [{"input_ids": np.zeros(int(n), dtype=np.int64)}
+            for n in [5, 3, 9, 1, 7, 2]]
+    for wid in range(2):
+        DataAnalyzer(data, {"seqlen": metric_seqlen}, str(tmp_path),
+                     num_workers=2, worker_id=wid).run_map()
+    out = DataAnalyzer(data, {"seqlen": metric_seqlen}, str(tmp_path),
+                       num_workers=2, worker_id=0).run_reduce()
+    values = np.load(out["seqlen"]["values"])
+    np.testing.assert_array_equal(values, [5, 3, 9, 1, 7, 2])
+    order = np.load(out["seqlen"]["index_by_value"])
+    np.testing.assert_array_equal(values[order], sorted(values))
+
+
+# --------------------------------------------------------------- random-LTD
+def test_random_ltd_gather_scatter_identity():
+    rng = jax.random.PRNGKey(0)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    idx = sample_token_indices(rng, num_layers=3, batch=2, seq=8, reserved=5)
+    assert idx.shape == (3, 2, 5)
+    # sorted ascending, unique per row
+    assert bool((jnp.diff(idx, axis=-1) > 0).all())
+    out = apply_random_ltd(lambda part: part, x, idx[0])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_random_ltd_layer_only_touches_sampled_tokens():
+    rng = jax.random.PRNGKey(1)
+    x = jnp.ones((2, 8, 4))
+    idx = sample_token_indices(rng, 1, 2, 8, reserved=3)[0]
+    out = apply_random_ltd(lambda p: p * 10.0, x, idx)
+    touched = np.zeros((2, 8), dtype=bool)
+    for b in range(2):
+        touched[b, np.asarray(idx[b])] = True
+    np.testing.assert_allclose(np.asarray(out)[touched], 10.0)
+    np.testing.assert_allclose(np.asarray(out)[~touched], 1.0)
+
+
+def test_random_ltd_gradients_flow():
+    rng = jax.random.PRNGKey(2)
+    idx = sample_token_indices(rng, 1, 1, 6, reserved=3)[0]
+    w = jnp.ones((4, 4))
+
+    def loss(w, x):
+        return apply_random_ltd(lambda p: p @ w, x, idx).sum()
+
+    g = jax.grad(loss)(w, jnp.ones((1, 6, 4)))
+    assert np.isfinite(np.asarray(g)).all() and np.abs(g).sum() > 0
+
+
+def test_random_ltd_scheduler():
+    s = RandomLTDScheduler({
+        "random_ltd_schedule": {
+            "min_value": 16, "max_value": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"seq_per_step": 16, "require_steps": 10}}})
+    assert s.update_seq(0) == 16
+    assert s.update_seq(10) == 32
+    assert s.update_seq(1000) == 64
+    st = s.state_dict()
+    s2 = RandomLTDScheduler({"min_value": 16, "max_value": 64})
+    s2.load_state_dict(st)
+    assert s2.get_current_seq() == 64
+
+
+# ----------------------------------------------------------- engine wiring
+def test_engine_seqlen_curriculum(devices8):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": -1, "fsdp": 1},
+        "steps_per_print": 10**9,
+        "curriculum_learning": {
+            "enabled": True,
+            "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8},
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=cfg)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, size=(16, 33),
+                                       dtype=np.int64)}
+    for _ in range(6):
+        loss = engine.train_batch(itertools.repeat(batch))
+        assert np.isfinite(float(loss))
+    # schedule exhausted: difficulty at max (= full 32-token sequence)
+    assert engine.curriculum_scheduler.get_current_difficulty() == 32

@@ -1,0 +1,138 @@
+"""Elasticity tests (reference tests/unit/elasticity/test_elastic.py):
+compatible batch/chip-count algebra (v0.1/v0.2), engine adoption of the
+elastic batch config, and a restart-based scale-down resume — checkpoint on
+8 chips, resume on a 4-chip mesh with the same global batch."""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.parallel.topology as topo
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config,
+                                      get_compatible_chips_v01,
+                                      get_compatible_chips_v02)
+from deepspeed_tpu.models import build_model
+
+
+def test_v01_picks_most_compatible_batch():
+    batch, valid = get_compatible_chips_v01([2, 4, 6], 2000)
+    # lcm=12 scaled by the largest HCN fitting 2000 → 1680 = 840 slots at
+    # micro 2: the divisor-richest candidate.
+    assert batch == 1680
+    assert 8 in valid and 7 in valid        # 840 % 7 == 0
+    assert 11 not in valid
+    for chips in valid:
+        assert any(batch % (m * chips) == 0 for m in (2, 4, 6))
+
+
+def test_v01_prefer_smaller():
+    b_large, _ = get_compatible_chips_v01([2, 4], 100, prefer_larger=True)
+    b_small, _ = get_compatible_chips_v01([2, 4], 100, prefer_larger=False)
+    assert b_small <= b_large
+
+
+def test_v01_micro_exceeds_max_raises():
+    with pytest.raises(ElasticityConfigError):
+        get_compatible_chips_v01([64], 32)
+
+
+def test_v02_model_parallel_host_granularity():
+    batch, valid_dp, micro = get_compatible_chips_v02(
+        [2, 4], 1000, current_num_chips=8, chips_per_host=4,
+        model_parallel_size=2)
+    # dp = chips/mp = 4, dp_per_host = 2: valid dp worlds are host multiples
+    assert all(v % 2 == 0 for v in valid_dp)
+    assert micro in (2, 4)
+    assert batch % (micro * 4) == 0         # reachable on the current dp=4
+
+
+def test_v02_incompatible_world_falls_back_to_current():
+    batch, valid, micro = get_compatible_chips_v02(
+        [5], 100, current_num_chips=7, chips_per_host=1)
+    assert valid == [7]
+    assert batch == 5 * 7 * (100 // 35)
+    assert micro == 5
+
+
+def test_compute_elastic_config_v01_world_check():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "version": 0.1}}
+    batch, valid = compute_elastic_config(cfg)
+    assert batch == 1680
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=11)
+
+
+def elastic_engine_config():
+    return {
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": -1, "fsdp": 1},
+        "steps_per_print": 10**9,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 96,
+            "micro_batch_sizes": [2, 4],
+            "version": 0.2,
+            "ignore_non_elastic_batch_info": True,
+        },
+    }
+
+
+def test_engine_adopts_elastic_batch(devices8):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=elastic_engine_config())
+    cfg = engine.config
+    dp = engine.topology.get_data_parallel_world_size()
+    assert cfg.train_batch_size == \
+        cfg.train_micro_batch_size_per_gpu * cfg.gradient_accumulation_steps * dp
+    assert cfg.train_micro_batch_size_per_gpu in (2, 4)
+    assert cfg.train_batch_size <= 96
+
+
+def test_engine_rejects_explicit_batch_with_elasticity(devices8):
+    cfg = elastic_engine_config()
+    cfg["train_micro_batch_size_per_gpu"] = 4
+    cfg["elasticity"]["ignore_non_elastic_batch_info"] = False
+    with pytest.raises(Exception, match="elasticity"):
+        deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
+
+
+def test_elastic_restart_scale_down(tmp_path, devices8):
+    """Checkpoint on the 8-chip mesh, resume on a 4-chip mesh: the elastic
+    global batch is unchanged (gas doubles), params match bit-for-bit, and
+    training continues finitely — the reference's restart-based elastic
+    scale-down (DSElasticAgent role) driven through universal checkpoints."""
+    def run(engine, steps, seed=0):
+        rng = np.random.default_rng(seed)
+        dp = engine.topology.get_data_parallel_world_size()
+        micro = engine.config.train_micro_batch_size_per_gpu
+        batch = {"input_ids": rng.integers(
+            0, 256, size=(micro * dp, 33), dtype=np.int64)}
+        return [float(engine.train_batch(itertools.repeat(batch)))
+                for _ in range(steps)]
+
+    e8, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=elastic_engine_config())
+    batch8 = e8.config.train_batch_size
+    run(e8, 2)
+    e8.save_checkpoint(str(tmp_path))
+    ref = [np.asarray(l) for l in jax.tree.leaves(e8.state.params)]
+
+    topo.reset_topology()
+    mesh4 = topo.MeshTopology.build(None, devices=jax.devices()[:4])
+    e4, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=elastic_engine_config(), mesh=mesh4)
+    assert e4.config.train_batch_size == batch8       # global batch invariant
+    assert e4.config.gradient_accumulation_steps == \
+        2 * e8.config.gradient_accumulation_steps
+    e4.load_checkpoint(str(tmp_path))
+    for a, b in zip(ref, jax.tree.leaves(e4.state.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+    losses = run(e4, 2, seed=7)
+    assert np.isfinite(losses).all()

@@ -1,0 +1,85 @@
+"""Hybrid (RLHF) engine tests (reference
+tests/unit/hybrid_engine/test_he_*): one engine alternates ZeRO-3 training
+with generate rollouts on the same weights; the serving view tracks
+training steps."""
+
+import itertools
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedTpuHybridEngine
+
+
+def make_engine(release_cache=False):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3},
+        "mesh": {"data": -1, "fsdp": 2},
+        "steps_per_print": 10**9,
+        "hybrid_engine": {"enabled": True, "max_out_tokens": 8,
+                          "release_inference_cache": release_cache},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=cfg)
+    return engine
+
+
+def train_steps(engine, n, seed=0):
+    rng = np.random.default_rng(seed)
+    dp = engine.topology.get_data_parallel_world_size()
+    batch = {"input_ids": rng.integers(0, 256, size=(2 * dp, 33),
+                                       dtype=np.int64)}
+    return [float(engine.train_batch(itertools.repeat(batch)))
+            for _ in range(n)]
+
+
+def test_initialize_selects_hybrid_engine(devices8):
+    engine = make_engine()
+    assert isinstance(engine, DeepSpeedTpuHybridEngine)
+
+
+def test_rlhf_train_generate_loop(devices8):
+    engine = make_engine()
+    prompts = np.random.default_rng(0).integers(0, 256, size=(4, 8))
+
+    losses = train_steps(engine, 2)
+    engine.eval()
+    out1 = np.asarray(engine.generate(prompts, max_new_tokens=4))
+    assert out1.shape == (4, 12)
+    engine.train()
+    train_steps(engine, 2, seed=1)
+    engine.eval()
+    out2 = np.asarray(engine.generate(prompts, max_new_tokens=4))
+    assert out2.shape == (4, 12)
+    assert np.isfinite(losses).all()
+
+    stats = engine.latency_stats()
+    assert stats["generate_iters"] == 2
+    assert stats["generate_latency_s"] > 0
+    assert stats["training_latency_s"] > 0
+
+
+def test_serving_view_tracks_training(devices8):
+    engine = make_engine()
+    train_steps(engine, 1)
+    eng = engine._sync_inference_params()
+    before = np.asarray(jax.tree.leaves(eng.params)[0]).copy()
+    train_steps(engine, 3, seed=2)
+    eng = engine._sync_inference_params()
+    after = np.asarray(jax.tree.leaves(eng.params)[0])
+    assert not np.allclose(before, after), \
+        "serving params did not refresh after training steps"
+
+
+def test_release_inference_cache(devices8):
+    engine = make_engine(release_cache=True)
+    train_steps(engine, 1)
+    prompts = np.zeros((2, 4), dtype=np.int64)
+    engine.generate(prompts, max_new_tokens=2)
+    assert engine._infer_engine is None     # dropped after each rollout

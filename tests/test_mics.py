@@ -1,0 +1,82 @@
+"""MiCS tests (reference tests/unit/runtime/zero/test_mics_optimizer.py):
+mics_shard_size shards params over a sub-group (the fsdp mesh axis) and
+replicates across the data axis, instead of sharding over the full DP world
+(runtime/zero/mics.py:55)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_model
+
+
+def tiny_data(n=64, seq=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(n, seq + 1),
+                                      dtype=np.int64)}
+
+
+def make_config(shard_size, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3, "mics_shard_size": shard_size},
+        "mesh": {"data": -1, "fsdp": 1},
+        "steps_per_print": 100,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(engine, data, steps=4):
+    loader = deepspeed_tpu.runtime.dataloader.RepeatingLoader(
+        engine.deepspeed_io(data))
+    it = iter(loader)
+    losses = []
+    for _ in range(steps):
+        loss = engine(next(it))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_mics_shapes_mesh_and_trains(devices8):
+    """shard_size=4 on 8 devices → fsdp=4 (shard group) × data=2 (replicas);
+    params are sharded over fsdp only, so each shard lives on 2 devices."""
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"), config=make_config(4))
+    assert engine.mesh.shape["fsdp"] == 4
+    assert engine.mesh.shape["data"] == 2
+
+    # Largest param: sharded over the 4-way group, replicated over data.
+    leaves = jax.tree.leaves(engine.state.params)
+    big = max(leaves, key=lambda p: p.size)
+    spec_axes = {a for axes in big.sharding.spec if axes
+                 for a in (axes if isinstance(axes, tuple) else (axes,))}
+    assert "fsdp" in spec_axes and "data" not in spec_axes, big.sharding
+    # replication factor 2: 4 distinct shards, each held by 2 of 8 devices
+    assert len(big.sharding.device_set) == 8
+    idx_map = big.sharding.devices_indices_map(big.shape)
+    distinct = {tuple((s.start, s.stop) for s in idx) for idx in
+                idx_map.values()}
+    assert len(distinct) == 4, f"expected 4 distinct shards: {distinct}"
+
+    losses = run_steps(engine, tiny_data(), steps=5)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_mics_requires_stage3(devices8):
+    cfg = make_config(4)
+    cfg["zero_optimization"]["stage"] = 2
+    with pytest.raises(ValueError, match="stage=3"):
+        deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
+
+
+def test_mics_rejects_conflicting_mesh(devices8):
+    cfg = make_config(4, mesh={"data": -1, "fsdp": 2})
+    with pytest.raises(ValueError, match="conflicts with the mesh"):
+        deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
