@@ -93,6 +93,144 @@ class Accelerator(abc.ABC):
 
         return jnp.float16 in self.supported_dtypes()
 
+    # ------------------------------------------------------- current device
+    def current_device(self) -> int:
+        """Reference current_device(): JAX is single-controller — the
+        'current device' notion maps to local device 0."""
+        return 0
+
+    def current_device_name(self) -> str:
+        return self.device_name(0)
+
+    def set_device(self, index: int) -> None:
+        """No-op: placement is sharding-driven under XLA (the reference's
+        CPU accelerator no-ops this the same way)."""
+
+    def device(self, index: int = 0):
+        """The device object itself (reference returns a torch.device)."""
+        return self.local_devices()[index]
+
+    # ------------------------------------------------------ streams/events
+    # XLA orders device work by data dependence; there is no user-visible
+    # stream/event surface. These shims keep the reference's ~15
+    # stream/event methods callable (its cpu_accelerator no-ops them too):
+    # Stream()/Event() return None, waits are immediate, synchronize() is
+    # block_until_ready.
+    def Stream(self, *a, **kw):
+        return None
+
+    def stream(self, stream):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def current_stream(self, *a, **kw):
+        return None
+
+    def default_stream(self, *a, **kw):
+        return None
+
+    def Event(self, *a, **kw):
+        return None
+
+    def wait_stream(self, *a, **kw) -> None:
+        pass
+
+    # ---------------------------------------------------------------- rng
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        return self.default_rng(seed)
+
+    def manual_seed_all(self, seed: int):
+        return self.manual_seed(seed)
+
+    def initial_seed(self) -> int:
+        return getattr(self, "_seed", 0)
+
+    # -------------------------------------------------------- memory (ext)
+    def empty_cache(self) -> None:
+        """Reference empty_cache(): XLA's BFC allocator frees on GC; the
+        closest action is dropping host-side jit caches is NOT wanted —
+        no-op, as in the reference's cpu path."""
+
+    def memory_allocated(self, index: int = 0) -> int:
+        return int(self.memory_stats(index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, index: int = 0) -> int:
+        stats = self.memory_stats(index)
+        peak = stats.get("peak_bytes_in_use")
+        return int(peak if peak is not None
+                   else stats.get("bytes_in_use", 0))
+
+    def reset_peak_memory_stats(self, index: int = 0) -> None:
+        pass    # PJRT exposes no peak reset; readers diff successive stats
+
+    def memory_reserved(self, index: int = 0) -> int:
+        return int(self.memory_stats(index).get("bytes_reserved", 0))
+
+    def max_memory_reserved(self, index: int = 0) -> int:
+        stats = self.memory_stats(index)
+        peak = stats.get("peak_bytes_reserved")
+        return int(peak if peak is not None
+                   else stats.get("bytes_reserved", 0))
+
+    # ------------------------------------------------------------- tensors
+    def pin_memory(self, array, align_bytes: int = 1):
+        """Host arrays are already DMA-able under PJRT; returns the array
+        (reference cpu path does the same)."""
+        return array
+
+    def is_pinned(self, array) -> bool:
+        return True
+
+    def on_accelerator(self, array) -> bool:
+        import jax
+
+        if not isinstance(array, jax.Array):
+            return False
+        plat = self.current_platform()
+        return any(d.platform == plat for d in array.devices())
+
+    # --------------------------------------------------------- capabilities
+    def is_triton_supported(self) -> bool:
+        return False
+
+    def use_host_timers(self) -> bool:
+        """TPU has no device-side timers visible to the host; wall-clock
+        after block_until_ready is the timing story (utils/timer.py)."""
+        return True
+
+    def resolves_data_dependency(self) -> bool:
+        return True     # XLA schedules by data dependence
+
+    def handles_memory_backpressure(self) -> bool:
+        return False
+
+    def communication_backend_version(self):
+        import jax
+
+        return jax.__version__
+
+    def amp(self):
+        """Reference amp(): mixed precision is dtype-driven in JAX (bf16
+        params/compute via the config); no autocast module exists."""
+        return None
+
+    def lazy_call(self, callback):
+        """Reference defers until device init; JAX initializes on first
+        use, so call immediately."""
+        callback()
+
+    # ----------------------------------------------------------- op builder
+    def create_op_builder(self, name: str):
+        builder_cls = self.get_op_builder(name)
+        return builder_cls() if builder_cls is not None else None
+
+    def get_op_builder(self, name: str):
+        from ..ops.op_builder import get_builder_class
+
+        return get_builder_class(name)
+
     # ------------------------------------------------------------------ misc
     def communication_backend_name(self) -> str:
         return self._communication_backend_name

@@ -94,6 +94,11 @@ class Autotuner:
         end = int(self.at_cfg.get("end_profile_step", 5))
         cfg = dict(self.base)
         cfg["train_micro_batch_size_per_gpu"] = micro
+        # The candidate redefines the batch split; the base's global batch /
+        # gas would over-constrain it (non-divisible combos would fail
+        # resolve_batch_sizes spuriously). Candidates are compared at gas=1.
+        cfg.pop("train_batch_size", None)
+        cfg["gradient_accumulation_steps"] = 1
         cfg["zero_optimization"] = dict(self.base.get("zero_optimization", {}),
                                         stage=stage)
         cfg["mesh"] = mesh
@@ -155,6 +160,12 @@ class Autotuner:
         self._write_results()
         best = self.best(metric)
         merged = dict(self.base)
+        # Return exactly what was measured: candidates ran with the batch
+        # triple (micro, gas=1) and no global-batch constraint — keeping the
+        # base's train_batch_size/gas could make the merged config
+        # unloadable (non-divisible) or differently batched than scored.
+        merged.pop("train_batch_size", None)
+        merged["gradient_accumulation_steps"] = 1
         merged["train_micro_batch_size_per_gpu"] = best["micro_batch"]
         merged["zero_optimization"] = dict(
             self.base.get("zero_optimization", {}), stage=best["zero_stage"])

@@ -132,13 +132,25 @@ def get_local_rank() -> int:
 
 
 def barrier(group: Group = None) -> None:
-    """Host-level barrier: blocks until all outstanding device work is done
-    (multi-host sync happens through the next collective; JAX's runtime has
-    no standalone barrier in the hot path)."""
+    """Barrier (reference comm/comm.py:406). Multi-process: a true
+    cross-host rendezvous via a zero-payload global collective
+    (multihost_utils.sync_global_devices). Single-process: flush
+    outstanding device work — there is no peer to wait for."""
     import jax
-    import jax.numpy as jnp
 
-    jax.block_until_ready(jnp.zeros(()))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        global _barrier_count
+        _barrier_count += 1
+        multihost_utils.sync_global_devices(f"ds_tpu_barrier_{_barrier_count}")
+    else:
+        import jax.numpy as jnp
+
+        jax.block_until_ready(jnp.zeros(()))
+
+
+_barrier_count = 0
 
 
 def _axes(group: Group):

@@ -140,3 +140,16 @@ class AsyncIOBuilder(OpBuilder):
 
 
 ALL_OPS = {b.name: b for b in (CPUAdamBuilder(), AsyncIOBuilder())}
+
+_BUILDER_CLASSES = {
+    "cpu_adam": CPUAdamBuilder, "CPUAdamBuilder": CPUAdamBuilder,
+    "aio": AsyncIOBuilder, "async_io": AsyncIOBuilder,
+    "AsyncIOBuilder": AsyncIOBuilder,
+}
+
+
+def get_builder_class(name: str):
+    """Builder class by reference-style name ('CPUAdamBuilder') or short op
+    name ('cpu_adam'); None when the op has no TPU-native builder
+    (accelerator.get_op_builder contract, reference real_accelerator)."""
+    return _BUILDER_CLASSES.get(name)
