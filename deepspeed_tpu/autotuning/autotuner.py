@@ -160,13 +160,27 @@ class Autotuner:
         self._write_results()
         best = self.best(metric)
         merged = dict(self.base)
-        # Return exactly what was measured: candidates ran with the batch
-        # triple (micro, gas=1) and no global-batch constraint — keeping the
-        # base's train_batch_size/gas could make the merged config
-        # unloadable (non-divisible) or differently batched than scored.
-        merged.pop("train_batch_size", None)
+        # Candidates are measured at gas=1 with no global-batch constraint.
+        # The returned config keeps the user's global batch when the winner
+        # divides it (gas rescales); otherwise it drops the constraint
+        # loudly rather than returning an unloadable or silently-rebatched
+        # config.
+        target_batch = merged.pop("train_batch_size", None)
         merged["gradient_accumulation_steps"] = 1
         merged["train_micro_batch_size_per_gpu"] = best["micro_batch"]
+        if isinstance(target_batch, int):
+            dp = len(jax.devices())
+            if target_batch % (best["micro_batch"] * dp) == 0:
+                merged["train_batch_size"] = target_batch
+                merged["gradient_accumulation_steps"] = \
+                    target_batch // (best["micro_batch"] * dp)
+            else:
+                logger.warning(
+                    f"autotune: train_batch_size={target_batch} is not "
+                    f"divisible by micro({best['micro_batch']})×dp({dp}); "
+                    "the tuned config runs at gas=1 — rescale the batch "
+                    "explicitly if the global batch is a training "
+                    "constraint")
         merged["zero_optimization"] = dict(
             self.base.get("zero_optimization", {}), stage=best["zero_stage"])
         merged["mesh"] = best["mesh"]

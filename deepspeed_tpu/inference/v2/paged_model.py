@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...models.transformer import CausalLM, _norm, rope_table
+from ...models.transformer import CausalLM, _linear, _norm, rope_table
 from ...ops.paged_attention import paged_attention
 
 
@@ -37,6 +37,12 @@ class PagedCausalLM:
                  max_blocks_per_seq: int):
         self.model = model
         self.cfg = model.cfg
+        if self.cfg.position == "alibi":
+            raise NotImplementedError(
+                "paged (v2) serving does not support ALiBi models yet — the "
+                "Pallas paged kernel takes no logit bias; serve BLOOM-family "
+                "models through the v1 engine (its decode path applies the "
+                "ALiBi bias, models/transformer.py _block_decode)")
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.forward = jax.jit(self._forward)
@@ -57,11 +63,14 @@ class PagedCausalLM:
         dt = cfg.dtype
 
         x = params["embed"]["wte"][tokens].astype(dt)          # [N, C, H]
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed"]["ln_w"],
+                      params["embed"].get("ln_b"), cfg.norm, cfg.norm_eps)
         positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [N, C]
         if cfg.position == "rope":
-            cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.head_dim,
+            cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.rot_dim,
                                             cfg.rope_theta)
-            cos = cos_full[positions]                           # [N, C, D/2]
+            cos = cos_full[positions]                           # [N, C, R/2]
             sin = sin_full[positions]
         else:
             x = x + params["embed"]["wpe"][positions].astype(dt)
@@ -83,22 +92,31 @@ class PagedCausalLM:
         def rope_q(q):
             if cfg.position != "rope":
                 return q
-            # apply_rope expects [B, T, H, D] with tables [T, D/2]; here the
-            # tables are per-(seq, pos): inline the rotation
-            q1, q2 = jnp.split(q, 2, axis=-1)
+            # apply_rope expects [B, T, H, D] with tables [T, R/2]; here the
+            # tables are per-(seq, pos): inline the (possibly partial)
+            # rotation, leaving trailing head dims unrotated (rope_pct)
+            rot = cos.shape[-1] * 2
+            qr, q_pass = q[..., :rot], q[..., rot:]
+            q1, q2 = jnp.split(qr, 2, axis=-1)
             c = cos[:, :, None, :]
             s = sin[:, :, None, :]
-            return jnp.concatenate([q1 * c - q2 * s, q2 * c + q1 * s],
-                                   axis=-1).astype(q.dtype)
+            out = jnp.concatenate([q1 * c - q2 * s, q2 * c + q1 * s],
+                                  axis=-1)
+            if q_pass.shape[-1]:
+                out = jnp.concatenate([out, q_pass], axis=-1)
+            return out.astype(q.dtype)
 
         def block(x, xs):
             lp, kc, vc = xs   # kc/vc [NB, KH, bs, D]
             h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
                        cfg.norm, cfg.norm_eps)
             nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-            q = rope_q((h1 @ lp["wq"].astype(dt)).reshape(N, C, nh, hd))
-            k = rope_q((h1 @ lp["wk"].astype(dt)).reshape(N, C, kvh, hd))
-            v = (h1 @ lp["wv"].astype(dt)).reshape(N, C, kvh, hd)
+            q = rope_q(_linear(h1, lp["wq"], lp.get("wq_b"),
+                               dt).reshape(N, C, nh, hd))
+            k = rope_q(_linear(h1, lp["wk"], lp.get("wk_b"),
+                               dt).reshape(N, C, kvh, hd))
+            v = _linear(h1, lp["wv"], lp.get("wv_b"),
+                        dt).reshape(N, C, kvh, hd)
 
             # paged KV write (reference linear_blocked_kv_rotary kernel):
             # token t lands at kc[block(t), :, slot(t), :]
@@ -110,8 +128,9 @@ class PagedCausalLM:
             # paged read: Pallas block-table walk (reference blocked_flash)
             attn = paged_attention(q, kc, vc, block_tables, start_pos,
                                    n_tokens)
-            x = x + attn.reshape(N, C, nh * hd) @ lp["wo"].astype(dt)
-            x = self.model._mlp(x, lp)
+            attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
+                               lp.get("wo_b"), dt)
+            x = self.model._attn_mlp_merge(x, attn_out, lp)
             return x, (kc, vc)
 
         x, (new_k, new_v) = lax.scan(block, x,

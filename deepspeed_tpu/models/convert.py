@@ -21,9 +21,12 @@ full model is never resident on any single host — the reference's
 the copy-and-slice round trip.
 
 Supported families (reference containers ``module_inject/containers/``):
-Llama/Llama-2, Mistral (sliding window not applied — full attention), and
-GPT-2. HF uses the GPT-NeoX ("rotate_half", non-interleaved) RoPE layout,
-which matches ``models/transformer.py:apply_rope`` directly.
+Llama/Llama-2, Mistral (sliding window not applied — full attention),
+GPT-2, Qwen2 (qkv-bias), OPT (learned positions, relu), GPT-NeoX
+(parallel residual, partial rotary, interleaved fused QKV), BLOOM (ALiBi,
+embedding LayerNorm), and Falcon 7B/40B (parallel attention, MQA/grouped
+QKV). Llama-family HF RoPE is the "rotate_half" non-interleaved layout,
+matching ``models/transformer.py:apply_rope`` directly.
 """
 
 from __future__ import annotations
@@ -231,9 +234,43 @@ class StackedLeafPlan:
         return np.stack(blocks, axis=0)
 
 
+@dataclasses.dataclass(frozen=True)
+class TransformSrc:
+    """Target fed by an arbitrary rearrangement of one source tensor —
+    needed for *interleaved* fused QKV layouts (GPT-NeoX/BLOOM store
+    [heads, 3, head_dim] packed in dim 0; Falcon packs per KV group),
+    where target slices are not affine in source coordinates. Reads the
+    whole source then slices: laziness drops to per-layer granularity,
+    which is fine — these are one layer's [3h, h]."""
+    name: str
+    fn: Callable[[np.ndarray], np.ndarray]
+
+    def read(self, reader: CheckpointReader, index: Index) -> np.ndarray:
+        return self.fn(reader.read(self.name))[index]
+
+
+def _qkv_deinterleave(which: str, groups: int, q_per_group: int, hd: int):
+    """Extract q/k/v from a fused [groups, q_per_group+2, hd, ...] packing
+    (weights [G·P·hd, h] → target [h, heads·hd]; biases [G·P·hd] →
+    [heads·hd])."""
+    sel = {"q": (0, q_per_group), "k": (q_per_group, q_per_group + 1),
+           "v": (q_per_group + 1, q_per_group + 2)}[which]
+
+    def fn(w: np.ndarray) -> np.ndarray:
+        P = q_per_group + 2
+        if w.ndim == 2:
+            w4 = w.reshape(groups, P, hd, w.shape[-1])
+            out = w4[:, sel[0]:sel[1]].reshape(-1, w.shape[-1])
+            return np.ascontiguousarray(out.T)      # [h, heads·hd]
+        return w.reshape(groups, P, hd)[:, sel[0]:sel[1]].reshape(-1)
+
+    return fn
+
+
 # ------------------------------------------------------------ family mappings
 
-def _llama_plans(cfg: TransformerConfig, shapes) -> Dict[str, Any]:
+def _llama_plans(cfg: TransformerConfig, shapes,
+             hf_config=None) -> Dict[str, Any]:
     """HF LlamaForCausalLM / MistralForCausalLM naming → CausalLM leaves."""
     L = "model.layers.{}."
 
@@ -265,7 +302,8 @@ def _llama_plans(cfg: TransformerConfig, shapes) -> Dict[str, Any]:
     return plans
 
 
-def _gpt2_plans(cfg: TransformerConfig, shapes) -> Dict[str, Any]:
+def _gpt2_plans(cfg: TransformerConfig, shapes,
+            hf_config=None) -> Dict[str, Any]:
     """HF GPT2LMHeadModel naming → CausalLM leaves. GPT-2 uses Conv1D
     ([in, out] — no transpose) and a fused c_attn split by column offset."""
     h = cfg.hidden_size
@@ -304,7 +342,276 @@ def _gpt2_plans(cfg: TransformerConfig, shapes) -> Dict[str, Any]:
     }
 
 
-_FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans, "gpt2": _gpt2_plans}
+def _qwen2_plans(cfg: TransformerConfig, shapes,
+             hf_config=None) -> Dict[str, Any]:
+    """Qwen2 = Llama layout + biases on q/k/v only."""
+    plans = _llama_plans(cfg, shapes)
+    L = "model.layers.{}."
+    for leaf, fmt in (("wq_b", "self_attn.q_proj.bias"),
+                      ("wk_b", "self_attn.k_proj.bias"),
+                      ("wv_b", "self_attn.v_proj.bias")):
+        plans["layers"][leaf] = StackedLeafPlan(
+            (lambda f: lambda i: Src((L + f).format(i)))(fmt),
+            shapes["layers"][leaf].shape)
+    return plans
+
+
+def _opt_plans(cfg: TransformerConfig, shapes,
+           hf_config=None) -> Dict[str, Any]:
+    """HF OPTForCausalLM: decoder stack, per-layer final_layer_norm is the
+    MLP norm, learned positions carry HF's +2 offset."""
+    L = "model.decoder.layers.{}."
+
+    def lsrc(fmt, transpose=False, offset=()):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose,
+                             offset=offset)
+
+    layers = {
+        "attn_norm_w": lsrc("self_attn_layer_norm.weight"),
+        "attn_norm_b": lsrc("self_attn_layer_norm.bias"),
+        "wq": lsrc("self_attn.q_proj.weight", transpose=True),
+        "wk": lsrc("self_attn.k_proj.weight", transpose=True),
+        "wv": lsrc("self_attn.v_proj.weight", transpose=True),
+        "wo": lsrc("self_attn.out_proj.weight", transpose=True),
+        "wq_b": lsrc("self_attn.q_proj.bias"),
+        "wk_b": lsrc("self_attn.k_proj.bias"),
+        "wv_b": lsrc("self_attn.v_proj.bias"),
+        "wo_b": lsrc("self_attn.out_proj.bias"),
+        "mlp_norm_w": lsrc("final_layer_norm.weight"),
+        "mlp_norm_b": lsrc("final_layer_norm.bias"),
+        "w_in": lsrc("fc1.weight", transpose=True),
+        "w_in_b": lsrc("fc1.bias"),
+        "w_out": lsrc("fc2.weight", transpose=True),
+        "w_out_b": lsrc("fc2.bias"),
+    }
+    return {
+        "embed": {
+            "wte": LeafPlan(Src("model.decoder.embed_tokens.weight"),
+                            shapes["embed"]["wte"].shape),
+            # OPTLearnedPositionalEmbedding rows are shifted by 2
+            "wpe": LeafPlan(Src("model.decoder.embed_positions.weight",
+                                offset=(2, 0)),
+                            shapes["embed"]["wpe"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {
+            "w": LeafPlan(Src("model.decoder.final_layer_norm.weight"),
+                          shapes["final_norm"]["w"].shape),
+            "b": LeafPlan(Src("model.decoder.final_layer_norm.bias"),
+                          shapes["final_norm"]["b"].shape)},
+    }
+
+
+def _neox_plans(cfg: TransformerConfig, shapes,
+            hf_config=None) -> Dict[str, Any]:
+    """HF GPTNeoXForCausalLM: interleaved fused QKV, parallel residual,
+    separate embed_out head."""
+    L = "gpt_neox.layers.{}."
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    def lsrc(fmt, transpose=False):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    def qkv(which, suffix):
+        return lambda i: TransformSrc(
+            (L + f"attention.query_key_value.{suffix}").format(i),
+            _qkv_deinterleave(which, nh, 1, hd))
+
+    layers = {
+        "attn_norm_w": lsrc("input_layernorm.weight"),
+        "attn_norm_b": lsrc("input_layernorm.bias"),
+        "mlp_norm_w": lsrc("post_attention_layernorm.weight"),
+        "mlp_norm_b": lsrc("post_attention_layernorm.bias"),
+        "wq": qkv("q", "weight"), "wk": qkv("k", "weight"),
+        "wv": qkv("v", "weight"),
+        "wq_b": qkv("q", "bias"), "wk_b": qkv("k", "bias"),
+        "wv_b": qkv("v", "bias"),
+        "wo": lsrc("attention.dense.weight", transpose=True),
+        "wo_b": lsrc("attention.dense.bias"),
+        "w_in": lsrc("mlp.dense_h_to_4h.weight", transpose=True),
+        "w_in_b": lsrc("mlp.dense_h_to_4h.bias"),
+        "w_out": lsrc("mlp.dense_4h_to_h.weight", transpose=True),
+        "w_out_b": lsrc("mlp.dense_4h_to_h.bias"),
+    }
+    plans = {
+        "embed": {"wte": LeafPlan(Src("gpt_neox.embed_in.weight"),
+                                  shapes["embed"]["wte"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {
+            "w": LeafPlan(Src("gpt_neox.final_layer_norm.weight"),
+                          shapes["final_norm"]["w"].shape),
+            "b": LeafPlan(Src("gpt_neox.final_layer_norm.bias"),
+                          shapes["final_norm"]["b"].shape)},
+    }
+    if not cfg.tie_embeddings:
+        plans["lm_head"] = {"w": LeafPlan(Src("embed_out.weight",
+                                              transpose=True),
+                                          shapes["lm_head"]["w"].shape)}
+    return plans
+
+
+def _bloom_plans(cfg: TransformerConfig, shapes,
+             hf_config=None) -> Dict[str, Any]:
+    """HF BloomForCausalLM: ALiBi, embedding LayerNorm, interleaved fused
+    QKV, tied embeddings."""
+    L = "transformer.h.{}."
+    nh, hd = cfg.num_heads, cfg.head_dim
+
+    def lsrc(fmt, transpose=False):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    def qkv(which, suffix):
+        return lambda i: TransformSrc(
+            (L + f"self_attention.query_key_value.{suffix}").format(i),
+            _qkv_deinterleave(which, nh, 1, hd))
+
+    layers = {
+        "attn_norm_w": lsrc("input_layernorm.weight"),
+        "attn_norm_b": lsrc("input_layernorm.bias"),
+        "mlp_norm_w": lsrc("post_attention_layernorm.weight"),
+        "mlp_norm_b": lsrc("post_attention_layernorm.bias"),
+        "wq": qkv("q", "weight"), "wk": qkv("k", "weight"),
+        "wv": qkv("v", "weight"),
+        "wq_b": qkv("q", "bias"), "wk_b": qkv("k", "bias"),
+        "wv_b": qkv("v", "bias"),
+        "wo": lsrc("self_attention.dense.weight", transpose=True),
+        "wo_b": lsrc("self_attention.dense.bias"),
+        "w_in": lsrc("mlp.dense_h_to_4h.weight", transpose=True),
+        "w_in_b": lsrc("mlp.dense_h_to_4h.bias"),
+        "w_out": lsrc("mlp.dense_4h_to_h.weight", transpose=True),
+        "w_out_b": lsrc("mlp.dense_4h_to_h.bias"),
+    }
+    return {
+        "embed": {
+            "wte": LeafPlan(Src("transformer.word_embeddings.weight"),
+                            shapes["embed"]["wte"].shape),
+            "ln_w": LeafPlan(
+                Src("transformer.word_embeddings_layernorm.weight"),
+                shapes["embed"]["ln_w"].shape),
+            "ln_b": LeafPlan(
+                Src("transformer.word_embeddings_layernorm.bias"),
+                shapes["embed"]["ln_b"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {"w": LeafPlan(Src("transformer.ln_f.weight"),
+                                     shapes["final_norm"]["w"].shape),
+                       "b": LeafPlan(Src("transformer.ln_f.bias"),
+                                     shapes["final_norm"]["b"].shape)},
+    }
+
+
+def _falcon_plans(cfg: TransformerConfig, shapes,
+              hf_config=None) -> Dict[str, Any]:
+    """HF FalconForCausalLM. Old decoder architecture (7B): one shared
+    input_layernorm feeds BOTH parallel branches — mapped by pointing
+    attn_norm and mlp_norm at the same tensor (numerically identical to
+    the shared-LN fused block). New architecture (40B): ln_attn/ln_mlp +
+    per-KV-group interleaved QKV."""
+    L = "transformer.h.{}."
+    nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    if hf_config is not None:
+        new_arch = hf_config.get("new_decoder_architecture", False)
+        multi_query = hf_config.get("multi_query", True)
+    else:   # no config.json available: infer from the head layout
+        new_arch = kvh not in (1, nh)
+        multi_query = kvh == 1
+
+    def lsrc(fmt, transpose=False, offset=()):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose,
+                             offset=offset)
+
+    if new_arch:
+        q_per_group = nh // kvh
+
+        def qkv(which):
+            return lambda i: TransformSrc(
+                (L + "self_attention.query_key_value.weight").format(i),
+                _qkv_deinterleave(which, kvh, q_per_group, hd))
+
+        wq, wk, wv = qkv("q"), qkv("k"), qkv("v")
+        attn_norm_w = lsrc("ln_attn.weight")
+        attn_norm_b = lsrc("ln_attn.bias")
+        mlp_norm_w = lsrc("ln_mlp.weight")
+        mlp_norm_b = lsrc("ln_mlp.bias")
+    else:
+        if multi_query:
+            # affine fused layout: q rows, then one K head, then one V head
+            wq = lsrc("self_attention.query_key_value.weight",
+                      transpose=True, offset=(0, 0))
+            wk = lsrc("self_attention.query_key_value.weight",
+                      transpose=True, offset=(0, nh * hd))
+            wv = lsrc("self_attention.query_key_value.weight",
+                      transpose=True, offset=(0, (nh + 1) * hd))
+        else:
+            # falcon-rw family: per-head interleaved [nh, 3, hd] packing
+            def qkv(which):
+                return lambda i: TransformSrc(
+                    (L + "self_attention.query_key_value.weight").format(i),
+                    _qkv_deinterleave(which, nh, 1, hd))
+
+            wq, wk, wv = qkv("q"), qkv("k"), qkv("v")
+        attn_norm_w = lsrc("input_layernorm.weight")
+        attn_norm_b = lsrc("input_layernorm.bias")
+        if cfg.parallel_residual:
+            # shared LN feeds both parallel branches: same source tensor
+            mlp_norm_w, mlp_norm_b = attn_norm_w, attn_norm_b
+        else:   # falcon-rw sequential blocks keep a separate post-attn LN
+            mlp_norm_w = lsrc("post_attention_layernorm.weight")
+            mlp_norm_b = lsrc("post_attention_layernorm.bias")
+
+    layers = {
+        "attn_norm_w": attn_norm_w, "attn_norm_b": attn_norm_b,
+        "mlp_norm_w": mlp_norm_w, "mlp_norm_b": mlp_norm_b,
+        "wq": wq, "wk": wk, "wv": wv,
+        "wo": lsrc("self_attention.dense.weight", transpose=True),
+        "w_in": lsrc("mlp.dense_h_to_4h.weight", transpose=True),
+        "w_out": lsrc("mlp.dense_4h_to_h.weight", transpose=True),
+    }
+    if cfg.use_bias:
+        if new_arch or not multi_query:
+            groups = kvh if new_arch else nh
+            qpg = (nh // kvh) if new_arch else 1
+
+            def qkv_b(which):
+                return lambda i: TransformSrc(
+                    (L + "self_attention.query_key_value.bias").format(i),
+                    _qkv_deinterleave(which, groups, qpg, hd))
+
+            wq_b, wk_b, wv_b = qkv_b("q"), qkv_b("k"), qkv_b("v")
+        else:
+            wq_b = lsrc("self_attention.query_key_value.bias", offset=(0,))
+            wk_b = lsrc("self_attention.query_key_value.bias",
+                        offset=(nh * hd,))
+            wv_b = lsrc("self_attention.query_key_value.bias",
+                        offset=((nh + 1) * hd,))
+        layers.update({
+            "wq_b": wq_b, "wk_b": wk_b, "wv_b": wv_b,
+            "wo_b": lsrc("self_attention.dense.bias"),
+            "w_in_b": lsrc("mlp.dense_h_to_4h.bias"),
+            "w_out_b": lsrc("mlp.dense_4h_to_h.bias"),
+        })
+    plans = {
+        "embed": {"wte": LeafPlan(Src("transformer.word_embeddings.weight"),
+                                  shapes["embed"]["wte"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {"w": LeafPlan(Src("transformer.ln_f.weight"),
+                                     shapes["final_norm"]["w"].shape),
+                       "b": LeafPlan(Src("transformer.ln_f.bias"),
+                                     shapes["final_norm"]["b"].shape)},
+    }
+    if not cfg.tie_embeddings:
+        plans["lm_head"] = {"w": LeafPlan(Src("lm_head.weight",
+                                              transpose=True),
+                                          shapes["lm_head"]["w"].shape)}
+    return plans
+
+
+_FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
+             "gpt2": _gpt2_plans, "qwen2": _qwen2_plans, "opt": _opt_plans,
+             "gpt_neox": _neox_plans, "bloom": _bloom_plans,
+             "falcon": _falcon_plans}
 
 
 def config_from_hf(hf_config: Dict[str, Any],
@@ -340,17 +647,104 @@ def config_from_hf(hf_config: Dict[str, Any],
             tie_embeddings=True, use_bias=True,
             norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
             dtype=dtype)
+    if mt == "qwen2":
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get("num_key_value_heads"),
+            max_seq_len=hf_config.get("max_position_embeddings", 4096),
+            norm="rmsnorm", activation="silu", position="rope",
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+            norm_eps=hf_config.get("rms_norm_eps", 1e-6),
+            qkv_bias=True, dtype=dtype)
+    if mt == "opt":
+        if not hf_config.get("do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=false (350m) "
+                             "uses post-norm blocks, which this model "
+                             "family does not implement")
+        h = hf_config["hidden_size"]
+        if hf_config.get("word_embed_proj_dim", h) != h:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size "
+                             "(projected embeddings) is unsupported")
+        act = hf_config.get("activation_function", "relu")
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config["ffn_dim"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm", activation=act, position="learned",
+            tie_embeddings=True, use_bias=True, dtype=dtype)
+    if mt == "gpt_neox":
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["hidden_size"],
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=("gelu_exact" if hf_config.get("hidden_act", "gelu")
+                        == "gelu" else hf_config.get("hidden_act", "gelu")),
+            position="rope",
+            rope_theta=hf_config.get("rotary_emb_base", 10000.0),
+            rope_pct=hf_config.get("rotary_pct", 1.0),
+            parallel_residual=hf_config.get("use_parallel_residual", True),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+            norm_eps=hf_config.get("layer_norm_eps", 1e-5),
+            use_bias=True, dtype=dtype)
+    if mt == "bloom":
+        h = hf_config.get("hidden_size", hf_config.get("n_embed"))
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=4 * h,
+            num_layers=hf_config["n_layer"],
+            num_heads=hf_config["n_head"],
+            max_seq_len=hf_config.get("seq_length", 2048),
+            norm="layernorm", activation="gelu", position="alibi",
+            embedding_layernorm=True, tie_embeddings=True, use_bias=True,
+            norm_eps=hf_config.get("layer_norm_epsilon", 1e-5), dtype=dtype)
+    if mt == "falcon":
+        nh = hf_config.get("num_attention_heads", hf_config.get("n_head"))
+        new_arch = hf_config.get("new_decoder_architecture", False)
+        if new_arch:
+            kv = hf_config.get("num_kv_heads", nh)
+        else:
+            kv = 1 if hf_config.get("multi_query", True) else nh
+        h = hf_config["hidden_size"]
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("ffn_hidden_size", 4 * h),
+            num_layers=hf_config.get("num_hidden_layers",
+                                     hf_config.get("n_layer")),
+            num_heads=nh, num_kv_heads=kv,
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu_exact",
+            position="alibi" if hf_config.get("alibi", False) else "rope",
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            parallel_residual=hf_config.get("parallel_attn", True),
+            tie_embeddings=hf_config.get("tie_word_embeddings", True),
+            use_bias=hf_config.get("bias", False),
+            norm_eps=hf_config.get("layer_norm_epsilon", 1e-5), dtype=dtype)
     raise ValueError(f"unsupported model_type {mt!r} "
                      f"(supported: {sorted(_FAMILIES)})")
 
 
 # ------------------------------------------------------------------ top level
 
-def build_leaf_plans(model: CausalLM, model_type: str) -> Dict[str, Any]:
+def build_leaf_plans(model: CausalLM, model_type: str,
+                     hf_config=None) -> Dict[str, Any]:
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
     if model_type not in _FAMILIES:
         raise ValueError(f"unsupported model_type {model_type!r}")
-    return _FAMILIES[model_type](model.cfg, shapes)
+    return _FAMILIES[model_type](model.cfg, shapes, hf_config)
 
 
 def load_hf_checkpoint(path: str,
@@ -383,7 +777,7 @@ def load_hf_checkpoint(path: str,
         param_dtype = model.cfg.dtype
 
     reader = open_checkpoint(path)
-    plans = build_leaf_plans(model, model_type)
+    plans = build_leaf_plans(model, model_type, hf_cfg)
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
 
     # validate leaf coverage: every model leaf must have a plan

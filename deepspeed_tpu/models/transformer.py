@@ -48,12 +48,16 @@ class TransformerConfig:
     max_seq_len: int = 1024
     # architecture switches
     norm: str = "layernorm"              # "layernorm" | "rmsnorm"
-    activation: str = "gelu"             # "gelu" | "silu" (silu => SwiGLU gated MLP)
-    position: str = "learned"            # "learned" | "rope"
+    activation: str = "gelu"             # "gelu" | "silu" (SwiGLU) | "relu"
+    position: str = "learned"            # "learned" | "rope" | "alibi"
     rope_theta: float = 10000.0
+    rope_pct: float = 1.0                # partial rotary (GPT-NeoX rotary_pct)
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
     use_bias: bool = False               # linear biases (GPT-2/OPT style)
+    qkv_bias: bool = False               # biases on q/k/v only (Qwen2)
+    parallel_residual: bool = False      # x + attn(ln1 x) + mlp(ln2 x) (NeoX/Falcon)
+    embedding_layernorm: bool = False    # LayerNorm after wte (BLOOM)
     dropout: float = 0.0
     dtype: Any = jnp.float32             # compute dtype (params kept fp32)
     remat: bool = False                  # activation checkpointing per layer
@@ -77,6 +81,11 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    @property
+    def rot_dim(self) -> int:
+        """Rotary dims per head (even; < head_dim for partial rotary)."""
+        return int(self.head_dim * self.rope_pct) // 2 * 2
 
     def num_params(self) -> int:
         h, m, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
@@ -148,18 +157,37 @@ def rope_table(max_len: int, head_dim: int, theta: float) -> Tuple[jnp.ndarray, 
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, T, H, D]; cos/sin: [T, D/2] (pre-sliced to positions)."""
-    x1, x2 = jnp.split(x, 2, axis=-1)
+    """x: [B, T, H, D]; cos/sin: [T, R/2] with R ≤ D (partial rotary — the
+    GPT-NeoX rotary_pct layout — leaves the trailing D−R dims unrotated)."""
+    rot = cos.shape[-1] * 2
+    xr, x_pass = x[..., :rot], x[..., rot:]
+    x1, x2 = jnp.split(xr, 2, axis=-1)
     c = cos[None, :, None, :]
     s = sin[None, :, None, :]
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out.astype(x.dtype)
 
 
-def attention_reference(q, k, v, causal: bool = True, mask=None):
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (Press et al.; the reference's softmax kernel
+    bakes these in — csrc/transformer/inference/csrc/softmax.cu alibi path)."""
+    m = 2 ** math.floor(math.log2(num_heads))
+    base = [2.0 ** (-8.0 * (i + 1) / m) for i in range(m)]
+    if m < num_heads:
+        extra = [2.0 ** (-4.0 * (2 * i + 1) / m) for i in range(num_heads - m)]
+        base += extra
+    return jnp.asarray(base, jnp.float32)
+
+
+def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None):
     """Pure-XLA attention: q [B,T,H,D], k/v [B,S,KH,D].
 
     GQA is expressed as an einsum over the [KH, group] head factorization —
-    no ``jnp.repeat``, so K/V are never copied in HBM.
+    no ``jnp.repeat``, so K/V are never copied in HBM. ``bias``: optional
+    additive [H, S] logit bias (ALiBi — per-row-constant terms cancel in
+    softmax, so slopes·key_position suffices).
     """
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
@@ -167,6 +195,8 @@ def attention_reference(q, k, v, causal: bool = True, mask=None):
     scale = 1.0 / math.sqrt(D)
     qg = q.reshape(B, T, KH, group, D)
     logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.reshape(KH, group, 1, S)[None]
     if causal:
         qpos = jnp.arange(T)[:, None] + (S - T)
         kpos = jnp.arange(S)[None, :]
@@ -221,6 +251,13 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
     shards — GSPMD cannot partition custom kernels, so the sequence comm
     (reference sequence/layer.py:37 Ulysses) is explicit here.
     """
+    if cfg.position == "alibi":
+        # additive logit bias: the Pallas kernel takes no bias — the XLA
+        # reference fuses it (softmax shift-invariance needs only slopes·k)
+        S = k.shape[1]
+        bias = alibi_slopes(cfg.num_heads)[:, None] * jnp.arange(S)[None, :]
+        return attention_reference(q, k, v, causal=causal, bias=bias)
+
     sp = _seq_parallel_size()
     if sp <= 1:
         return _local_attention(q, k, v, cfg, causal)
@@ -318,10 +355,11 @@ class CausalLM:
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = jnp.zeros((L, h), jnp.float32)
             layers["mlp_norm_b"] = jnp.zeros((L, h), jnp.float32)
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             layers["wq_b"] = jnp.zeros((L, nh * hd), jnp.float32)
             layers["wk_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
             layers["wv_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
+        if cfg.use_bias:
             layers["wo_b"] = jnp.zeros((L, h), jnp.float32)
             layers["w_in_b"] = jnp.zeros((L, m), jnp.float32)
             layers["w_out_b"] = jnp.zeros((L, h), jnp.float32)
@@ -335,6 +373,10 @@ class CausalLM:
         }
         if cfg.position == "learned":
             params["embed"]["wpe"] = normal(keys[8], (cfg.max_seq_len, h))
+        if cfg.embedding_layernorm:
+            params["embed"]["ln_w"] = jnp.ones((h,), jnp.float32)
+            if cfg.norm == "layernorm":
+                params["embed"]["ln_b"] = jnp.zeros((h,), jnp.float32)
         if cfg.norm == "layernorm":
             params["final_norm"]["b"] = jnp.zeros((h,), jnp.float32)
         if not cfg.tie_embeddings:
@@ -368,10 +410,11 @@ class CausalLM:
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = spec("layers", "embed")
             layers["mlp_norm_b"] = spec("layers", "embed")
-        if cfg.use_bias:
+        if cfg.use_bias or cfg.qkv_bias:
             layers["wq_b"] = spec("layers", "heads")
             layers["wk_b"] = spec("layers", "kv_heads")
             layers["wv_b"] = spec("layers", "kv_heads")
+        if cfg.use_bias:
             layers["wo_b"] = spec("layers", "embed")
             layers["w_in_b"] = spec("layers", "mlp")
             layers["w_out_b"] = spec("layers", "embed")
@@ -384,6 +427,10 @@ class CausalLM:
         }
         if cfg.position == "learned":
             specs["embed"]["wpe"] = spec(None, "embed")
+        if cfg.embedding_layernorm:
+            specs["embed"]["ln_w"] = spec("embed")
+            if cfg.norm == "layernorm":
+                specs["embed"]["ln_b"] = spec("embed")
         if cfg.norm == "layernorm":
             specs["final_norm"]["b"] = spec("embed")
         if not cfg.tie_embeddings:
@@ -399,19 +446,23 @@ class CausalLM:
         h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
         q, k, v = self._qkv(h1, lp, cos, sin, B, T)
         attn = _attention(q, k, v, cfg, causal=True)
-        attn = attn.reshape(B, T, -1) @ lp["wo"].astype(cfg.dtype)
+        attn = _linear(attn.reshape(B, T, -1), lp["wo"], lp.get("wo_b"),
+                       cfg.dtype)
         if cfg.dropout > 0 and not deterministic:
             rng, sub = jax.random.split(rng)
             attn = attn * jax.random.bernoulli(sub, 1 - cfg.dropout, attn.shape) / (1 - cfg.dropout)
-        x = x + attn
 
-        # mlp (dense or MoE; body shared with the inference paths)
-        h2 = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm, cfg.norm_eps)
+        # mlp (dense or MoE; body shared with the inference paths).
+        # parallel_residual (NeoX/Falcon): both branches read the SAME
+        # input x; sequential (default): mlp reads the post-attention x.
+        mlp_in = x if cfg.parallel_residual else x + attn
+        h2 = _norm(mlp_in, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm,
+                   cfg.norm_eps)
         y, l_aux = self._mlp_body(h2, lp, rng, deterministic)
         if cfg.dropout > 0 and not deterministic:
             rng, sub = jax.random.split(rng)
             y = y * jax.random.bernoulli(sub, 1 - cfg.dropout, y.shape) / (1 - cfg.dropout)
-        return x + y, l_aux
+        return x + attn + y, l_aux
 
     def _mlp_body(self, h2, lp, rng, deterministic: bool):
         """Dense or MoE FFN on normed input; returns (y, aux_loss)."""
@@ -420,10 +471,16 @@ class CausalLM:
             return self._moe_mlp(h2, lp, rng, deterministic)
         dt = cfg.dtype
         if cfg.activation == "silu":
-            y = jax.nn.silu(h2 @ lp["w_gate"].astype(dt)) * (h2 @ lp["w_in"].astype(dt))
+            y = jax.nn.silu(_linear(h2, lp["w_gate"], lp.get("w_gate_b"), dt)) \
+                * _linear(h2, lp["w_in"], lp.get("w_in_b"), dt)
         else:
-            y = jax.nn.gelu(h2 @ lp["w_in"].astype(dt), approximate=True)
-        return y @ lp["w_out"].astype(dt), jnp.zeros((), jnp.float32)
+            act = {"relu": jax.nn.relu,
+                   "gelu_exact": partial(jax.nn.gelu, approximate=False),
+                   }.get(cfg.activation, partial(jax.nn.gelu,
+                                                 approximate=True))
+            y = act(_linear(h2, lp["w_in"], lp.get("w_in_b"), dt))
+        return _linear(y, lp["w_out"], lp.get("w_out_b"), dt), \
+            jnp.zeros((), jnp.float32)
 
     def _moe_mlp(self, h2, lp, rng, deterministic):
         """GShard top-k MoE MLP (reference moe/sharded_moe.py:477): gate +
@@ -470,16 +527,21 @@ class CausalLM:
                 if grp in params:
                     params[grp] = {k: flat[f"{grp}.{k}"] for k in params[grp]}
         x = params["embed"]["wte"][tokens].astype(cfg.dtype)
-        if cfg.position == "learned":
-            pos = positions if positions is not None else jnp.arange(T)
-            x = x + params["embed"]["wpe"][pos].astype(cfg.dtype)
-            cos = sin = jnp.zeros((T, 1), jnp.float32)
-        else:
-            cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed"]["ln_w"], params["embed"].get("ln_b"),
+                      cfg.norm, cfg.norm_eps)
+        if cfg.position == "rope":
+            cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.rot_dim,
+                                            cfg.rope_theta)
             if positions is not None:
                 cos, sin = cos_full[positions], sin_full[positions]
             else:
                 cos, sin = cos_full[:T], sin_full[:T]
+        else:
+            if cfg.position == "learned":
+                pos = positions if positions is not None else jnp.arange(T)
+                x = x + params["embed"]["wpe"][pos].astype(cfg.dtype)
+            cos = sin = jnp.zeros((T, 1), jnp.float32)
         if rng is None:
             rng = jax.random.PRNGKey(0)
 
@@ -555,6 +617,9 @@ class CausalLM:
         cfg = self.cfg
         B, T = tokens.shape
         x = params["embed"]["wte"][tokens].astype(cfg.dtype)
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed"]["ln_w"], params["embed"].get("ln_b"),
+                      cfg.norm, cfg.norm_eps)
         cos, sin = self._pos_tables(T, None)
         if cfg.position == "learned":
             x = x + params["embed"]["wpe"][jnp.arange(T)].astype(cfg.dtype)
@@ -581,6 +646,9 @@ class CausalLM:
         B = tokens.shape[0]
         S = cache["k"].shape[2]
         x = params["embed"]["wte"][tokens][:, None, :].astype(cfg.dtype)  # [B,1,H]
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed"]["ln_w"], params["embed"].get("ln_b"),
+                      cfg.norm, cfg.norm_eps)
         cos, sin = self._pos_tables(1, jnp.asarray(pos)[None])
         if cfg.position == "learned":
             x = x + params["embed"]["wpe"][jnp.asarray(pos)[None]].astype(cfg.dtype)
@@ -602,7 +670,8 @@ class CausalLM:
         cfg = self.cfg
         if cfg.position != "rope":
             return jnp.zeros((T, 1), jnp.float32), jnp.zeros((T, 1), jnp.float32)
-        cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+        cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.rot_dim,
+                                        cfg.rope_theta)
         if positions is not None:
             return cos_full[positions], sin_full[positions]
         return cos_full[:T], sin_full[:T]
@@ -625,12 +694,15 @@ class CausalLM:
             k = apply_rope(k, cos, sin)
         return q, k, v
 
-    def _mlp(self, x, lp):
-        """Inference-path residual MLP (no dropout, aux discarded)."""
+    def _attn_mlp_merge(self, x, attn_out, lp):
+        """Shared residual wiring for the inference blocks: sequential
+        (mlp reads post-attention) or parallel (both branches read x)."""
         cfg = self.cfg
-        h2 = _norm(x, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm, cfg.norm_eps)
+        mlp_in = x if cfg.parallel_residual else x + attn_out
+        h2 = _norm(mlp_in, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm,
+                   cfg.norm_eps)
         y, _ = self._mlp_body(h2, lp, None, True)
-        return x + y
+        return x + attn_out + y
 
     def _block_kv(self, x, lp, cos, sin):
         """Forward block that also returns this layer's K/V (for prefill)."""
@@ -639,8 +711,9 @@ class CausalLM:
         h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
         q, k, v = self._qkv(h1, lp, cos, sin, B, T)
         attn = _attention(q, k, v, cfg, causal=True)
-        x = x + attn.reshape(B, T, -1) @ lp["wo"].astype(cfg.dtype)
-        return self._mlp(x, lp), k, v
+        attn = _linear(attn.reshape(B, T, -1), lp["wo"], lp.get("wo_b"),
+                       cfg.dtype)
+        return self._attn_mlp_merge(x, attn, lp), k, v
 
     def _block_decode(self, x, lp, kc, vc, cos, sin, pos, S):
         """Decode block: single token attends over the cache."""
@@ -651,9 +724,15 @@ class CausalLM:
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
         mask = (jnp.arange(S) <= pos)[None, None, None, :]   # [1,1,1,S]
-        attn = attention_reference(q, kc, vc, causal=False, mask=mask)
-        x = x + attn.reshape(B, 1, -1) @ lp["wo"].astype(cfg.dtype)
-        return self._mlp(x, lp), kc, vc
+        bias = None
+        if cfg.position == "alibi":
+            bias = alibi_slopes(cfg.num_heads)[:, None] \
+                * jnp.arange(S)[None, :]
+        attn = attention_reference(q, kc, vc, causal=False, mask=mask,
+                                   bias=bias)
+        attn = _linear(attn.reshape(B, 1, -1), lp["wo"], lp.get("wo_b"),
+                       cfg.dtype)
+        return self._attn_mlp_merge(x, attn, lp), kc, vc
 
     # -- loss ---------------------------------------------------------------
     def loss(self, params, batch, rng=None):

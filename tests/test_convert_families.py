@@ -1,0 +1,203 @@
+"""HF checkpoint import — extended model families (reference
+module_inject/containers/*: OPT, GPT-NeoX, BLOOM, Falcon, plus Qwen2 from
+inference v2): logits pinned against the transformers torch forward for
+each family, covering qkv-bias, parallel residual, partial rotary, ALiBi,
+embedding LayerNorm, relu/exact-gelu, and interleaved fused-QKV layouts."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import config_from_hf, from_pretrained
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _hf_logits(model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        return model(torch.tensor(tokens)).logits.float().numpy()
+
+
+def _save(model, tmp_path_factory, name):
+    path = tmp_path_factory.mktemp(name)
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path)
+
+
+def _parity(path, hf_model, vocab, seq=12, atol=4e-4):
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, size=(2, seq))
+    ours = np.asarray(model.apply(params, jnp.asarray(tokens, jnp.int32)))
+    theirs = _hf_logits(hf_model, tokens)
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=4e-4)
+    return model
+
+
+def test_qwen2_forward_parity(tmp_path_factory):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(vocab_size=120, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = Qwen2ForCausalLM(cfg).eval()
+    # HF zero-inits nothing here, but force nonzero qkv biases so the
+    # qkv_bias path is actually exercised
+    with torch.no_grad():
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.uniform_(-0.5, 0.5)
+    path = _save(hf, tmp_path_factory, "qwen2")
+    model = _parity(path, hf, 120)
+    assert model.cfg.qkv_bias
+
+
+def test_opt_forward_parity(tmp_path_factory):
+    from transformers import OPTConfig, OPTForCausalLM
+
+    cfg = OPTConfig(vocab_size=100, hidden_size=32, ffn_dim=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64, do_layer_norm_before=True,
+                    activation_function="relu", word_embed_proj_dim=32)
+    torch.manual_seed(1)
+    hf = OPTForCausalLM(cfg).eval()
+    with torch.no_grad():   # exercise every bias path with nonzero values
+        for p in hf.parameters():
+            if p.ndim == 1:
+                p.uniform_(-0.3, 0.3)
+    path = _save(hf, tmp_path_factory, "opt")
+    model = _parity(path, hf, 100)
+    assert model.cfg.activation == "relu"
+    assert model.cfg.position == "learned"
+
+
+def test_gpt_neox_forward_parity(tmp_path_factory):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    cfg = GPTNeoXConfig(vocab_size=110, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=64, rotary_pct=0.5,
+                        use_parallel_residual=True)
+    torch.manual_seed(2)
+    hf = GPTNeoXForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for p in hf.parameters():
+            if p.ndim == 1:
+                p.uniform_(-0.3, 0.3)
+    path = _save(hf, tmp_path_factory, "neox")
+    model = _parity(path, hf, 110)
+    assert model.cfg.parallel_residual
+    assert model.cfg.rope_pct == 0.5
+    assert model.cfg.rot_dim == 4      # head_dim 8 × 0.5
+
+
+def test_gpt_neox_sequential_residual(tmp_path_factory):
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    cfg = GPTNeoXConfig(vocab_size=90, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=64,
+                        use_parallel_residual=False)
+    torch.manual_seed(3)
+    hf = GPTNeoXForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "neox_seq")
+    model = _parity(path, hf, 90)
+    assert not model.cfg.parallel_residual
+
+
+def test_bloom_forward_parity(tmp_path_factory):
+    from transformers import BloomConfig, BloomForCausalLM
+
+    cfg = BloomConfig(vocab_size=130, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(4)
+    hf = BloomForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for p in hf.parameters():
+            if p.ndim == 1:
+                p.uniform_(-0.3, 0.3)
+    path = _save(hf, tmp_path_factory, "bloom")
+    model = _parity(path, hf, 130)
+    assert model.cfg.position == "alibi"
+    assert model.cfg.embedding_layernorm
+
+
+@pytest.mark.parametrize("new_arch", [False, True])
+def test_falcon_forward_parity(tmp_path_factory, new_arch):
+    from transformers import FalconConfig, FalconForCausalLM
+
+    kwargs = dict(vocab_size=105, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=4, bias=False, parallel_attn=True,
+                  alibi=False, max_position_embeddings=64)
+    if new_arch:
+        kwargs.update(new_decoder_architecture=True, num_kv_heads=2)
+    else:
+        kwargs.update(new_decoder_architecture=False, multi_query=True)
+    cfg = FalconConfig(**kwargs)
+    torch.manual_seed(5)
+    hf = FalconForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, f"falcon{int(new_arch)}")
+    model = _parity(path, hf, 105)
+    assert model.cfg.parallel_residual
+    assert model.cfg.kv_heads == (2 if new_arch else 1)
+
+
+def test_kv_cache_generate_matches_forward_alibi(tmp_path_factory):
+    """ALiBi decode path: prefill+decode logits must match the plain
+    forward at each position (BLOOM serving path)."""
+    from transformers import BloomConfig, BloomForCausalLM
+
+    cfg = BloomConfig(vocab_size=80, hidden_size=32, n_layer=2, n_head=4)
+    torch.manual_seed(6)
+    hf = BloomForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "bloom_gen")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    tokens = np.random.default_rng(7).integers(0, 80, size=(1, 8))
+    full = np.asarray(model.apply(params, jnp.asarray(tokens, jnp.int32)))
+
+    cache = model.init_cache(1, 12)
+    logits, cache = model.prefill(params, jnp.asarray(tokens, jnp.int32),
+                                  cache)
+    np.testing.assert_allclose(np.asarray(logits), full, atol=2e-4,
+                               rtol=2e-4)
+    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    step_logits, cache = model.decode_step(params, cache, nxt,
+                                           tokens.shape[1])
+    tokens2 = np.concatenate([tokens, np.asarray(nxt)[:, None]], axis=1)
+    full2 = np.asarray(model.apply(params, jnp.asarray(tokens2, jnp.int32)))
+    np.testing.assert_allclose(np.asarray(step_logits), full2[:, -1],
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_falcon_rw_alibi_parity(tmp_path_factory):
+    """falcon-rw family: ALiBi + per-head interleaved QKV + sequential
+    blocks with separate post-attention LN (review findings: alibi flag and
+    non-MQA fused layout must not silently mis-convert)."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    cfg = FalconConfig(vocab_size=95, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, bias=True, alibi=True,
+                       parallel_attn=False, multi_query=False,
+                       new_decoder_architecture=False,
+                       max_position_embeddings=64)
+    torch.manual_seed(8)
+    hf = FalconForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for p in hf.parameters():
+            if p.ndim == 1:
+                p.uniform_(-0.3, 0.3)
+    path = _save(hf, tmp_path_factory, "falcon_rw")
+    model = _parity(path, hf, 95)
+    assert model.cfg.position == "alibi"
+    assert not model.cfg.parallel_residual
+    assert model.cfg.kv_heads == 4
