@@ -235,36 +235,47 @@ class StackedLeafPlan:
 
 
 @dataclasses.dataclass(frozen=True)
-class TransformSrc:
-    """Target fed by an arbitrary rearrangement of one source tensor —
-    needed for *interleaved* fused QKV layouts (GPT-NeoX/BLOOM store
-    [heads, 3, head_dim] packed in dim 0; Falcon packs per KV group),
-    where target slices are not affine in source coordinates. Reads the
-    whole source then slices: laziness drops to per-layer granularity,
-    which is fine — these are one layer's [3h, h]."""
+class FusedQKVSrc:
+    """q/k/v extracted from an *interleaved* fused QKV tensor — GPT-NeoX/
+    BLOOM pack [heads, 3, head_dim] in dim 0, Falcon-40B packs per KV
+    group [groups, q_per_group+2, head_dim]. Target slices are piecewise-
+    affine in source rows, so each target block maps to a short list of
+    contiguous source row ranges: only those bytes are read (no full-tensor
+    read-and-rearrange), keeping the exact-bytes streaming property of the
+    affine Src path."""
     name: str
-    fn: Callable[[np.ndarray], np.ndarray]
+    which: str            # "q" | "k" | "v"
+    groups: int
+    q_per_group: int
+    hd: int
+
+    def _src_ranges(self, a: int, b: int):
+        """Source row ranges covering target out-rows [a, b)."""
+        sel_off = {"q": 0, "k": self.q_per_group,
+                   "v": self.q_per_group + 1}[self.which]
+        sel_w = (self.q_per_group if self.which == "q" else 1) * self.hd
+        P = self.q_per_group + 2
+        out = []
+        o = a
+        while o < b:
+            g, within = divmod(o, sel_w)
+            take = min(b - o, sel_w - within)
+            src0 = g * P * self.hd + sel_off * self.hd + within
+            out.append((src0, src0 + take))
+            o += take
+        return out
 
     def read(self, reader: CheckpointReader, index: Index) -> np.ndarray:
-        return self.fn(reader.read(self.name))[index]
-
-
-def _qkv_deinterleave(which: str, groups: int, q_per_group: int, hd: int):
-    """Extract q/k/v from a fused [groups, q_per_group+2, hd, ...] packing
-    (weights [G·P·hd, h] → target [h, heads·hd]; biases [G·P·hd] →
-    [heads·hd])."""
-    sel = {"q": (0, q_per_group), "k": (q_per_group, q_per_group + 1),
-           "v": (q_per_group + 1, q_per_group + 2)}[which]
-
-    def fn(w: np.ndarray) -> np.ndarray:
-        P = q_per_group + 2
-        if w.ndim == 2:
-            w4 = w.reshape(groups, P, hd, w.shape[-1])
-            out = w4[:, sel[0]:sel[1]].reshape(-1, w.shape[-1])
-            return np.ascontiguousarray(out.T)      # [h, heads·hd]
-        return w.reshape(groups, P, hd)[:, sel[0]:sel[1]].reshape(-1)
-
-    return fn
+        if len(index) == 1:    # bias: target [heads·hd]
+            (osl,) = index
+            parts = [reader.read(self.name, (slice(s, e),))
+                     for s, e in self._src_ranges(osl.start, osl.stop)]
+            return np.concatenate(parts, axis=0)
+        # weight: target [h_in, heads·hd]; source stores [rows, h_in]
+        in_sl, out_sl = index
+        parts = [reader.read(self.name, (slice(s, e), in_sl))
+                 for s, e in self._src_ranges(out_sl.start, out_sl.stop)]
+        return np.ascontiguousarray(np.concatenate(parts, axis=0).T)
 
 
 # ------------------------------------------------------------ family mappings
@@ -384,7 +395,7 @@ def _opt_plans(cfg: TransformerConfig, shapes,
         "w_out": lsrc("fc2.weight", transpose=True),
         "w_out_b": lsrc("fc2.bias"),
     }
-    return {
+    plans = {
         "embed": {
             "wte": LeafPlan(Src("model.decoder.embed_tokens.weight"),
                             shapes["embed"]["wte"].shape),
@@ -400,6 +411,11 @@ def _opt_plans(cfg: TransformerConfig, shapes,
             "b": LeafPlan(Src("model.decoder.final_layer_norm.bias"),
                           shapes["final_norm"]["b"].shape)},
     }
+    if not cfg.tie_embeddings:
+        plans["lm_head"] = {"w": LeafPlan(Src("lm_head.weight",
+                                              transpose=True),
+                                          shapes["lm_head"]["w"].shape)}
+    return plans
 
 
 def _neox_plans(cfg: TransformerConfig, shapes,
@@ -413,9 +429,9 @@ def _neox_plans(cfg: TransformerConfig, shapes,
         return lambda i: Src((L + fmt).format(i), transpose=transpose)
 
     def qkv(which, suffix):
-        return lambda i: TransformSrc(
+        return lambda i: FusedQKVSrc(
             (L + f"attention.query_key_value.{suffix}").format(i),
-            _qkv_deinterleave(which, nh, 1, hd))
+            which, nh, 1, hd)
 
     layers = {
         "attn_norm_w": lsrc("input_layernorm.weight"),
@@ -462,9 +478,9 @@ def _bloom_plans(cfg: TransformerConfig, shapes,
         return lambda i: Src((L + fmt).format(i), transpose=transpose)
 
     def qkv(which, suffix):
-        return lambda i: TransformSrc(
+        return lambda i: FusedQKVSrc(
             (L + f"self_attention.query_key_value.{suffix}").format(i),
-            _qkv_deinterleave(which, nh, 1, hd))
+            which, nh, 1, hd)
 
     layers = {
         "attn_norm_w": lsrc("input_layernorm.weight"),
@@ -525,9 +541,9 @@ def _falcon_plans(cfg: TransformerConfig, shapes,
         q_per_group = nh // kvh
 
         def qkv(which):
-            return lambda i: TransformSrc(
+            return lambda i: FusedQKVSrc(
                 (L + "self_attention.query_key_value.weight").format(i),
-                _qkv_deinterleave(which, kvh, q_per_group, hd))
+                which, kvh, q_per_group, hd)
 
         wq, wk, wv = qkv("q"), qkv("k"), qkv("v")
         attn_norm_w = lsrc("ln_attn.weight")
@@ -546,9 +562,9 @@ def _falcon_plans(cfg: TransformerConfig, shapes,
         else:
             # falcon-rw family: per-head interleaved [nh, 3, hd] packing
             def qkv(which):
-                return lambda i: TransformSrc(
+                return lambda i: FusedQKVSrc(
                     (L + "self_attention.query_key_value.weight").format(i),
-                    _qkv_deinterleave(which, nh, 1, hd))
+                    which, nh, 1, hd)
 
             wq, wk, wv = qkv("q"), qkv("k"), qkv("v")
         attn_norm_w = lsrc("input_layernorm.weight")
@@ -574,9 +590,9 @@ def _falcon_plans(cfg: TransformerConfig, shapes,
             qpg = (nh // kvh) if new_arch else 1
 
             def qkv_b(which):
-                return lambda i: TransformSrc(
+                return lambda i: FusedQKVSrc(
                     (L + "self_attention.query_key_value.bias").format(i),
-                    _qkv_deinterleave(which, groups, qpg, hd))
+                    which, groups, qpg, hd)
 
             wq_b, wk_b, wv_b = qkv_b("q"), qkv_b("k"), qkv_b("v")
         else:
@@ -679,7 +695,8 @@ def config_from_hf(hf_config: Dict[str, Any],
             num_heads=hf_config["num_attention_heads"],
             max_seq_len=hf_config.get("max_position_embeddings", 2048),
             norm="layernorm", activation=act, position="learned",
-            tie_embeddings=True, use_bias=True, dtype=dtype)
+            tie_embeddings=hf_config.get("tie_word_embeddings", True),
+            use_bias=True, dtype=dtype)
     if mt == "gpt_neox":
         return TransformerConfig(
             vocab_size=hf_config["vocab_size"],

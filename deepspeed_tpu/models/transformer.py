@@ -254,6 +254,11 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
     if cfg.position == "alibi":
         # additive logit bias: the Pallas kernel takes no bias — the XLA
         # reference fuses it (softmax shift-invariance needs only slopes·k)
+        if _seq_parallel_size() > 1:
+            raise NotImplementedError(
+                "ALiBi models do not support sequence parallelism yet: the "
+                "ring/Ulysses paths carry no logit bias; run BLOOM-family "
+                "models without a sequence mesh axis")
         S = k.shape[1]
         bias = alibi_slopes(cfg.num_heads)[:, None] * jnp.arange(S)[None, :]
         return attention_reference(q, k, v, causal=causal, bias=bias)

@@ -1,0 +1,52 @@
+"""Evoformer attention (DS4Science).
+
+Counterpart of reference ``ops/deepspeed4science/evoformer_attn.py``
+(``DS4Sci_EvoformerAttention`` :88 over the CUTLASS kernels in
+``csrc/deepspeed4science/evoformer_attn/``, ~15k LoC of fused
+attention-with-bias forward/backward). The AlphaFold-style contract:
+``q/k/v`` are ``[*, L, H, D]`` (sequence at -3, heads at -2) and up to two
+additive logit biases — the MSA row mask ``[B, N, 1, 1, L]`` and the
+triangle pair bias ``[B, 1, H, L, L]``.
+
+TPU-native: the whole computation is one XLA-fused
+einsum→bias→softmax→einsum chain (SURVEY §2.2 maps this component to
+"Pallas/XLA"; at AlphaFold's L ≤ ~2k and D ≤ 64 the logits tile fits VMEM
+and XLA's fusion already keeps them out of HBM — the hand-written CUTLASS
+scheduling being replaced is exactly what the compiler does here).
+Autodiff provides the backward, including bias gradients, replacing the
+custom ``attention_bwd``. ``jax.checkpoint`` around the caller handles the
+long-sequence memory case the kernel's streaming solved.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def evoformer_attention(q, k, v, biases: Sequence = ()):
+    """``DS4Sci_EvoformerAttention`` semantics.
+
+    q/k/v: ``[*, L, H, D]``; biases: up to two arrays broadcastable to the
+    logits ``[*, H, L, L]`` (reference shapes ``[B, N, 1, 1, L]`` and
+    ``[B, 1, H, L, L]`` both broadcast). Returns ``[*, L, H, D]``.
+    """
+    if len(biases) > 2:
+        raise ValueError(f"at most two biases (got {len(biases)}) — "
+                         "reference evoformer_attn.py:89 asserts the same")
+    *lead, L, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    for bias in biases:
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+# reference public alias
+DS4Sci_EvoformerAttention = evoformer_attention

@@ -1,0 +1,28 @@
+"""Spatial (diffusers UNet/VAE) fused elementwise ops.
+
+Counterpart of reference ``csrc/spatial/csrc/opt_bias_add.cu`` (298 LoC of
+hand-vectorized NHWC bias-add variants behind ``SpatialInferenceBuilder``).
+On TPU these are single XLA fusions — the functions exist for API parity
+and to document the mapping (SURVEY §2.2 "Spatial → XLA fusion"); each
+compiles to one fused elementwise kernel, which is the entire point of the
+CUDA originals."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def nhwc_bias_add(activation, bias):
+    """out = activation + bias (bias broadcast over N, H, W)."""
+    return activation + bias
+
+
+def nhwc_bias_add_add(activation, bias, other):
+    """out = (activation + bias) + other (reference opt_bias_add_add)."""
+    return activation + bias + other
+
+
+def nhwc_bias_add_bias_add(activation, bias, other, other_bias):
+    """out = (activation + bias) + (other + other_bias)
+    (reference opt_bias_add_bias_add — the UNet residual join)."""
+    return activation + bias + other + other_bias

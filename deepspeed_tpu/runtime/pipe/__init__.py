@@ -5,3 +5,4 @@ from .schedule import (  # noqa: F401
     SendGrad, RecvGrad, LoadMicroBatch, ReduceGrads, ReduceTiedGrads,
     OptimizerStep)
 from .module import LayerSpec, TiedLayerSpec, PipelineModule  # noqa: F401
+from .engine import PipelineEngine  # noqa: F401

@@ -1,0 +1,289 @@
+"""Pipeline instruction-schedule executor.
+
+Counterpart of reference ``runtime/pipe/engine.py`` (``PipelineEngine`` :55
+— ``train_batch`` :312 executes the 1F1B instruction stream through
+``_exec_schedule`` :1331 with P2P activation/grad exchange). The TPU-native
+*fast path* is the SPMD pipeline (``parallel/pipeline.py``: layers sharded
+over the pipe mesh axis, ppermute rotation inside one jitted scan). This
+module is the **host-driven executor** for the classic
+``PipelineModule``/``LayerSpec`` API: it interprets the exact
+``TrainSchedule``/``InferenceSchedule`` instruction streams
+(schedule.py) clock-step by clock-step with real dataflow —
+
+- ``ForwardPass`` runs the stage function under ``jax.vjp`` and keeps the
+  pullback in the pipe buffer (the functional equivalent of retaining the
+  autograd graph per micro-batch);
+- ``Send/RecvActivation`` / ``Send/RecvGrad`` move arrays through FIFO
+  edge mailboxes (single-controller stand-in for the p2p wire protocol,
+  ``pipe/p2p.py`` in the reference — on a multi-slice DCN deployment the
+  mailboxes become host transfers);
+- ``BackwardPass`` applies the saved pullback to the received cotangent
+  (1F1B order ⇒ bounded live activations, exactly the schedule's point);
+- ``ReduceTiedGrads`` sums gradients of tie-group params contributed by
+  every stage that uses them (TiedLayerSpec);
+- ``OptimizerStep`` applies the per-stage optimizer.
+
+Layer protocol (functional stand-in for the reference's nn.Module layers):
+a built LayerSpec object exposes ``init(rng, x) -> params`` and
+``apply(params, x) -> y``.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.optimizers import build_optimizer
+from .module import PipelineModule, TiedLayerSpec
+from . import schedule as sched
+
+
+class PipelineEngine:
+    """Host-driven schedule interpreter over a PipelineModule."""
+
+    def __init__(self, module: PipelineModule, loss_fn: Callable,
+                 num_micro_batches: int, optimizer: str = "sgd",
+                 optimizer_params: Optional[dict] = None, seed: int = 0):
+        self.module = module
+        self.loss_fn = loss_fn
+        self.num_micro = num_micro_batches
+        self.num_stages = module.num_stages
+        self._rng = jax.random.PRNGKey(seed)
+        self._opt = build_optimizer(optimizer,
+                                    optimizer_params or {"lr": 1e-2})
+        self._initialized = False
+        self.global_steps = 0
+
+        # built layers per stage + tie bookkeeping
+        self._stage_layers: List[List[Any]] = []
+        self._tie_key_of: List[List[Optional[str]]] = []
+        for sid in range(self.num_stages):
+            specs = module.stage_layers(sid)
+            built, ties = [], []
+            for spec in specs:
+                built.append(spec.build() if hasattr(spec, "build") else spec)
+                ties.append(spec.key if isinstance(spec, TiedLayerSpec)
+                            else None)
+            self._stage_layers.append(built)
+            self._tie_key_of.append(ties)
+
+    # ------------------------------------------------------------- params
+    def _lazy_init(self, example_x):
+        """Shape-propagating init: tied groups initialize once and share."""
+        tied_params: Dict[str, Any] = {}
+        self.params: List[List[Any]] = []
+        x = example_x
+        for sid in range(self.num_stages):
+            stage_p = []
+            for layer, tie in zip(self._stage_layers[sid],
+                                  self._tie_key_of[sid]):
+                self._rng, sub = jax.random.split(self._rng)
+                if tie is not None and tie in tied_params:
+                    p = tied_params[tie]
+                else:
+                    p = layer.init(sub, x)
+                    if tie is not None:
+                        tied_params[tie] = p
+                stage_p.append(p)
+                x = layer.apply(p, x)
+            self.params.append(stage_p)
+        self.opt_state = [self._opt.init(sp) for sp in self.params]
+        self._initialized = True
+
+    def _stage_apply(self, sid: int, stage_params, x):
+        for layer, p in zip(self._stage_layers[sid], stage_params):
+            x = layer.apply(p, x)
+        return x
+
+    # ---------------------------------------------------------- execution
+    def train_batch(self, data_iter) -> float:
+        """Pull ``num_micro`` (x, y) micro-batches and execute the 1F1B
+        TrainSchedule across all stages (reference train_batch :312)."""
+        micros = [next(data_iter) for _ in range(self.num_micro)]
+        xs = [m[0] if isinstance(m, (tuple, list)) else m["x"]
+              for m in micros]
+        ys = [m[1] if isinstance(m, (tuple, list)) else m["y"]
+              for m in micros]
+        if not self._initialized:
+            self._lazy_init(jnp.asarray(xs[0]))
+
+        S, M = self.num_stages, self.num_micro
+        schedules = [sched.TrainSchedule(M, S, sid).steps()
+                     for sid in range(S)]
+        total = len(schedules[0])
+        assert all(len(s) == total for s in schedules)
+
+        # per-stage machine state
+        inputs = [dict() for _ in range(S)]     # buffer -> stage input
+        outputs = [dict() for _ in range(S)]    # buffer -> stage output
+        pullbacks = [dict() for _ in range(S)]  # buffer -> vjp fn
+        cotangents = [dict() for _ in range(S)]  # buffer -> received grad
+        grad_out = [dict() for _ in range(S)]   # buffer -> grad to send up
+        grads = [jax.tree.map(jnp.zeros_like, sp) for sp in self.params]
+        act_edges = [deque() for _ in range(S)]   # edge (s-1) -> s
+        grad_edges = [deque() for _ in range(S)]  # edge (s+1) -> s
+        load_ptr = [0]          # next micro to load at stage 0
+        label_q = deque()       # labels consumed by last-stage forwards
+        losses: List[jnp.ndarray] = []
+
+        def exec_cmd(sid, cmd):
+            b = getattr(cmd, "buffer_id", None)
+            if isinstance(cmd, sched.LoadMicroBatch):
+                i = load_ptr[0]
+                load_ptr[0] += 1
+                inputs[sid][b] = jnp.asarray(xs[i])
+                label_q.append(jnp.asarray(ys[i]))
+            elif isinstance(cmd, sched.RecvActivation):
+                inputs[sid][b] = act_edges[sid].popleft()
+            elif isinstance(cmd, sched.RecvGrad):
+                cotangents[sid][b] = grad_edges[sid].popleft()
+            elif isinstance(cmd, sched.ForwardPass):
+                x = inputs[sid].pop(b)
+                if sid == S - 1:
+                    y = label_q.popleft()
+
+                    def fwd(sp, xx):
+                        out = self._stage_apply(sid, sp, xx)
+                        return self.loss_fn(out, y)
+
+                    loss, vjp = jax.vjp(fwd, self.params[sid], x)
+                    losses.append(loss)
+                    pullbacks[sid][b] = ("loss", vjp)
+                else:
+                    def fwd(sp, xx):
+                        return self._stage_apply(sid, sp, xx)
+
+                    out, vjp = jax.vjp(fwd, self.params[sid], x)
+                    outputs[sid][b] = out
+                    pullbacks[sid][b] = ("act", vjp)
+            elif isinstance(cmd, sched.BackwardPass):
+                kind, vjp = pullbacks[sid].pop(b)
+                if kind == "loss":
+                    cot = jnp.ones((), losses[-1].dtype) / M
+                else:
+                    cot = cotangents[sid].pop(b)
+                gp, gx = vjp(cot)
+                if sid > 0:
+                    grad_out[sid][b] = gx
+                grads[sid] = jax.tree.map(jnp.add, grads[sid], gp)
+            elif isinstance(cmd, sched.SendActivation):
+                act_edges[sid + 1].append(outputs[sid].pop(b))
+            elif isinstance(cmd, sched.SendGrad):
+                grad_edges[sid - 1].append(grad_out[sid].pop(b))
+            elif isinstance(cmd, sched.ReduceTiedGrads):
+                if sid == 0:
+                    self._reduce_tied_grads(grads)
+            elif isinstance(cmd, sched.ReduceGrads):
+                pass    # DP reduction: single-controller — GSPMD handles DP
+            elif isinstance(cmd, sched.OptimizerStep):
+                if sid == 0:
+                    self._optimizer_step(grads)
+            else:   # pragma: no cover - unknown instruction
+                raise TypeError(f"unknown pipe instruction {cmd!r}")
+
+        # Blocking-p2p semantics (reference pipe/p2p.py): each stage walks
+        # its instruction stream in order; a recv with an empty mailbox
+        # blocks that stage until the producer's send lands. Round-robin
+        # until every stream drains — a correct schedule cannot deadlock.
+        streams = [[c for step in schedules[sid] for c in step]
+                   for sid in range(S)]
+        cursor = [0] * S
+        while any(cursor[s] < len(streams[s]) for s in range(S)):
+            progressed = False
+            for sid in range(S):
+                while cursor[sid] < len(streams[sid]):
+                    cmd = streams[sid][cursor[sid]]
+                    if isinstance(cmd, sched.RecvActivation) \
+                            and not act_edges[sid]:
+                        break
+                    if isinstance(cmd, sched.RecvGrad) \
+                            and not grad_edges[sid]:
+                        break
+                    exec_cmd(sid, cmd)
+                    cursor[sid] += 1
+                    progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    "pipeline schedule deadlock: every stage blocked on a "
+                    "recv — instruction streams are inconsistent")
+
+        self.global_steps += 1
+        return float(jnp.mean(jnp.stack(losses)))
+
+    def _reduce_tied_grads(self, grads):
+        """Sum tie-group gradients across stages, broadcast back
+        (reference _exec_reduce_tied_grads)."""
+        groups: Dict[str, List] = {}
+        for sid in range(self.num_stages):
+            for li, tie in enumerate(self._tie_key_of[sid]):
+                if tie is not None:
+                    groups.setdefault(tie, []).append((sid, li))
+        for tie, sites in groups.items():
+            if len(sites) < 2:
+                continue
+            total = None
+            for sid, li in sites:
+                g = grads[sid][li]
+                total = g if total is None else jax.tree.map(jnp.add,
+                                                             total, g)
+            for sid, li in sites:
+                grads[sid][li] = total
+
+    def _optimizer_step(self, grads):
+        tied_updated: Dict[str, Any] = {}
+        for sid in range(self.num_stages):
+            new_p, new_o = self._opt.step(self.params[sid], grads[sid],
+                                          self.opt_state[sid],
+                                          getattr(self._opt, "lr", 1e-2))
+            self.params[sid] = list(new_p)
+            self.opt_state[sid] = new_o
+        # re-share tied params (each stage stepped its own copy with the
+        # same summed grad + same state ⇒ identical values; aliasing keeps
+        # future updates in lockstep)
+        for sid in range(self.num_stages):
+            for li, tie in enumerate(self._tie_key_of[sid]):
+                if tie is None:
+                    continue
+                if tie in tied_updated:
+                    self.params[sid][li] = tied_updated[tie]
+                else:
+                    tied_updated[tie] = self.params[sid][li]
+
+    # ---------------------------------------------------------- inference
+    def eval_batch(self, x) -> jnp.ndarray:
+        """Forward-only fill-drain (InferenceSchedule :135): one micro."""
+        if not self._initialized:
+            self._lazy_init(jnp.asarray(x))
+        out = jnp.asarray(x)
+        S = self.num_stages
+        streams = [[c for step in sched.InferenceSchedule(1, S, sid).steps()
+                    for c in step] for sid in range(S)]
+        act_edges = [deque() for _ in range(S)]
+        vals = [None] * S
+        cursor = [0] * S
+        while any(cursor[s] < len(streams[s]) for s in range(S)):
+            progressed = False
+            for sid in range(S):
+                while cursor[sid] < len(streams[sid]):
+                    cmd = streams[sid][cursor[sid]]
+                    if isinstance(cmd, sched.RecvActivation) \
+                            and not act_edges[sid]:
+                        break
+                    if isinstance(cmd, sched.LoadMicroBatch):
+                        vals[sid] = out
+                    elif isinstance(cmd, sched.RecvActivation):
+                        vals[sid] = act_edges[sid].popleft()
+                    elif isinstance(cmd, sched.ForwardPass):
+                        vals[sid] = self._stage_apply(
+                            sid, self.params[sid], vals[sid])
+                    elif isinstance(cmd, sched.SendActivation):
+                        act_edges[sid + 1].append(vals[sid])
+                    cursor[sid] += 1
+                    progressed = True
+            if not progressed:
+                raise RuntimeError("inference schedule deadlock")
+        return vals[S - 1]

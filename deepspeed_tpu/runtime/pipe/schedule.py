@@ -4,9 +4,10 @@ Counterpart of reference ``runtime/pipe/schedule.py`` (``TrainSchedule``
 :189 — 1F1B; ``InferenceSchedule`` :135; instruction classes :327-489).
 On TPU the hot path does not interpret these instructions — the SPMD
 pipeline (parallel/pipeline.py) compiles the whole schedule into one XLA
-program — but the generators are kept for parity, debugging (they describe
-the logical schedule the compiled program implements), and for the
-host-driven multi-slice pipeline planned over DCN.
+program. These generators drive the host-level executor
+(runtime/pipe/engine.py PipelineEngine), which interprets the streams with
+real dataflow for the classic PipelineModule/LayerSpec API and is the
+skeleton of the multi-slice DCN pipeline.
 """
 
 from __future__ import annotations
@@ -171,12 +172,12 @@ class TrainSchedule(PipeSchedule):
         return (step_id - 1) // 2 - self.stage_id // 2
 
     def _even_step_backward_id(self, step_id):
-        return step_id // 2 - self.stages + self.stage_id // 2 + 1 \
-            + (self.stage_id % 2)
+        # only reached for odd stages (even step + odd stage → backward)
+        return step_id // 2 - self.stages + (self.stage_id + 1) // 2
 
     def _odd_step_backward_id(self, step_id):
-        return (step_id - 1) // 2 - self.stages + self.stage_id // 2 + 1 \
-            + (self.stage_id % 2)
+        # only reached for even stages
+        return (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
 
     def steps(self):
         total_steps = 2 * (self.micro_batches + self.stages - 1)

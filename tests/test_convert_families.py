@@ -201,3 +201,20 @@ def test_falcon_rw_alibi_parity(tmp_path_factory):
     assert model.cfg.position == "alibi"
     assert not model.cfg.parallel_residual
     assert model.cfg.kv_heads == 4
+
+
+def test_opt_untied_embeddings(tmp_path_factory):
+    from transformers import OPTConfig, OPTForCausalLM
+
+    cfg = OPTConfig(vocab_size=88, hidden_size=32, ffn_dim=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64, do_layer_norm_before=True,
+                    word_embed_proj_dim=32, tie_word_embeddings=False)
+    torch.manual_seed(9)
+    hf = OPTForCausalLM(cfg).eval()
+    with torch.no_grad():   # untie for real: distinct lm_head weights
+        hf.lm_head.weight = torch.nn.Parameter(
+            torch.randn_like(hf.lm_head.weight) * 0.1)
+    path = _save(hf, tmp_path_factory, "opt_untied")
+    model = _parity(path, hf, 88)
+    assert not model.cfg.tie_embeddings

@@ -1,0 +1,205 @@
+"""Host-driven pipeline executor (reference runtime/pipe/engine.py
+PipelineEngine): the classic LayerSpec/PipelineModule API trains for real —
+1F1B schedule interpretation with exact gradient parity against
+non-pipelined training, tied-layer gradient reduction, and forward-only
+inference schedules."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizers import build_optimizer
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+
+
+class Linear:
+    """Minimal functional layer honoring the executor's layer protocol."""
+
+    def __init__(self, out_dim, act=True):
+        self.out_dim = out_dim
+        self.act = act
+
+    def init(self, rng, x):
+        k1, k2 = jax.random.split(rng)
+        w = 0.3 * jax.random.normal(k1, (x.shape[-1], self.out_dim))
+        b = jnp.zeros((self.out_dim,))
+        return {"w": w, "b": b}
+
+    def apply(self, p, x):
+        y = x @ p["w"] + p["b"]
+        return jnp.tanh(y) if self.act else y
+
+
+class Embed:
+    def __init__(self, vocab, dim):
+        self.vocab, self.dim = vocab, dim
+
+    def init(self, rng, x):
+        return {"e": 0.3 * jax.random.normal(rng, (self.vocab, self.dim))}
+
+    def apply(self, p, x):
+        return p["e"][x]
+
+
+class Unembed:
+    """Tied to Embed: same params, transposed use."""
+
+    def __init__(self, vocab, dim):
+        self.vocab, self.dim = vocab, dim
+
+    def init(self, rng, x):   # only called if the tie group is new
+        return {"e": 0.3 * jax.random.normal(rng, (self.vocab, self.dim))}
+
+    def apply(self, p, x):
+        return x @ p["e"].T
+
+
+def mse(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def micro_iter(xs, ys):
+    return iter(list(zip(xs, ys)))
+
+
+def test_1f1b_matches_sequential_training():
+    """3 optimizer steps through the 4-stage 1F1B executor must equal the
+    same layers trained unpipelined with the same optimizer."""
+    specs = [LayerSpec(Linear, 8), LayerSpec(Linear, 8),
+             LayerSpec(Linear, 8), LayerSpec(Linear, 4, act=False)]
+    module = PipelineModule(specs, num_stages=4,
+                            partition_method="uniform")
+    eng = PipelineEngine(module, mse, num_micro_batches=4,
+                         optimizer="sgd", optimizer_params={"lr": 0.1},
+                         seed=0)
+    rng = np.random.default_rng(0)
+    data = [(jnp.asarray(rng.normal(size=(2, 8)).astype(np.float32)),
+             jnp.asarray(rng.normal(size=(2, 4)).astype(np.float32)))
+            for _ in range(12)]
+    losses = []
+    for step in range(3):
+        losses.append(eng.train_batch(micro_iter(
+            *zip(*data[step * 4:(step + 1) * 4]))))
+
+    # sequential reference with identical init (same PRNG stream)
+    ref = PipelineEngine(module, mse, num_micro_batches=4,
+                         optimizer="sgd", optimizer_params={"lr": 0.1},
+                         seed=0)
+    ref._lazy_init(data[0][0])
+    params = [list(sp) for sp in ref.params]
+    opt = build_optimizer("sgd", {"lr": 0.1})
+    flat = [p for sp in params for p in sp]
+    state = opt.init(flat)
+    layers = [l for sl in ref._stage_layers for l in sl]
+
+    def loss_fn(flat_params, x, y):
+        for layer, p in zip(layers, flat_params):
+            x = layer.apply(p, x)
+        return mse(x, y)
+
+    for step in range(3):
+        grads = None
+        for x, y in data[step * 4:(step + 1) * 4]:
+            g = jax.grad(loss_fn)(flat, x, y)
+            g = jax.tree.map(lambda v: v / 4.0, g)
+            grads = g if grads is None else jax.tree.map(jnp.add, grads, g)
+        flat, state = opt.step(flat, grads, state, 0.1)
+
+    pipe_flat = [p for sp in eng.params for p in sp]
+    for a, b in zip(jax.tree.leaves(pipe_flat), jax.tree.leaves(flat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert np.isfinite(losses).all()
+
+
+def test_tied_embedding_grads_reduced():
+    """TiedLayerSpec shares params across stages; after a step both sites
+    hold the identical updated array, matching a reference where the tied
+    param receives the SUM of both sites' gradients."""
+    V, H = 12, 6
+    specs = [TiedLayerSpec("emb", Embed, V, H),
+             LayerSpec(Linear, H),
+             TiedLayerSpec("emb", Unembed, V, H)]
+    module = PipelineModule(specs, num_stages=3,
+                            partition_method="uniform")
+
+    def ce(logits, y):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None],
+                                             axis=-1))
+
+    eng = PipelineEngine(module, ce, num_micro_batches=2,
+                         optimizer="sgd", optimizer_params={"lr": 0.05},
+                         seed=1)
+    rng = np.random.default_rng(1)
+    data = [(jnp.asarray(rng.integers(0, V, size=(3, 5))),
+             jnp.asarray(rng.integers(0, V, size=(3, 5))))
+            for _ in range(4)]
+    l0 = eng.train_batch(micro_iter(*zip(*data[:2])))
+
+    emb0 = eng.params[0][0]["e"]
+    emb2 = eng.params[2][0]["e"]
+    np.testing.assert_array_equal(np.asarray(emb0), np.asarray(emb2))
+
+    # reference: same tied weight used twice, grads naturally summed
+    ref = PipelineEngine(module, ce, num_micro_batches=2,
+                         optimizer="sgd", optimizer_params={"lr": 0.05},
+                         seed=1)
+    ref._lazy_init(data[0][0])
+    e0 = ref.params[0][0]
+    mid = ref.params[1][0]
+    lin = ref._stage_layers[1][0]
+
+    def loss_fn(e, mid_p, x, y):
+        h = e["e"][x]
+        h = lin.apply(mid_p, h)
+        return ce(h @ e["e"].T, y)
+
+    opt = build_optimizer("sgd", {"lr": 0.05})
+    state = opt.init({"e": e0, "mid": mid})
+    ge = gm = None
+    for x, y in data[:2]:
+        g_e, g_m = jax.grad(loss_fn, argnums=(0, 1))(e0, mid, x, y)
+        g_e = jax.tree.map(lambda v: v / 2.0, g_e)
+        g_m = jax.tree.map(lambda v: v / 2.0, g_m)
+        ge = g_e if ge is None else jax.tree.map(jnp.add, ge, g_e)
+        gm = g_m if gm is None else jax.tree.map(jnp.add, gm, g_m)
+    newp, _ = opt.step({"e": e0, "mid": mid}, {"e": ge, "mid": gm},
+                       state, 0.05)
+    np.testing.assert_allclose(np.asarray(emb0), np.asarray(newp["e"]["e"]),
+                               rtol=1e-5, atol=1e-6)
+    assert np.isfinite(l0)
+
+
+def test_inference_schedule_matches_direct():
+    specs = [LayerSpec(Linear, 8), LayerSpec(Linear, 8),
+             LayerSpec(Linear, 4, act=False)]
+    module = PipelineModule(specs, num_stages=3,
+                            partition_method="uniform")
+    eng = PipelineEngine(module, mse, num_micro_batches=2, seed=2)
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8))
+                    .astype(np.float32))
+    out = eng.eval_batch(x)
+    direct = x
+    for sid in range(3):
+        direct = eng._stage_apply(sid, eng.params[sid], direct)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(direct),
+                               rtol=1e-6)
+
+
+def test_loss_decreases_over_steps():
+    specs = [LayerSpec(Linear, 16), LayerSpec(Linear, 4, act=False)]
+    module = PipelineModule(specs, num_stages=2,
+                            partition_method="uniform")
+    eng = PipelineEngine(module, mse, num_micro_batches=2,
+                         optimizer="adam", optimizer_params={"lr": 1e-2},
+                         seed=3)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(4, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+    losses = [eng.train_batch(micro_iter([x, x], [y, y]))
+              for _ in range(10)]
+    assert losses[-1] < losses[0]
