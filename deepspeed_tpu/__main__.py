@@ -1,0 +1,32 @@
+"""``python -m deepspeed_tpu`` — the launcher CLI (reference ``bin/deepspeed``).
+
+Subcommand-free: forwards to the launcher's main (hostfile parse,
+include/exclude filters, ssh fan-out, ``--autotune``). ``--report`` prints
+the environment report (reference ``bin/ds_report``)."""
+
+import os
+import sys
+
+
+def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        # site plugins (axon) can pin jax_platforms at interpreter start;
+        # honor the user's env override before any device query (same
+        # workaround as tests/conftest.py)
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception:
+            pass
+    if "--report" in sys.argv[1:2]:
+        from .env_report import main as report_main
+
+        return report_main()
+    from .launcher.runner import main as runner_main
+
+    return runner_main()
+
+
+if __name__ == "__main__":
+    main()

@@ -50,7 +50,8 @@ class DeepSpeedTpuDataLoader:
 
     def __init__(self, dataset, batch_size: int, topology=None,
                  collate_fn: Optional[Callable] = None, seed: int = 1234,
-                 shuffle: bool = True, drop_last: bool = True):
+                 shuffle: bool = True, drop_last: bool = True,
+                 data_sampler=None):
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn
@@ -58,6 +59,10 @@ class DeepSpeedTpuDataLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        # optional index-batch source (e.g. the curriculum
+        # DeepSpeedDataSampler, runtime/data_pipeline/data_sampler.py) —
+        # reference deepspeed_io(data_sampler=...) contract
+        self.data_sampler = data_sampler
         import jax
 
         self.num_shards = jax.process_count()
@@ -97,6 +102,15 @@ class DeepSpeedTpuDataLoader:
         return np.stack([np.asarray(e) for e in examples])
 
     def __iter__(self):
+        if self.data_sampler is not None:
+            # sampler yields global-batch index arrays (difficulty-gated
+            # under curriculum learning); the loader contract is one FULL
+            # global micro batch per yield — identical to the index path
+            # below — so the engine's sharded device_put sees the same
+            # shape either way
+            for indices in self.data_sampler:
+                yield self._gather(np.asarray(indices))
+            return
         n = self._len_dataset()
         if n is None:
             # iterable of prepared batches

@@ -817,7 +817,8 @@ class DeepSpeedTpuEngine:
             batch_size=batch_size or global_micro,
             topology=self.topology,
             collate_fn=collate_fn,
-            seed=self.config.seed)
+            seed=self.config.seed,
+            data_sampler=data_sampler)
 
     def _device_batch(self, batch):
         """Shard a host batch over the data axes."""

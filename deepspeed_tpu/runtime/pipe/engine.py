@@ -31,11 +31,10 @@ a built LayerSpec object exposes ``init(rng, x) -> params`` and
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ...ops.optimizers import build_optimizer
 from .module import PipelineModule, TiedLayerSpec

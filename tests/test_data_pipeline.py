@@ -210,3 +210,35 @@ def test_engine_seqlen_curriculum(devices8):
         assert np.isfinite(float(loss))
     # schedule exhausted: difficulty at max (= full 32-token sequence)
     assert engine.curriculum_scheduler.get_current_difficulty() == 32
+
+
+def test_engine_deepspeed_io_with_curriculum_sampler(devices8):
+    """deepspeed_io(data_sampler=...): the loader draws difficulty-gated
+    index batches from the curriculum sampler (reference engine.py
+    deepspeed_io + data_pipeline sampler integration)."""
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": -1, "fsdp": 1},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=build_model("tiny"),
+                                               config=cfg)
+    n = 64
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(n, 33),
+                                      dtype=np.int64)}
+    lengths = np.arange(1, n + 1)     # sample i has difficulty i+1
+    sampler = DeepSpeedDataSampler(
+        sampler_config(), one_epoch_total_samples=n,
+        micro_batch_size=2,
+        data_parallel_size=engine.topology.get_data_parallel_world_size(),
+        metric_values={"seqlen": lengths})
+    loader = engine.deepspeed_io(data, data_sampler=iter(sampler))
+    it = iter(loader)
+    batch = next(it)
+    assert batch["input_ids"].shape[0] == 16    # global micro batch
+    loss = engine.train_batch(iter([batch]))
+    assert np.isfinite(float(loss))
