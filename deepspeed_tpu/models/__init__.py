@@ -14,6 +14,11 @@ from .transformer import (  # noqa: F401
     LLAMA2_7B,
     LLAMA2_70B,
     MISTRAL_7B,
+    QWEN2_7B,
+    OPT_1B3,
+    PYTHIA_1B4,
+    BLOOM_560M,
+    FALCON_7B,
     TINY_TEST,
 )
 
@@ -29,6 +34,11 @@ MODEL_CONFIGS = {
     "llama2-7b": LLAMA2_7B,
     "llama2-70b": LLAMA2_70B,
     "mistral-7b": MISTRAL_7B,
+    "qwen2-7b": QWEN2_7B,
+    "opt-1.3b": OPT_1B3,
+    "pythia-1.4b": PYTHIA_1B4,
+    "bloom-560m": BLOOM_560M,
+    "falcon-7b": FALCON_7B,
     "tiny": TINY_TEST,
 }
 

@@ -73,6 +73,7 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
+    moe_dropless: bool = False   # ragged_dot grouped GEMM (moe/grouped.py)
 
     @property
     def head_dim(self) -> int:
@@ -121,6 +122,39 @@ MISTRAL_7B = TransformerConfig(vocab_size=32000, hidden_size=4096,
                                norm="rmsnorm", activation="silu",
                                position="rope", tie_embeddings=False,
                                rope_theta=10000.0, dtype=jnp.bfloat16)
+QWEN2_7B = TransformerConfig(vocab_size=152064, hidden_size=3584,
+                             intermediate_size=18944, num_layers=28,
+                             num_heads=28, num_kv_heads=4, max_seq_len=32768,
+                             norm="rmsnorm", activation="silu",
+                             position="rope", rope_theta=1e6,
+                             tie_embeddings=False, qkv_bias=True,
+                             norm_eps=1e-6, dtype=jnp.bfloat16)
+OPT_1B3 = TransformerConfig(vocab_size=50272, hidden_size=2048,
+                            intermediate_size=8192, num_layers=24,
+                            num_heads=32, max_seq_len=2048,
+                            norm="layernorm", activation="relu",
+                            position="learned", tie_embeddings=True,
+                            use_bias=True, dtype=jnp.bfloat16)
+PYTHIA_1B4 = TransformerConfig(vocab_size=50304, hidden_size=2048,
+                               intermediate_size=8192, num_layers=24,
+                               num_heads=16, max_seq_len=2048,
+                               norm="layernorm", activation="gelu_exact",
+                               position="rope", rope_pct=0.25,
+                               parallel_residual=True, tie_embeddings=False,
+                               use_bias=True, dtype=jnp.bfloat16)
+BLOOM_560M = TransformerConfig(vocab_size=250880, hidden_size=1024,
+                               intermediate_size=4096, num_layers=24,
+                               num_heads=16, max_seq_len=2048,
+                               norm="layernorm", activation="gelu",
+                               position="alibi", embedding_layernorm=True,
+                               tie_embeddings=True, use_bias=True,
+                               dtype=jnp.bfloat16)
+FALCON_7B = TransformerConfig(vocab_size=65024, hidden_size=4544,
+                              intermediate_size=18176, num_layers=32,
+                              num_heads=71, num_kv_heads=1, max_seq_len=2048,
+                              norm="layernorm", activation="gelu_exact",
+                              position="rope", parallel_residual=True,
+                              tie_embeddings=True, dtype=jnp.bfloat16)
 TINY_TEST = TransformerConfig(vocab_size=256, hidden_size=64,
                               intermediate_size=128, num_layers=2,
                               num_heads=4, num_kv_heads=2, max_seq_len=128,
@@ -499,6 +533,23 @@ class CausalLM:
         dt = cfg.dtype
         tokens = h2.reshape(B * T, M)
         logits = tokens.astype(jnp.float32) @ lp["router_wg"].astype(jnp.float32)
+        if cfg.moe_dropless:
+            from ..parallel import topology as topo
+
+            if (topo.has_topology()
+                    and topo.get_topology().get_expert_parallel_world_size() > 1):
+                raise ValueError(
+                    "moe_dropless (ragged_dot grouped GEMM) runs per-shard; "
+                    "use the capacity path for expert parallelism "
+                    "(moe/grouped.py docstring)")
+            if cfg.moe_top_k != 1:
+                raise ValueError("moe_dropless supports top-1 routing")
+            from ..moe.grouped import dropless_moe_mlp
+
+            y, l_aux = dropless_moe_mlp(
+                tokens, logits, lp["w_in"], lp["w_out"], lp.get("w_gate"),
+                activation=cfg.activation, dtype=dt)
+            return y.reshape(B, T, M), l_aux
         gate_rng = None if deterministic else rng
         if cfg.moe_top_k == 1:
             l_aux, combine, dispatch, _ = top1gating(

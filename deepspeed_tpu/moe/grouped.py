@@ -1,0 +1,69 @@
+"""Dropless grouped-GEMM MoE (megablox-style).
+
+Counterpart of reference ``inference/v2/kernels/cutlass_ops/moe_gemm``
+(CUTLASS grouped GEMM over per-expert token groups) and the capacity-free
+execution style of modern MoE serving. The GShard capacity path
+(``sharded_moe.py``) pads every expert to a fixed capacity — simple to
+shard, but wastes FLOPs on padding and drops overflow tokens. This path
+sorts tokens by their routed expert and runs ``jax.lax.ragged_dot``
+(TPU-native grouped matmul — the same op Pallas megablox kernels back)
+over the true group sizes: no padding FLOPs, no dropped tokens.
+
+Single-device (per-shard) formulation: with expert parallelism the
+capacity-einsum path remains the sharded implementation (its all-to-all is
+the EP collective); ``ragged_dot``'s group dimension cannot span an
+``expert`` mesh axis. That mirrors the reference, where the cutlass
+grouped GEMM also runs per-rank after dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def dropless_moe_mlp(tokens: jax.Array, router_logits: jax.Array,
+                     w_in: jax.Array, w_out: jax.Array,
+                     w_gate: Optional[jax.Array] = None,
+                     activation: str = "gelu",
+                     dtype=None) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 dropless MoE FFN.
+
+    tokens [N, H]; router_logits [N, E] (fp32); w_in [E, H, M];
+    w_out [E, M, H]; w_gate [E, H, M] for SwiGLU. Returns
+    (out [N, H], aux_loss) — aux is the GShard load-balancing loss
+    (E · Σ_e fraction_tokens_e · fraction_probs_e), same as top1gating.
+    """
+    N, H = tokens.shape
+    E = router_logits.shape[-1]
+    dtype = dtype or tokens.dtype
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(router_logits, axis=-1)          # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # load-balance aux (reference sharded_moe.py top1gating l_aux)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(expert, E, dtype=jnp.float32), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # sort tokens by expert; group sizes are the per-expert counts
+    order = jnp.argsort(expert)                          # stable
+    sorted_tokens = tokens[order].astype(dtype)
+    group_sizes = jnp.zeros((E,), jnp.int32).at[expert].add(1)
+
+    h = lax.ragged_dot(sorted_tokens, w_in.astype(dtype), group_sizes)
+    if w_gate is not None and activation == "silu":
+        g = lax.ragged_dot(sorted_tokens, w_gate.astype(dtype), group_sizes)
+        h = jax.nn.silu(g) * h
+    elif activation == "relu":
+        h = jax.nn.relu(h)
+    else:
+        h = jax.nn.gelu(h, approximate=activation != "gelu_exact")
+    out_sorted = lax.ragged_dot(h, w_out.astype(dtype), group_sizes)
+
+    # unsort + gate scale
+    out = jnp.zeros_like(out_sorted).at[order].set(out_sorted)
+    return out * gate[:, None].astype(dtype), l_aux

@@ -17,3 +17,14 @@ from .optimizers import (  # noqa: F401
     SGD,
     Adagrad,
 )
+from .onebit import OneBitAdam, OneBitLamb, ZeroOneAdam  # noqa: F401
+from .evoformer_attn import DS4Sci_EvoformerAttention  # noqa: F401
+from .sparse_attention import (  # noqa: F401
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+    sparse_attention,
+)

@@ -78,6 +78,9 @@ class DeepSpeedTpuDataLoader:
             return None
 
     def __len__(self):
+        if self.data_sampler is not None:
+            # the sampler owns batching: its length is in samples
+            return len(self.data_sampler) // self.batch_size
         n = self._len_dataset()
         if n is None:
             raise TypeError("iterable dataset has no length")

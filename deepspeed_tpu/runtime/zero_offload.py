@@ -119,20 +119,29 @@ class OffloadOptimizerPlan:
                          if shard_leaves[i] is not None else jax.numpy.asarray(arr))
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
+    def host_update_leaf(self, i: int, grad: np.ndarray, lr: float) -> None:
+        """C++ host optimizer step for ONE offloaded leaf (the unit of the
+        pipelined step — engine._offload_step overlaps leaf i's update with
+        leaf i+1's device→host gradient transfer, the reference's
+        stream-overlap of stage_1_and_2.py:1096 expressed as a transfer/
+        compute pipeline)."""
+        g = np.ascontiguousarray(grad.reshape(-1), np.float32)
+        master = self.masters[i].reshape(-1)
+        if self.swapper is not None:
+            state = {mk: self.swapper.load(f"leaf{i}_{mk}")
+                     for mk in self.states[i]}
+        else:
+            state = self.states[i]
+        self.cpu_opt.step(master, g, state, lr=lr)
+        if self.swapper is not None:
+            for mk, arr in state.items():
+                self.swapper.store(f"leaf{i}_{mk}", arr)
+
     def host_update(self, off_grads: Dict[int, np.ndarray], lr: float) -> Dict[int, np.ndarray]:
-        """Run the C++ host optimizer on every offloaded leaf."""
+        """Run the C++ host optimizer on every offloaded leaf (serial
+        convenience path; the engine uses the pipelined per-leaf form)."""
         for i in self.offloaded:
-            g = np.ascontiguousarray(off_grads[i].reshape(-1), np.float32)
-            master = self.masters[i].reshape(-1)
-            if self.swapper is not None:
-                state = {mk: self.swapper.load(f"leaf{i}_{mk}")
-                         for mk in self.states[i]}
-            else:
-                state = self.states[i]
-            self.cpu_opt.step(master, g, state, lr=lr)
-            if self.swapper is not None:
-                for mk, arr in state.items():
-                    self.swapper.store(f"leaf{i}_{mk}", arr)
+            self.host_update_leaf(i, off_grads[i], lr)
         return self.masters
 
     def close(self):
