@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...models.transformer import CausalLM, _linear, _norm, rope_table
+from ...models.transformer import (CausalLM, _linear, _norm, alibi_slopes,
+                                   rope_table)
 from ...ops.paged_attention import paged_attention
 
 
@@ -37,12 +38,6 @@ class PagedCausalLM:
                  max_blocks_per_seq: int):
         self.model = model
         self.cfg = model.cfg
-        if self.cfg.position == "alibi":
-            raise NotImplementedError(
-                "paged (v2) serving does not support ALiBi models yet — the "
-                "Pallas paged kernel takes no logit bias; serve BLOOM-family "
-                "models through the v1 engine (its decode path applies the "
-                "ALiBi bias, models/transformer.py _block_decode)")
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.forward = jax.jit(self._forward)
@@ -67,11 +62,16 @@ class PagedCausalLM:
             x = _norm(x, params["embed"]["ln_w"],
                       params["embed"].get("ln_b"), cfg.norm, cfg.norm_eps)
         positions = start_pos[:, None] + jnp.arange(C)[None, :]  # [N, C]
+        slopes = None
         if cfg.position == "rope":
             cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.rot_dim,
                                             cfg.rope_theta)
             cos = cos_full[positions]                           # [N, C, R/2]
             sin = sin_full[positions]
+        elif cfg.position == "alibi":
+            # bias applied inside the paged kernel (slope · kv_position)
+            slopes = alibi_slopes(cfg.num_heads)
+            cos = sin = None
         else:
             x = x + params["embed"]["wpe"][positions].astype(dt)
             cos = sin = None
@@ -127,7 +127,7 @@ class PagedCausalLM:
 
             # paged read: Pallas block-table walk (reference blocked_flash)
             attn = paged_attention(q, kc, vc, block_tables, start_pos,
-                                   n_tokens)
+                                   n_tokens, alibi_slopes=slopes)
             attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
                                lp.get("wo_b"), dt)
             x = self.model._attn_mlp_merge(x, attn_out, lp)

@@ -21,7 +21,7 @@ long-sequence memory case the kernel's streaming solved.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

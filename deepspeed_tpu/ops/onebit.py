@@ -46,7 +46,6 @@ Documented divergences from the reference (design, not omission):
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -60,12 +60,14 @@ def _use_interpret() -> bool:
 
 # ------------------------------------------------------------------- kernel
 
-def _paged_kernel(tables_ref, startp_ref, ntok_ref, q_ref, k_ref, v_ref,
-                  o_ref, acc_ref, m_ref, l_ref, *, block_size: int,
-                  chunk: int, sm_scale: float):
+def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
+                  k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  block_size: int, chunk: int, groups: int,
+                  sm_scale: float, alibi: bool):
     """One (n, kh, b) grid step: fold table block b of sequence n into the
     online softmax of its [G·C, D] query group."""
     n = pl.program_id(0)
+    kh = pl.program_id(1)
     b = pl.program_id(2)
     nb = pl.num_programs(2)
 
@@ -90,6 +92,15 @@ def _paged_kernel(tables_ref, startp_ref, ntok_ref, q_ref, k_ref, v_ref,
         ci = lax.broadcasted_iota(jnp.int32, s.shape, 0) % chunk
         qpos = startp_ref[n] + ci
         kvpos = b * block_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if alibi:
+            # ALiBi logit bias: slope[head] · kv_position (row r of this
+            # kv-head group belongs to head kh·G + r//C). Slopes live in
+            # SMEM; the static G-unroll keeps reads scalar.
+            gi = lax.broadcasted_iota(jnp.int32, s.shape, 0) // chunk
+            slope = jnp.zeros_like(s[:, :1])
+            for g in range(groups):
+                slope = jnp.where(gi[:, :1] == g, slopes_ref[kh, g], slope)
+            s = s + slope * kvpos.astype(jnp.float32)
         s = jnp.where((kvpos <= qpos) & (kvpos < ctx_len), s, NEG_INF)
         m_prev, l_prev = m_ref[...], l_ref[...]               # [G*C, 128]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -121,7 +132,7 @@ def _clamp_tables(block_tables, ctx_len, block_size):
 
 
 def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
-                  interpret: bool):
+                  alibi_slopes=None, interpret: bool):
     N, C, H, D = q.shape
     NB, KH, bs, _ = k_pool.shape
     G = H // KH
@@ -135,22 +146,29 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
     tables = _clamp_tables(block_tables, ctx_len, bs)
     startp = start_pos.astype(jnp.int32)
     ntok = n_tokens.astype(jnp.int32)
+    alibi = alibi_slopes is not None
+    # slopes regrouped [KH, G] so the kernel reads its kv-head's row
+    slopes = (jnp.asarray(alibi_slopes, jnp.float32).reshape(KH, G)
+              if alibi else jnp.zeros((KH, G), jnp.float32))
 
     kernel = functools.partial(_paged_kernel, block_size=bs, chunk=C,
-                               sm_scale=sm_scale)
+                               groups=G, sm_scale=sm_scale, alibi=alibi)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(N, KH, MB),
         in_specs=[
             pl.BlockSpec((1, 1, G * C, D),
-                         lambda n, kh, b, tbl, sp, nt: (n, kh, 0, 0)),
+                         lambda n, kh, b, tbl, sp, nt, sl: (n, kh, 0, 0)),
             pl.BlockSpec((1, 1, bs, D),
-                         lambda n, kh, b, tbl, sp, nt: (tbl[n, b], kh, 0, 0)),
+                         lambda n, kh, b, tbl, sp, nt, sl:
+                         (tbl[n, b], kh, 0, 0)),
             pl.BlockSpec((1, 1, bs, D),
-                         lambda n, kh, b, tbl, sp, nt: (tbl[n, b], kh, 0, 0)),
+                         lambda n, kh, b, tbl, sp, nt, sl:
+                         (tbl[n, b], kh, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, G * C, D),
-                               lambda n, kh, b, tbl, sp, nt: (n, kh, 0, 0)),
+                               lambda n, kh, b, tbl, sp, nt, sl:
+                               (n, kh, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((G * C, D), jnp.float32),
             pltpu.VMEM((G * C, LANES), jnp.float32),
@@ -164,7 +182,7 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(tables, startp, ntok, qh, k_pool, v_pool)
+    )(tables, startp, ntok, slopes, qh, k_pool, v_pool)
     # [N, KH, G*C, D] -> [N, C, H, D]
     return (o.reshape(N, KH, G, C, D).transpose(0, 3, 1, 2, 4)
             .reshape(N, C, H, D))
@@ -172,7 +190,8 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
 
 # ----------------------------------------------------------- XLA reference
 
-def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens):
+def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
+                        alibi_slopes=None):
     """Dense-gather formulation (the pre-Pallas path): gather the table into
     [N, MB*bs, KH, D] and mask. Numerically the kernel's reference."""
     N, C, H, D = q.shape
@@ -191,6 +210,10 @@ def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens):
 
     qg = q.reshape(N, C, KH, G, D)
     s = jnp.einsum("nckgd,nksd->nkgcs", qg, k_ctx).astype(jnp.float32) * sm_scale
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32).reshape(KH, G)
+        s = s + (slopes[None, :, :, None, None]
+                 * ctx_positions[None, None, None, None, :])
     ctx_len = (start_pos + n_tokens)[:, None]
     qpos = start_pos[:, None] + jnp.arange(C)[None, :]          # [N, C]
     causal = qpos[:, None, None, :, None] >= ctx_positions[None, None, None, None, :]
@@ -210,17 +233,21 @@ def _pallas_ok(q, k_pool) -> bool:
             and (_on_tpu() or _FORCE_INTERPRET))
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens):
+def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
+                    alibi_slopes=None):
     """Block-table paged attention.
 
     q [N, C, H, D]; k/v pool [NB, KH, bs, D]; block_tables [N, MB]
     (entries < 0 = unallocated); start_pos/n_tokens [N]. The pool must
     already contain this chunk's K/V (write-then-attend, like the
     reference's blocked_kv_rotary-then-blocked_flash sequence).
+    ``alibi_slopes`` [H]: optional ALiBi bias slopes (BLOOM-family
+    serving) — bias slope·kv_position is added to the logits in-kernel.
     Rows beyond n_tokens are garbage (masked out downstream).
     """
     if _pallas_ok(q, k_pool):
         return _paged_pallas(q, k_pool, v_pool, block_tables, start_pos,
-                             n_tokens, interpret=_use_interpret())
+                             n_tokens, alibi_slopes=alibi_slopes,
+                             interpret=_use_interpret())
     return paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos,
-                               n_tokens)
+                               n_tokens, alibi_slopes=alibi_slopes)

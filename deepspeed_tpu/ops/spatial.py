@@ -9,7 +9,6 @@ CUDA originals."""
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 
 def nhwc_bias_add(activation, bias):

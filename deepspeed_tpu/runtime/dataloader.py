@@ -79,8 +79,17 @@ class DeepSpeedTpuDataLoader:
 
     def __len__(self):
         if self.data_sampler is not None:
-            # the sampler owns batching: its length is in samples
-            return len(self.data_sampler) // self.batch_size
+            # the sampler owns batching: len() is in samples and each yield
+            # consumes the sampler's OWN global batch (which includes its
+            # gradient-accumulation factor)
+            try:
+                per_yield = getattr(self.data_sampler, "global_batch_size",
+                                    self.batch_size)
+                return len(self.data_sampler) // per_yield
+            except TypeError:
+                raise TypeError(
+                    "data_sampler has no length (pass the sampler object, "
+                    "not an iterator, when len() is needed)")
         n = self._len_dataset()
         if n is None:
             raise TypeError("iterable dataset has no length")

@@ -242,3 +242,23 @@ def test_engine_deepspeed_io_with_curriculum_sampler(devices8):
     assert batch["input_ids"].shape[0] == 16    # global micro batch
     loss = engine.train_batch(iter([batch]))
     assert np.isfinite(float(loss))
+
+
+def test_loader_len_with_sampler(devices8):
+    n = 64
+    lengths = np.arange(1, n + 1)
+    sampler = DeepSpeedDataSampler(
+        sampler_config(), one_epoch_total_samples=n, micro_batch_size=2,
+        data_parallel_size=8, gradient_accumulation_steps=2,
+        metric_values={"seqlen": lengths})
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedTpuDataLoader
+
+    data = {"input_ids": np.zeros((n, 33), np.int64)}
+    loader = DeepSpeedTpuDataLoader(data, batch_size=16,
+                                    data_sampler=sampler)
+    # total samples = 64*num_epochs(4) = 256; each yield consumes the
+    # sampler's global batch 2*8*2 = 32 -> 8 batches
+    assert len(loader) == 256 // 32
+    with pytest.raises(TypeError, match="no length"):
+        len(DeepSpeedTpuDataLoader(data, batch_size=16,
+                                   data_sampler=iter(sampler)))

@@ -149,3 +149,52 @@ def test_dead_blocks_no_contribution(monkeypatch):
                               tbl, sp, nt)
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("case", [CASES[0], CASES[2]])
+def test_alibi_pallas_matches_xla(case, monkeypatch):
+    """ALiBi slopes in-kernel == XLA gather reference with the same bias."""
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    rng = np.random.default_rng(7)
+    N, C, H, KH, D, bs, MB, NB, ctx = case
+    q, kp, vp, tbl, sp, nt = _build_case(rng, N, C, H, KH, D, bs, MB, NB,
+                                         ctx)
+    from deepspeed_tpu.models.transformer import alibi_slopes
+
+    slopes = alibi_slopes(H)
+    out_k = pa._paged_pallas(q, kp, vp, tbl, sp, nt, alibi_slopes=slopes,
+                             interpret=True)
+    out_x = pa.paged_attention_xla(q, kp, vp, tbl, sp, nt,
+                                   alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_x),
+                               atol=2e-5, rtol=2e-5)
+    # and the bias genuinely changes the result
+    out_nobias = pa.paged_attention_xla(q, kp, vp, tbl, sp, nt)
+    assert not np.allclose(np.asarray(out_x), np.asarray(out_nobias))
+
+
+def test_v2_put_matches_dense_alibi(monkeypatch):
+    """BLOOM-style (ALiBi + embedding LN) model through the v2 ragged
+    engine: put() logits == dense forward at the last position."""
+    import dataclasses
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import CausalLM, TINY_TEST
+
+    cfg = dataclasses.replace(
+        TINY_TEST, num_kv_heads=4, position="alibi", norm="layernorm",
+        activation="gelu", use_bias=True, embedding_layernorm=True)
+    model = CausalLM(cfg)
+    vcfg = RaggedInferenceEngineConfig(
+        max_ragged_batch_size=128, max_ragged_sequence_count=4,
+        max_chunk_tokens=32, kv_blocks=32, kv_block_size=8,
+        max_tracked_sequences=8)
+    engine = InferenceEngineV2(model, config=vcfg)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab_size, size=20).tolist()
+    logits = engine.put([1], [prompt])
+    full = model.apply(engine.params, jnp.asarray([prompt], jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits)[0],
+                               np.asarray(full)[0, -1], atol=2e-3,
+                               rtol=2e-3)
