@@ -79,13 +79,11 @@ class DeepSpeedTpuDataLoader:
 
     def __len__(self):
         if self.data_sampler is not None:
-            # the sampler owns batching: len() is in samples and each yield
-            # consumes the sampler's OWN global batch (which includes its
-            # gradient-accumulation factor)
+            # sampler length is in samples; the loader re-slices sampler
+            # yields into global micro batches (__iter__), so the count is
+            # samples / global-micro
             try:
-                per_yield = getattr(self.data_sampler, "global_batch_size",
-                                    self.batch_size)
-                return len(self.data_sampler) // per_yield
+                return len(self.data_sampler) // self.batch_size
             except TypeError:
                 raise TypeError(
                     "data_sampler has no length (pass the sampler object, "
@@ -115,13 +113,16 @@ class DeepSpeedTpuDataLoader:
 
     def __iter__(self):
         if self.data_sampler is not None:
-            # sampler yields global-batch index arrays (difficulty-gated
-            # under curriculum learning); the loader contract is one FULL
-            # global micro batch per yield — identical to the index path
-            # below — so the engine's sharded device_put sees the same
-            # shape either way
+            # sampler yields GLOBAL-batch index arrays (micro × dp × gas,
+            # difficulty-gated under curriculum learning); the loader
+            # contract is one global MICRO batch per yield, so each sampler
+            # yield is re-sliced into its gas micro batches — the engine's
+            # train_batch then consumes exactly one sampler yield (and one
+            # curriculum step) per optimizer step
             for indices in self.data_sampler:
-                yield self._gather(np.asarray(indices))
+                indices = np.asarray(indices)
+                for lo in range(0, len(indices), self.batch_size):
+                    yield self._gather(indices[lo:lo + self.batch_size])
             return
         n = self._len_dataset()
         if n is None:

@@ -1007,6 +1007,62 @@ class DeepSpeedTpuEngine:
                 out[key] = np.asarray(out[key])[..., :difficulty + 1]
         return out
 
+    def comms_report(self, batch=None, print_log: bool = True):
+        """Static collective analysis of the compiled step programs
+        (utils/comms_logging.analyze_compiled): per-op counts + per-shard
+        bytes on the wire each step. Covers what the eager comms logger
+        cannot see — collectives fused inside jit (ZeRO gathers, qwZ/qgZ
+        quantized collectives, 1-bit int8 allreduce, TP/EP/SP traffic)."""
+        from ..utils.comms_logging import (analyze_compiled,
+                                           format_compiled_comms)
+
+        if batch is None:
+            micro = self.train_micro_batch_size_per_gpu()
+            dp = self.topology.get_data_parallel_world_size()
+            seq = getattr(getattr(self.module, "cfg", None), "max_seq_len",
+                          128)
+            batch = {"input_ids": np.zeros((micro * dp, min(seq, 128) + 1),
+                                           np.int64)}
+        batch = self._device_batch(batch)
+        rng = jax.random.fold_in(self._rng, 0)
+
+        rep = self.plan.replicated()
+
+        def aval(x):
+            # eval_shape drops shardings; keep them or GSPMD partitioning
+            # (and thus every collective) vanishes from the lowered
+            # program. Eagerly-created scalars carry SingleDeviceSharding —
+            # normalize those to mesh-replicated so all args share devices.
+            if isinstance(x, jax.Array):
+                sh = x.sharding
+                if isinstance(sh, jax.sharding.SingleDeviceSharding):
+                    sh = rep
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+            return x
+
+        avals = jax.tree.map(aval, (self.state, batch, rng))
+        report = analyze_compiled(
+            self._micro_fn.lower(*avals).compile())
+        # the micro program runs gas times per optimizer step
+        gas = self.gradient_accumulation_steps()
+        for rec in report.values():
+            rec["count"] *= gas
+            rec["bytes"] *= gas
+        update_fn = self._finalize_fn if self._finalize_fn is not None \
+            else self._update_fn
+        upd = analyze_compiled(update_fn.lower(avals[0]).compile())
+        for op, rec in upd.items():
+            dst = report.setdefault(op, {"count": 0, "bytes": 0,
+                                         "group_sizes": set(),
+                                         "dtypes": set()})
+            dst["count"] += rec["count"]
+            dst["bytes"] += rec["bytes"]
+            dst["group_sizes"] |= rec["group_sizes"]
+            dst["dtypes"] |= rec["dtypes"]
+        if print_log:
+            log_dist(format_compiled_comms(report), ranks=[0])
+        return report
+
     def set_compression(self, transform):
         """Attach a CompressionTransform after construction (the
         ``init_compression(engine, config)`` path — reference

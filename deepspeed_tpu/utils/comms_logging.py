@@ -112,6 +112,88 @@ class CommsLogger:
         return summary
 
 
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+def analyze_compiled(compiled) -> dict:
+    """Static comms report from a compiled XLA program.
+
+    The eager ``@timed_op`` path can't see inside jit — on TPU the
+    collectives live in the compiled program. This parses the optimized
+    HLO for collective ops and reports per-op counts, per-shard bytes, and
+    group sizes (the reference's comms summary, derived at compile time;
+    the byte numbers are what rides the ICI/DCN links each step).
+
+    ``compiled``: the object returned by ``jit(f).lower(...).compile()``
+    (or anything with ``as_text()``).
+    """
+    import re
+
+    op_re = re.compile(
+        r"(?<!%)\b(all-reduce|all-gather|reduce-scatter|all-to-all|"
+        r"collective-permute)(-start)?(?:\.\d+)?\(")
+    type_re = re.compile(r"(\w+)\[([\d,]*)\]")
+    # brace format {{0,1},{2,3}} and iota format [2,4]<=[8]
+    group_re = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+    iota_re = re.compile(r"replica_groups=\[\d+,(\d+)\]<=")
+    txt = compiled.as_text() if hasattr(compiled, "as_text") else str(compiled)
+    report: dict = {}
+    for line in txt.splitlines():
+        if " = " not in line:
+            continue
+        m = op_re.search(line)
+        if not m:
+            continue
+        op, is_start = m.group(1), m.group(2) is not None
+        # LHS types between '=' and the op name (scalar OR tuple form:
+        # "%x = f32[2,16]{1,0} all-reduce(...)" /
+        # "%x = (s8[8,4]{..}, s8[4]{..}) all-reduce-start(...)")
+        lhs = line[line.index(" = ") + 3:m.start()]
+        sizes = []
+        dtypes = set()
+        for dtype, shape_s in type_re.findall(lhs):
+            if dtype not in _DTYPE_BYTES:
+                continue
+            elems = 1
+            for d in shape_s.split(","):
+                if d:
+                    elems *= int(d)
+            sizes.append(elems * _DTYPE_BYTES[dtype])
+            dtypes.add(dtype)
+        if not sizes:
+            continue
+        # async '-start' ops carry (aliased operand, result[, context])
+        # tuples — counting everything would double the wire bytes; the
+        # result buffer is the max-sized element for every collective kind
+        nbytes = max(sizes) if is_start else sum(sizes)
+        g = group_re.search(line)
+        if g:
+            group = len(g.group(1).split(","))
+        else:
+            gi = iota_re.search(line)
+            group = int(gi.group(1)) if gi else 1
+        rec = report.setdefault(op, {"count": 0, "bytes": 0,
+                                     "group_sizes": set(), "dtypes": set()})
+        rec["count"] += 1
+        rec["bytes"] += nbytes
+        rec["group_sizes"].add(group)
+        rec["dtypes"] |= dtypes
+    return report
+
+
+def format_compiled_comms(report: dict) -> str:
+    lines = ["compiled-program collectives (per step, per shard):"]
+    for op, rec in sorted(report.items()):
+        lines.append(
+            f"  {op:<20} x{rec['count']:<4} {_fmt_size(rec['bytes']):>10} "
+            f"groups={sorted(rec['group_sizes'])} "
+            f"dtypes={sorted(rec['dtypes'])}")
+    if len(lines) == 1:
+        lines.append("  (none — single-shard program)")
+    return "\n".join(lines)
+
+
 def _fmt_size(num: int) -> str:
     if num == 0:
         return "0 B"

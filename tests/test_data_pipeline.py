@@ -256,9 +256,13 @@ def test_loader_len_with_sampler(devices8):
     data = {"input_ids": np.zeros((n, 33), np.int64)}
     loader = DeepSpeedTpuDataLoader(data, batch_size=16,
                                     data_sampler=sampler)
-    # total samples = 64*num_epochs(4) = 256; each yield consumes the
-    # sampler's global batch 2*8*2 = 32 -> 8 batches
-    assert len(loader) == 256 // 32
+    # total samples = 64*num_epochs(4) = 256; the loader slices each
+    # sampler yield (global batch 32, incl. gas=2) into 16-wide global
+    # micro batches -> 16 yields
+    assert len(loader) == 256 // 16
+    it = iter(loader)
+    first = next(it)
+    assert first["input_ids"].shape[0] == 16   # one global MICRO batch
     with pytest.raises(TypeError, match="no length"):
         len(DeepSpeedTpuDataLoader(data, batch_size=16,
                                    data_sampler=iter(sampler)))
