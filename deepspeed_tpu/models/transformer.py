@@ -65,7 +65,16 @@ class TransformerConfig:
     use_flash_attention: bool = True     # pallas kernel on TPU
     flash_block_q: int = 1024     # 1024/1024 measured fastest on v5e
     flash_block_kv: int = 1024    # (52.5 vs 36.2 TF/s fwd+bwd at 512/512)
-    attention_impl: str = "flash"        # "flash" | "reference" | "ring"
+    attention_impl: str = "flash"        # "flash" | "reference" | "ring" | "sparse"
+    # block-sparse attention (ops/sparse_attention.py) when attention_impl
+    # == "sparse": pattern + its knobs (reference ops/sparse_attention
+    # sparsity_config.py surface)
+    sparse_pattern: str = "fixed"        # fixed | bigbird | bslongformer | variable
+    sparse_block: int = 64
+    sparse_num_local_blocks: int = 4
+    sparse_num_global_blocks: int = 1
+    sparse_num_random_blocks: int = 1
+    sparse_num_sliding_window_blocks: int = 3
     pipeline_microbatches: int = 0       # 0 → pipe-axis size when pipelined
     # MoE (reference deepspeed/moe/): >0 turns every MLP into a top-k MoE
     moe_num_experts: int = 0
@@ -247,7 +256,68 @@ def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None):
     return o.reshape(B, T, H, D)
 
 
+_SPARSE_LAYOUT_CACHE: Dict[tuple, Any] = {}
+
+
+def _sparse_layout(cfg: TransformerConfig, seq_len: int):
+    """Build (and cache) the block-sparse layout for this config + length
+    (ops/sparse_attention.py sparsity configs; unidirectional = causal)."""
+    key = (cfg.sparse_pattern, cfg.num_heads, cfg.sparse_block, seq_len,
+           cfg.sparse_num_local_blocks, cfg.sparse_num_global_blocks,
+           cfg.sparse_num_random_blocks,
+           cfg.sparse_num_sliding_window_blocks)
+    if key not in _SPARSE_LAYOUT_CACHE:
+        from ..ops.sparse_attention import (BigBirdSparsityConfig,
+                                            BSLongformerSparsityConfig,
+                                            FixedSparsityConfig,
+                                            VariableSparsityConfig)
+
+        common = dict(num_heads=cfg.num_heads, block=cfg.sparse_block,
+                      attention="unidirectional")
+        if cfg.sparse_pattern == "fixed":
+            sc = FixedSparsityConfig(
+                num_local_blocks=cfg.sparse_num_local_blocks,
+                num_global_blocks=cfg.sparse_num_global_blocks, **common)
+        elif cfg.sparse_pattern == "bigbird":
+            sc = BigBirdSparsityConfig(
+                num_random_blocks=cfg.sparse_num_random_blocks,
+                num_sliding_window_blocks=cfg.sparse_num_sliding_window_blocks,
+                num_global_blocks=cfg.sparse_num_global_blocks, **common)
+        elif cfg.sparse_pattern == "bslongformer":
+            sc = BSLongformerSparsityConfig(
+                num_sliding_window_blocks=cfg.sparse_num_sliding_window_blocks,
+                **common)
+        elif cfg.sparse_pattern == "variable":
+            sc = VariableSparsityConfig(
+                num_random_blocks=cfg.sparse_num_random_blocks,
+                local_window_blocks=[cfg.sparse_num_local_blocks], **common)
+        else:
+            raise ValueError(f"unknown sparse_pattern {cfg.sparse_pattern!r}")
+        _SPARSE_LAYOUT_CACHE[key] = sc.make_layout(seq_len)
+    return _SPARSE_LAYOUT_CACHE[key]
+
+
 def _local_attention(q, k, v, cfg: TransformerConfig, causal=True):
+    if cfg.attention_impl == "sparse" and q.shape[1] == k.shape[1]:
+        from ..ops.sparse_attention import sparse_attention as sparse_attn
+
+        layout = _sparse_layout(cfg, q.shape[1])
+        tr = lambda x: x.transpose(0, 2, 1, 3)    # noqa: E731  [B,T,H,D]→[B,H,T,D]
+        H, KH = q.shape[2], k.shape[2]
+        if KH != H:
+            # GQA without copying K/V: heads of group g are [g, G+g, ...]
+            # (head = kh·G + g); each group pairs 1:1 with the KH kv heads,
+            # so run the block-sparse op once per group over KH heads
+            G = H // KH
+            outs = [sparse_attn(tr(q[:, :, g::G]), tr(k), tr(v),
+                                layout[g::G], cfg.sparse_block,
+                                causal=causal).transpose(0, 2, 1, 3)
+                    for g in range(G)]            # each [B, T, KH, D]
+            B, T = q.shape[0], q.shape[1]
+            return jnp.stack(outs, axis=3).reshape(B, T, H, q.shape[3])
+        out = sparse_attn(tr(q), tr(k), tr(v), layout, cfg.sparse_block,
+                          causal=causal)
+        return out.transpose(0, 2, 1, 3)
     if cfg.use_flash_attention and cfg.attention_impl != "reference" \
             and q.shape[1] == k.shape[1]:
         try:
@@ -293,6 +363,10 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
                 "ALiBi models do not support sequence parallelism yet: the "
                 "ring/Ulysses paths carry no logit bias; run BLOOM-family "
                 "models without a sequence mesh axis")
+        if cfg.attention_impl == "sparse":
+            raise NotImplementedError(
+                "attention_impl='sparse' does not support ALiBi models yet "
+                "(the block-sparse op takes no logit bias)")
         S = k.shape[1]
         bias = alibi_slopes(cfg.num_heads)[:, None] * jnp.arange(S)[None, :]
         return attention_reference(q, k, v, causal=causal, bias=bias)
@@ -300,6 +374,11 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
     sp = _seq_parallel_size()
     if sp <= 1:
         return _local_attention(q, k, v, cfg, causal)
+    if cfg.attention_impl == "sparse":
+        raise NotImplementedError(
+            "attention_impl='sparse' does not compose with the sequence "
+            "mesh axis yet: the block-sparse layout is built for full "
+            "sequences/heads, not the Ulysses/ring shards")
 
     from functools import partial as _partial
 
@@ -355,6 +434,14 @@ class CausalLM:
         # non-stacked leaves (embeddings, final norm, lm head).
         self.layer_transform = None
         self.global_transform = None
+        if cfg.attention_impl == "sparse":
+            from ..utils.logging import logger
+
+            logger.warning(
+                "attention_impl='sparse' applies to training/prefill; the "
+                "incremental decode path attends densely over the KV cache "
+                "(same scope as the reference's training-only "
+                "ops/sparse_attention)")
 
     # -- init ---------------------------------------------------------------
     def init(self, rng) -> Dict[str, Any]:

@@ -28,3 +28,6 @@ from .sparse_attention import (  # noqa: F401
     VariableSparsityConfig,
     sparse_attention,
 )
+# NOTE: this re-export shadows the *submodule* of the same name —
+# `from deepspeed_tpu.ops import sparse_attention` yields the callable;
+# in-package code imports classes via the submodule path explicitly.
