@@ -301,21 +301,11 @@ def _local_attention(q, k, v, cfg: TransformerConfig, causal=True):
     if cfg.attention_impl == "sparse" and q.shape[1] == k.shape[1]:
         from ..ops.sparse_attention import sparse_attention as sparse_attn
 
+        # [B, T, H, D] → [B, H, T, D]; GQA (KH < H) is handled inside the
+        # op via the (KH, group) factorization — K/V gathered once
         layout = _sparse_layout(cfg, q.shape[1])
-        tr = lambda x: x.transpose(0, 2, 1, 3)    # noqa: E731  [B,T,H,D]→[B,H,T,D]
-        H, KH = q.shape[2], k.shape[2]
-        if KH != H:
-            # GQA without copying K/V: heads of group g are [g, G+g, ...]
-            # (head = kh·G + g); each group pairs 1:1 with the KH kv heads,
-            # so run the block-sparse op once per group over KH heads
-            G = H // KH
-            outs = [sparse_attn(tr(q[:, :, g::G]), tr(k), tr(v),
-                                layout[g::G], cfg.sparse_block,
-                                causal=causal).transpose(0, 2, 1, 3)
-                    for g in range(G)]            # each [B, T, KH, D]
-            B, T = q.shape[0], q.shape[1]
-            return jnp.stack(outs, axis=3).reshape(B, T, H, q.shape[3])
-        out = sparse_attn(tr(q), tr(k), tr(v), layout, cfg.sparse_block,
+        out = sparse_attn(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), layout, cfg.sparse_block,
                           causal=causal)
         return out.transpose(0, 2, 1, 3)
     if cfg.use_flash_attention and cfg.attention_impl != "reference" \

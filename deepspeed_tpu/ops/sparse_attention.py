@@ -283,51 +283,66 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int,
                      scale: Optional[float] = None):
     """Block-sparse attention over a static layout.
 
-    q/k/v: [B, H, T, D]; layout: bool [H, T//block, T//block];
-    key_padding_mask: optional bool [B, T] (True = keep). Returns
-    [B, H, T, D]. FLOPs ∝ layout density (the reference's SDD/softmax/DSD
-    triton pipeline collapsed into one gathered dense attention)."""
+    q: [B, H, T, D]; k/v: [B, KH, T, D] with H % KH == 0 (GQA: K/V blocks
+    are gathered ONCE per kv head via the (KH, group) factorization —
+    attention_reference's no-repeat scheme — which requires the layouts of
+    the heads within a kv group to agree); layout: bool
+    [H, T//block, T//block]; key_padding_mask: optional bool [B, T]
+    (True = keep). Logits/softmax run in fp32 like every other attention
+    path. Returns [B, H, T, D]. FLOPs ∝ layout density (the reference's
+    SDD/softmax/DSD triton pipeline collapsed into one gathered dense
+    attention)."""
     B, H, T, D = q.shape
+    KH = k.shape[1]
+    if H % KH:
+        raise ValueError(f"H={H} not divisible by KH={KH}")
+    G = H // KH
     nb = T // block
     if layout.shape != (H, nb, nb):
         raise ValueError(f"layout {layout.shape} != {(H, nb, nb)}")
-    col_idx_np, valid_np = _pack_layout(layout)
+    lay = np.asarray(layout).reshape(KH, G, nb, nb)
+    if G > 1 and not (lay == lay[:, :1]).all():
+        raise ValueError(
+            "GQA sparse attention requires identical layouts within each "
+            "kv-head group (set different_layout_per_head patterns per "
+            "group, not per query head)")
+    col_idx_np, valid_np = _pack_layout(lay[:, 0])      # [KH, nb, L]
     col_idx = jnp.asarray(col_idx_np)
     valid = jnp.asarray(valid_np)
     L = col_idx.shape[-1]
     scale = scale if scale is not None else 1.0 / float(np.sqrt(D))
 
-    qb = q.reshape(B, H, nb, block, D)
-    kb = k.reshape(B, H, nb, block, D)
-    vb = v.reshape(B, H, nb, block, D)
-    heads = jnp.arange(H)[:, None, None]
-    kg = kb[:, heads, col_idx]            # [B, H, nb, L, block, D]
-    vg = vb[:, heads, col_idx]
+    qb = q.reshape(B, KH, G, nb, block, D)
+    kb = k.reshape(B, KH, nb, block, D)
+    vb = v.reshape(B, KH, nb, block, D)
+    kv_heads = jnp.arange(KH)[:, None, None]
+    kg = kb[:, kv_heads, col_idx]         # [B, KH, nb, L, block, D]
+    vg = vb[:, kv_heads, col_idx]
 
-    scores = jnp.einsum("bhipd,bhilqd->bhiplq", qb, kg) * scale
+    scores = jnp.einsum("bkgipd,bkilqd->bkgiplq", qb,
+                        kg).astype(jnp.float32) * scale
 
-    mask = valid[None, :, :, None, :, None]            # [1,H,nb,1,L,1]
+    mask = valid[None, :, None, :, None, :, None]      # [1,KH,1,nb,1,L,1]
     if causal:
         q_pos = (jnp.arange(nb)[:, None] * block
                  + jnp.arange(block)[None, :])          # [nb, block]
         k_pos = (col_idx[..., None] * block
-                 + jnp.arange(block))                   # [H, nb, L, block]
+                 + jnp.arange(block))                   # [KH, nb, L, block]
         causal_ok = (q_pos[None, :, :, None, None]
-                     >= k_pos[:, :, None, :, :])        # [H,nb,block,L,block]
-        mask = mask & causal_ok[None]
+                     >= k_pos[:, :, None, :, :])        # [KH,nb,blk,L,blk]
+        mask = mask & causal_ok[None, :, None]
     if key_padding_mask is not None:
         kp = key_padding_mask.reshape(B, nb, block)     # [B, nb, block]
-        kp_g = kp[:, col_idx]                           # [B, H, nb, L, block]
-        mask = mask & kp_g[:, :, :, None, :, :]
-
+        kp_g = kp[:, col_idx]                           # [B, KH, nb, L, blk]
+        mask = mask & kp_g[:, :, None, :, None, :, :]
     scores = jnp.where(mask, scores, -1e30)
-    flat = scores.reshape(B, H, nb, block, L * block)
+    flat = scores.reshape(B, KH, G, nb, block, L * block)
     probs = jax.nn.softmax(flat, axis=-1).reshape(scores.shape)
     # rows with no admitted keys (fully masked) produce uniform junk —
     # zero them instead
     any_valid = mask.any(axis=(-2, -1), keepdims=True)
-    probs = jnp.where(any_valid, probs, 0.0)
-    out = jnp.einsum("bhiplq,bhilqd->bhipd", probs, vg)
+    probs = jnp.where(any_valid, probs, 0.0).astype(q.dtype)
+    out = jnp.einsum("bkgiplq,bkilqd->bkgipd", probs, vg)
     return out.reshape(B, H, T, D)
 
 

@@ -137,3 +137,20 @@ def test_zero_to_fp32_offline_reconstruction(tmp_path):
     loaded = np.load(out)
     np.testing.assert_allclose(loaded[sorted(expect)[0]],
                                expect[sorted(expect)[0]], rtol=1e-6)
+
+
+def test_sharded_roundtrip_tp_change(tmp_path):
+    """Universal layout reshapes across TENSOR parallelism too: save on a
+    tp=2 x fsdp=2 mesh, resume on pure dp (reference ds_to_universal's
+    merge_tp_slices role — here a device_put with the new sharding)."""
+    engine = make_engine(mesh={"data": -1, "fsdp": 2, "tensor": 2})
+    train(engine, 2)
+    engine.save_checkpoint(str(tmp_path))
+    ref = [np.asarray(l) for l in jax.tree.leaves(engine.state.params)]
+
+    engine2 = make_engine(mesh={"data": -1, "fsdp": 1, "tensor": 1})
+    engine2.load_checkpoint(str(tmp_path))
+    for a, b in zip(ref, jax.tree.leaves(engine2.state.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+    losses = train(engine2, 2, seed=5)
+    assert np.isfinite(losses).all()

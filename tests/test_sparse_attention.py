@@ -203,3 +203,25 @@ def test_causal_lm_sparse_attention_trains(devices8):
     np.testing.assert_allclose(
         np.asarray(m_sparse.apply(params, tokens)),
         np.asarray(m_dense.apply(params, tokens)), rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_dense_layout_matches_reference():
+    """KH < H: the (KH, group) factorization equals grouped dense
+    attention (fp32 softmax, no KV repeat)."""
+    from deepspeed_tpu.models.transformer import attention_reference
+
+    rng = np.random.default_rng(9)
+    B, H, KH, T, D = 2, 8, 2, 64, 8
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, KH, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, KH, T, D)).astype(np.float32))
+    layout = np.tril(DenseSparsityConfig(num_heads=H, block=16)
+                     .make_layout(T))
+    out = sparse_attention(q, k, v, layout, block=16, causal=True)
+    # attention_reference uses [B, T, H, D]
+    ref = attention_reference(q.transpose(0, 2, 1, 3),
+                              k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), causal=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref.transpose(0, 2, 1, 3)),
+                               rtol=2e-4, atol=2e-5)
