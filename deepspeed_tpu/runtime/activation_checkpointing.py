@@ -1,0 +1,124 @@
+"""Activation checkpointing API.
+
+Counterpart of reference ``runtime/activation_checkpointing/checkpointing.py``
+(``checkpoint`` :984, ``CheckpointFunction`` :485, ``configure`` :1065,
+``CudaRNGStatesTracker`` :122). The mechanism is ``jax.checkpoint``
+(rematerialization): the forward is traced once and recomputed in the
+backward per the chosen policy — so most of the reference's machinery is
+the compiler's job here:
+
+- *partitioned activations across TP* → under GSPMD, saved residuals keep
+  their shardings; there is nothing to partition by hand.
+- *CPU checkpointing* → ``jax.checkpoint`` + offload policies
+  (``save_and_offload_only_these_names``) when host offload is wanted;
+  the engine's remat config covers the common cases.
+- *contiguous memory buffers* → XLA's allocator owns layout.
+- *RNG state tracking for dropout determinism* → JAX PRNG keys are values,
+  not global state: the same key in forward and recompute is deterministic
+  by construction, which is the entire job of the reference's
+  ``CudaRNGStatesTracker``.
+
+The reference's call surface is kept so Megatron-style model code ports
+unchanged: ``checkpoint(fn, *args)`` runs ``fn`` under remat,
+``configure(...)`` records the config, the boolean probes answer from it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from ..utils.logging import logger
+
+_config = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "num_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+}
+
+_POLICIES = {
+    None: None,
+    "dots_saveable": "dots_saveable",
+    "nothing_saveable": "nothing_saveable",
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference ``configure`` (:1065): record the checkpointing options.
+    On TPU these inform policy choice; partitioning/contiguity are XLA's
+    concern (module docstring)."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing", None)
+        if ac is not None:
+            _config["partition_activations"] = ac.partition_activations
+            _config["contiguous_memory_optimization"] = \
+                ac.contiguous_memory_optimization
+            _config["cpu_checkpointing"] = ac.cpu_checkpointing
+            _config["num_checkpoints"] = ac.number_checkpoints
+            _config["profile"] = ac.profile
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization",
+                      contiguous_checkpointing),
+                     ("num_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize_checkpoint_boundary", synchronize),
+                     ("profile", profile)):
+        if val is not None:
+            _config[key] = val
+    if _config["cpu_checkpointing"]:
+        logger.warning(
+            "cpu_checkpointing: host offload of residuals is policy-driven "
+            "on TPU (jax.checkpoint offload policies); the engine's "
+            "remat_policy handles the standard cases")
+
+
+def is_configured() -> bool:
+    return True     # jax.checkpoint needs no global setup
+
+
+def partition_activations_in_checkpoint(partition: bool) -> None:
+    _config["partition_activations"] = bool(partition)
+
+
+def checkpoint(function: Callable, *args, policy: Optional[str] = None,
+               static_argnums=()) -> Any:
+    """Reference ``checkpoint`` (:984): run ``function(*args)`` storing
+    only the inputs (plus what ``policy`` saves); the backward recomputes
+    the rest. Differentiable through ``jax.grad`` like any JAX function."""
+    pol = None
+    if policy == "dots_saveable":
+        pol = jax.checkpoint_policies.dots_saveable
+    elif policy == "nothing_saveable":
+        pol = jax.checkpoint_policies.nothing_saveable
+    elif policy is not None:
+        raise ValueError(f"unknown remat policy {policy!r}")
+    wrapped = jax.checkpoint(function, policy=pol,
+                             static_argnums=tuple(static_argnums))
+    return wrapped(*args)
+
+
+class CheckpointFunction:
+    """API-parity alias (reference ``CheckpointFunction`` :485 is a torch
+    autograd.Function; functional JAX needs only the wrapper above)."""
+
+    @staticmethod
+    def apply(function, *args):
+        return checkpoint(function, *args)
+
+
+def get_rng_tracker():
+    """Reference ``get_cuda_rng_tracker``: JAX PRNG keys are explicit
+    values — recompute under ``jax.checkpoint`` replays the same keys, so
+    dropout is deterministic with no tracker. Returns None."""
+    return None
+
+
+def model_parallel_cuda_manual_seed(seed: int) -> None:
+    """Reference RNG seeding hook: a no-op — seeds flow through PRNG keys
+    (`jax.random.PRNGKey(seed)` at engine init)."""

@@ -1,0 +1,60 @@
+"""Activation checkpointing API tests (reference
+tests/unit/runtime/activation_checkpointing/test_activation_checkpointing.py):
+remat correctness — same values and gradients as the unremat function —
+plus dropout determinism under recompute and the configure surface."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+
+def test_checkpoint_matches_plain():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 16))
+                    .astype(np.float32))
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16))
+                    .astype(np.float32))
+
+    def f(w, x):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(h @ w.T)
+
+    def f_ckpt(w, x):
+        return ac.checkpoint(f, w, x)
+
+    np.testing.assert_allclose(np.asarray(f(w, x)),
+                               np.asarray(f_ckpt(w, x)), rtol=1e-6)
+    g_plain = jax.grad(f)(w, x)
+    g_ckpt = jax.grad(f_ckpt)(w, x)
+    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_ckpt),
+                               rtol=1e-6)
+
+
+def test_checkpoint_policy_and_dropout_determinism():
+    key = jax.random.PRNGKey(0)
+    w = jnp.ones((8, 8))
+
+    def f(w, key):
+        h = w @ w
+        mask = jax.random.bernoulli(key, 0.5, h.shape)
+        return jnp.sum(h * mask)
+
+    for policy in (None, "dots_saveable", "nothing_saveable"):
+        out = ac.checkpoint(f, w, key, policy=policy)
+        grad = jax.grad(lambda w: ac.checkpoint(f, w, key, policy=policy))(w)
+        # recompute replays the same PRNG key: value and grad agree with
+        # the unremat version (the CudaRNGStatesTracker role)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(f(w, key)),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(grad),
+                                   np.asarray(jax.grad(f)(w, key)),
+                                   rtol=1e-6)
+
+
+def test_configure_and_probes():
+    ac.configure(partition_activations=True, num_checkpoints=4)
+    assert ac.is_configured()
+    assert ac.CheckpointFunction.apply(lambda x: x * 2, jnp.ones(3))[0] == 2
+    assert ac.get_rng_tracker() is None
+    ac.model_parallel_cuda_manual_seed(1234)   # no-op by design

@@ -225,3 +225,39 @@ def test_gqa_dense_layout_matches_reference():
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(ref.transpose(0, 2, 1, 3)),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_gqa_sparse_layout_matches_repeat_kv():
+    """Genuinely sparse layout + GQA: matches repeat-KV dense reference
+    through the padded gather and the (KH, group) masks."""
+    rng = np.random.default_rng(10)
+    B, H, KH, T, D = 2, 8, 2, 64, 8
+    G = H // KH
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, KH, T, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, KH, T, D)).astype(np.float32))
+    layout = FixedSparsityConfig(num_heads=H, block=8, num_local_blocks=2,
+                                 num_global_blocks=1,
+                                 attention="unidirectional").make_layout(T)
+    mask = np.ones((B, T), dtype=bool)
+    mask[:, 56:] = False
+    out = sparse_attention(q, k, v, layout, block=8, causal=True,
+                           key_padding_mask=jnp.asarray(mask))
+    k_rep = jnp.repeat(k, G, axis=1)
+    v_rep = jnp.repeat(v, G, axis=1)
+    ref = sparse_attention(q, k_rep, v_rep, layout, block=8, causal=True,
+                           key_padding_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gqa_rejects_mismatched_group_layouts():
+    rng = np.random.default_rng(11)
+    B, H, KH, T, D = 1, 4, 2, 32, 8
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, KH, T, D)).astype(np.float32))
+    layout = DenseSparsityConfig(num_heads=H, block=8).make_layout(T)
+    layout = np.array(layout)
+    layout[1, 0, 2] = False     # head 1 differs from head 0 (same group)
+    with pytest.raises(ValueError, match="identical layouts"):
+        sparse_attention(q, k, k, layout, block=8)
