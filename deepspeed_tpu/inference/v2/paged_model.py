@@ -125,9 +125,11 @@ class PagedCausalLM:
             vc = vc.at[write_blk, :, write_off, :].set(
                 v.reshape(-1, kvh, hd), mode="drop")
 
-            # paged read: Pallas block-table walk (reference blocked_flash)
+            # paged read: Pallas block-table walk (reference blocked_flash;
+            # Mistral sliding window clamps the walk to the last W positions)
             attn = paged_attention(q, kc, vc, block_tables, start_pos,
-                                   n_tokens, alibi_slopes=slopes)
+                                   n_tokens, alibi_slopes=slopes,
+                                   window=cfg.sliding_window or 0)
             attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
                                lp.get("wo_b"), dt)
             x = self.model._attn_mlp_merge(x, attn_out, lp)

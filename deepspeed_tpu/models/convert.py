@@ -21,7 +21,7 @@ full model is never resident on any single host — the reference's
 the copy-and-slice round trip.
 
 Supported families (reference containers ``module_inject/containers/``):
-Llama/Llama-2, Mistral (sliding window not applied — full attention),
+Llama/Llama-2, Mistral (sliding-window attention applied past the window),
 GPT-2, Qwen2 (qkv-bias), OPT (learned positions, relu), GPT-NeoX
 (parallel residual, partial rotary, interleaved fused QKV), BLOOM (ALiBi,
 embedding LayerNorm), and Falcon 7B/40B (parallel attention, MQA/grouped
@@ -630,6 +630,27 @@ _FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
              "falcon": _falcon_plans}
 
 
+def _qwen2_window(hf_config: Dict[str, Any]):
+    """Qwen2 applies SWA only to layers >= max_window_layers (HF semantics:
+    the first max_window_layers layers use full attention). A single global
+    window can represent the all-SWA (max_window_layers <= 0) and no-SWA
+    (max_window_layers >= num layers, or use_sliding_window false) configs;
+    mixed per-layer windows are rejected rather than silently mis-masked."""
+    if not hf_config.get("use_sliding_window"):
+        return None
+    n_layers = hf_config["num_hidden_layers"]
+    mwl = hf_config.get("max_window_layers", n_layers)
+    if mwl >= n_layers:
+        return None                       # no layer is windowed
+    if mwl <= 0:
+        return hf_config.get("sliding_window")
+    raise ValueError(
+        f"Qwen2 with mixed attention layers (max_window_layers={mwl} of "
+        f"{n_layers}) is unsupported: the first {mwl} layers use full "
+        "attention in HF while the rest use SWA, and TransformerConfig has "
+        "one global sliding_window")
+
+
 def config_from_hf(hf_config: Dict[str, Any],
                    dtype=jnp.bfloat16) -> TransformerConfig:
     """HF ``config.json`` dict → TransformerConfig (reference: the per-model
@@ -645,6 +666,8 @@ def config_from_hf(hf_config: Dict[str, Any],
             num_kv_heads=hf_config.get("num_key_value_heads",
                                        hf_config["num_attention_heads"]),
             max_seq_len=hf_config.get("max_position_embeddings", 4096),
+            sliding_window=(hf_config.get("sliding_window")
+                            if mt == "mistral" else None),
             norm="rmsnorm", activation="silu", position="rope",
             rope_theta=hf_config.get("rope_theta", 10000.0),
             tie_embeddings=hf_config.get("tie_word_embeddings", False),
@@ -672,6 +695,7 @@ def config_from_hf(hf_config: Dict[str, Any],
             num_heads=hf_config["num_attention_heads"],
             num_kv_heads=hf_config.get("num_key_value_heads"),
             max_seq_len=hf_config.get("max_position_embeddings", 4096),
+            sliding_window=_qwen2_window(hf_config),
             norm="rmsnorm", activation="silu", position="rope",
             rope_theta=hf_config.get("rope_theta", 10000.0),
             tie_embeddings=hf_config.get("tie_word_embeddings", False),

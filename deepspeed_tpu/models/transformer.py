@@ -46,6 +46,7 @@ class TransformerConfig:
     num_heads: int = 12
     num_kv_heads: Optional[int] = None   # GQA; None => MHA
     max_seq_len: int = 1024
+    sliding_window: Optional[int] = None  # Mistral sliding-window attention
     # architecture switches
     norm: str = "layernorm"              # "layernorm" | "rmsnorm"
     activation: str = "gelu"             # "gelu" | "silu" (SwiGLU) | "relu"
@@ -130,7 +131,8 @@ MISTRAL_7B = TransformerConfig(vocab_size=32000, hidden_size=4096,
                                num_heads=32, num_kv_heads=8, max_seq_len=8192,
                                norm="rmsnorm", activation="silu",
                                position="rope", tie_embeddings=False,
-                               rope_theta=10000.0, dtype=jnp.bfloat16)
+                               rope_theta=10000.0, sliding_window=4096,
+                               dtype=jnp.bfloat16)
 QWEN2_7B = TransformerConfig(vocab_size=152064, hidden_size=3584,
                              intermediate_size=18944, num_layers=28,
                              num_heads=28, num_kv_heads=4, max_seq_len=32768,
@@ -224,13 +226,15 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
     return jnp.asarray(base, jnp.float32)
 
 
-def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None):
+def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None,
+                        window: int = 0):
     """Pure-XLA attention: q [B,T,H,D], k/v [B,S,KH,D].
 
     GQA is expressed as an einsum over the [KH, group] head factorization —
     no ``jnp.repeat``, so K/V are never copied in HBM. ``bias``: optional
     additive [H, S] logit bias (ALiBi — per-row-constant terms cancel in
-    softmax, so slopes·key_position suffices).
+    softmax, so slopes·key_position suffices). ``window`` > 0: sliding
+    window (query p attends keys in (p − window, p]).
     """
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
@@ -240,10 +244,14 @@ def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None):
     logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
     if bias is not None:
         logits = logits + bias.reshape(KH, group, 1, S)[None]
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     if causal:
         qpos = jnp.arange(T)[:, None] + (S - T)
         kpos = jnp.arange(S)[None, :]
         cmask = qpos >= kpos
+        if window:
+            cmask = cmask & (qpos - kpos < window)
         logits = jnp.where(cmask[None, None, None], logits, -1e30)
     if mask is not None:
         # mask contract: anything broadcastable to [B, H, T, S] (the layout
@@ -298,8 +306,14 @@ def _sparse_layout(cfg: TransformerConfig, seq_len: int):
 
 
 def _local_attention(q, k, v, cfg: TransformerConfig, causal=True):
+    window = cfg.sliding_window or 0
     if cfg.attention_impl == "sparse" and q.shape[1] == k.shape[1]:
         from ..ops.sparse_attention import sparse_attention as sparse_attn
+
+        if window:
+            raise NotImplementedError(
+                "sliding_window does not compose with attention_impl="
+                "'sparse': the block-sparse layout carries no window clamp")
 
         # [B, T, H, D] → [B, H, T, D]; GQA (KH < H) is handled inside the
         # op via the (KH, group) factorization — K/V gathered once
@@ -315,10 +329,11 @@ def _local_attention(q, k, v, cfg: TransformerConfig, causal=True):
 
             return flash_attention(q, k, v, causal=causal,
                                    block_q=cfg.flash_block_q,
-                                   block_kv=cfg.flash_block_kv)
+                                   block_kv=cfg.flash_block_kv,
+                                   window=window)
         except Exception:
             pass
-    return attention_reference(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal, window=window)
 
 
 def _seq_parallel_size() -> int:
@@ -383,6 +398,12 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
     if cfg.attention_impl == "ring":
         from ..sequence.ring_attention import ring_attention
 
+        if cfg.sliding_window:
+            raise NotImplementedError(
+                "sliding_window does not compose with ring attention yet: "
+                "the ring pass carries no window clamp; use the Ulysses "
+                "path (attention_impl='flash') for windowed models under "
+                "sequence parallelism")
         fn = shard_map(_partial(ring_attention, causal=causal,
                                 axis_name=topo.SEQUENCE_AXIS),
                        mesh=t.mesh, in_specs=(spec_, spec_, spec_),
@@ -856,7 +877,10 @@ class CausalLM:
         q, k, v = self._qkv(h1, lp, cos, sin, B, 1)
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
-        mask = (jnp.arange(S) <= pos)[None, None, None, :]   # [1,1,1,S]
+        keep = jnp.arange(S) <= pos
+        if cfg.sliding_window:
+            keep = keep & (pos - jnp.arange(S) < cfg.sliding_window)
+        mask = keep[None, None, None, :]                     # [1,1,1,S]
         bias = None
         if cfg.position == "alibi":
             bias = alibi_slopes(cfg.num_heads)[:, None] \

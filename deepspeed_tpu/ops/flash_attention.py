@@ -59,9 +59,39 @@ _FORCE_INTERPRET = False
 
 # ----------------------------------------------------------------- fwd kernel
 
+def _window_live(causal, window, i, j, block_q, block_kv, offs):
+    """Is grid block (i, j) inside the causal / sliding-window band?
+
+    Row r (global q position ``i·bq + r + offs``) attends to col c iff
+    ``r >= c`` (causal) and ``r − c < window`` (sliding window; Mistral
+    semantics — the window includes self). A KV block is dead when every
+    (row, col) pair violates either bound."""
+    live = True
+    if causal:
+        row_max = i * block_q + block_q - 1 + offs
+        live = row_max >= j * block_kv
+    if window:
+        row_min = i * block_q + offs
+        live = live & (j * block_kv + block_kv - 1 > row_min - window)
+    return live
+
+
+def _band_mask(s, causal, window, i, j, block_q, block_kv, offs,
+               masked_val=NEG_INF):
+    """Apply the causal + sliding-window mask to a [bq, bkv] logit block."""
+    if not causal and not window:
+        return s
+    rows = i * block_q + offs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    keep = rows >= cols if causal else (rows == rows)
+    if window:
+        keep = keep & (rows - cols < window)
+    return jnp.where(keep, s, masked_val)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
                 causal: bool, sm_scale: float, block_q: int, block_kv: int,
-                q_len: int, kv_len: int):
+                q_len: int, kv_len: int, window: int):
     """One (b, h, i, j) grid step: fold KV block j into q block i's online
     softmax. Scratch: acc [bq, D]; m/l [bq, 128] lane-replicated, f32."""
     j = pl.program_id(3)
@@ -75,10 +105,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     # Causal: KV blocks entirely above the diagonal contribute nothing.
-    # Row r attends to col c iff r + (S - T) >= c.
+    # Sliding window: blocks entirely before the window contribute nothing.
     offs = kv_len - q_len
-    row_max = i * block_q + block_q - 1 + offs
-    live = (not causal) or (row_max >= j * block_kv)
+    live = _window_live(causal, window, i, j, block_q, block_kv, offs)
 
     @pl.when(live)
     def _compute():
@@ -87,10 +116,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         v = v_ref[0, 0].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [bq, bkv]
-        if causal:
-            rows = i * block_q + offs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _band_mask(s, causal, window, i, j, block_q, block_kv, offs)
         m_prev, l_prev = m_ref[...], l_ref[...]                 # [bq, 128]
         m_cur = jnp.max(s, axis=-1, keepdims=True)              # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)                      # [bq, 128]
@@ -112,7 +138,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                dq_acc, *, causal: bool, sm_scale: float, block_q: int,
-               block_kv: int, q_len: int, kv_len: int):
+               block_kv: int, q_len: int, kv_len: int, window: int):
     """Grid (B, H, T//bq, S//bkv); accumulates dq for q block i over KV."""
     j = pl.program_id(3)
     nj = pl.num_programs(3)
@@ -123,8 +149,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     offs = kv_len - q_len
-    row_max = i * block_q + block_q - 1 + offs
-    live = (not causal) or (row_max >= j * block_kv)
+    live = _window_live(causal, window, i, j, block_q, block_kv, offs)
 
     @pl.when(live)
     def _compute():
@@ -138,10 +163,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         p = jnp.exp(s - lse)                                    # [bq, bkv]
-        if causal:
-            rows = i * block_q + offs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            p = jnp.where(rows >= cols, p, 0.0)
+        p = _band_mask(p, causal, window, i, j, block_q, block_kv, offs,
+                       masked_val=0.0)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale                        # [bq, bkv]
@@ -158,7 +181,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, causal: bool,
                 sm_scale: float, block_q: int, block_kv: int, q_len: int,
-                kv_len: int, num_q_blocks: int):
+                kv_len: int, num_q_blocks: int, window: int):
     """Grid (B, KH, S//bkv, group*T//bq): accumulate dk/dv for KV block j
     over all query blocks of all query heads sharing this KV head (GQA)."""
     t = pl.program_id(3)
@@ -172,8 +195,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     offs = kv_len - q_len
-    row_max = i * block_q + block_q - 1 + offs
-    live = (not causal) or (row_max >= j * block_kv)
+    live = _window_live(causal, window, i, j, block_q, block_kv, offs)
 
     @pl.when(live)
     def _compute():
@@ -187,10 +209,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         p = jnp.exp(s - lse)                                    # [bq, bkv]
-        if causal:
-            rows = i * block_q + offs + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_kv + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            p = jnp.where(rows >= cols, p, 0.0)
+        p = _band_mask(p, causal, window, i, j, block_q, block_kv, offs,
+                       masked_val=0.0)
         dv_acc[...] += lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -228,21 +248,28 @@ def _dim_sem(n):
         dimension_semantics=tuple(["parallel"] * (n - 1) + ["arbitrary"]))
 
 
-def _causal_kv_clamp(causal, bq, bkv, offs):
-    """Index-map clamp: map fully-masked (above-diagonal) KV blocks back to
-    the diagonal block. Pallas only issues a DMA when the mapped block index
-    *changes* between consecutive grid steps, so the dead iterations (skipped
-    by ``pl.when`` in-kernel) also fetch nothing — restoring the KV-traffic
-    saving of a diagonal-trimmed loop without a data-dependent grid."""
+def _causal_kv_clamp(causal, bq, bkv, offs, window=0):
+    """Index-map clamp: map fully-masked (above-diagonal, and — with a
+    sliding window — before-the-window) KV blocks back to the nearest live
+    block. Pallas only issues a DMA when the mapped block index *changes*
+    between consecutive grid steps, so the dead iterations (skipped by
+    ``pl.when`` in-kernel) also fetch nothing — restoring the KV-traffic
+    saving of a band-trimmed loop without a data-dependent grid."""
     def clamp(i, j):
-        if not causal:
+        if not causal and not window:
             return j
-        diag = jnp.maximum((i * bq + bq - 1 + offs) // bkv, 0)
-        return jnp.minimum(j, diag)
+        out = j
+        if window:
+            first = jnp.maximum((i * bq + offs - window + 1) // bkv, 0)
+            out = jnp.maximum(out, first)
+        if causal:
+            diag = jnp.maximum((i * bq + bq - 1 + offs) // bkv, 0)
+            out = jnp.minimum(out, diag)
+        return out
     return clamp
 
 
-def _fwd_pallas(q, k, v, causal, block_q, block_kv, *, interpret):
+def _fwd_pallas(q, k, v, causal, block_q, block_kv, window, *, interpret):
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
     group = H // KH
@@ -253,11 +280,11 @@ def _fwd_pallas(q, k, v, causal, block_q, block_kv, *, interpret):
     kh = k.transpose(0, 2, 1, 3)
     vh = v.transpose(0, 2, 1, 3)
 
-    clamp = _causal_kv_clamp(causal, bq, bkv, S - T)
+    clamp = _causal_kv_clamp(causal, bq, bkv, S - T, window)
     grid = (B, H, T // bq, S // bkv)
     kernel = functools.partial(
         _fwd_kernel, causal=causal, sm_scale=sm_scale, block_q=bq,
-        block_kv=bkv, q_len=T, kv_len=S)
+        block_kv=bkv, q_len=T, kv_len=S, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -288,7 +315,8 @@ def _fwd_pallas(q, k, v, causal, block_q, block_kv, *, interpret):
     return o, lse        # o in head-major [B,H,T,D]; caller transposes
 
 
-def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, *, interpret):
+def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, window, *,
+                interpret):
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
     group = H // KH
@@ -301,7 +329,7 @@ def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, *, interpret):
     doh = g.transpose(0, 2, 1, 3)        # [B,H,T,D]
 
     nqb = T // bq
-    clamp = _causal_kv_clamp(causal, bq, bkv, S - T)
+    clamp = _causal_kv_clamp(causal, bq, bkv, S - T, window)
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
     kv_spec = pl.BlockSpec((1, 1, bkv, D),
                            lambda b, h, i, j: (b, h // group, clamp(i, j), 0))
@@ -309,7 +337,7 @@ def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, *, interpret):
                              lambda b, h, i, j: (b, h, i, 0))
     dq_kernel = functools.partial(
         _dq_kernel, causal=causal, sm_scale=sm_scale, block_q=bq,
-        block_kv=bkv, q_len=T, kv_len=S)
+        block_kv=bkv, q_len=T, kv_len=S, window=window)
     dqh = pl.pallas_call(
         dq_kernel,
         grid=(B, H, nqb, S // bkv),
@@ -325,16 +353,23 @@ def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, *, interpret):
     # query-side specs decode (head, q block) from the flattened index t.
     # Causal: q blocks entirely before the KV block are dead — clamp them up
     # to the first live q block so their DMAs coalesce away (see
-    # _causal_kv_clamp for the mechanism).
+    # _causal_kv_clamp for the mechanism). Sliding window: q blocks entirely
+    # past the window are dead — clamp them down to the last live q block.
     offs = S - T
 
     def q_block(j, t):
         i = t % nqb
-        if not causal:
+        if not causal and not window:
             return i
-        num = j * bkv - offs - bq + 1
-        i_min = jnp.clip(-((-num) // bq), 0, nqb - 1)
-        return jnp.maximum(i, i_min)
+        if causal:
+            num = j * bkv - offs - bq + 1
+            i_min = jnp.clip(-((-num) // bq), 0, nqb - 1)
+            i = jnp.maximum(i, i_min)
+        if window:
+            i_max = jnp.clip((j * bkv + bkv + window - 2 - offs) // bq,
+                             0, nqb - 1)
+            i = jnp.minimum(i, i_max)
+        return i
 
     def q_map(b, kh_, j, t):
         return (b, kh_ * group + t // nqb, q_block(j, t), 0)
@@ -344,7 +379,7 @@ def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, *, interpret):
     statg_spec = pl.BlockSpec((1, 1, bq, STAT_LANES), q_map)
     dkv_kernel = functools.partial(
         _dkv_kernel, causal=causal, sm_scale=sm_scale, block_q=bq,
-        block_kv=bkv, q_len=T, kv_len=S, num_q_blocks=nqb)
+        block_kv=bkv, q_len=T, kv_len=S, num_q_blocks=nqb, window=window)
     dkh, dvh = pl.pallas_call(
         dkv_kernel,
         grid=(B, KH, S // bkv, group * nqb),
@@ -371,7 +406,7 @@ def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, *, interpret):
 
 # ------------------------------------------------------------------- reference
 
-def _attention_xla(q, k, v, causal: bool):
+def _attention_xla(q, k, v, causal: bool, window: int = 0):
     """Grouped-head XLA attention reference (no KV repeat: einsum over the
     [KH, group] factorization)."""
     B, T, H, D = q.shape
@@ -379,9 +414,12 @@ def _attention_xla(q, k, v, causal: bool):
     group = H // KH
     qg = q.reshape(B, T, KH, group, D)
     s = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) / math.sqrt(D)
+    qpos = jnp.arange(T)[:, None] + (S - T)
+    kpos = jnp.arange(S)[None, :]
     if causal:
-        mask = (jnp.arange(T)[:, None] + (S - T)) >= jnp.arange(S)[None, :]
-        s = jnp.where(mask[None, None, None], s, NEG_INF)
+        s = jnp.where((qpos >= kpos)[None, None, None], s, NEG_INF)
+    if window:
+        s = jnp.where((qpos - kpos < window)[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     o = jnp.einsum("bkgts,bskd->btkgd", p, v)
     return o.reshape(B, T, H, D)
@@ -389,14 +427,19 @@ def _attention_xla(q, k, v, causal: bool):
 
 # ------------------------------------------------------------------ public api
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_kv: int = 512):
+                    block_kv: int = 512, window: int = 0):
     """Blocked flash attention; Pallas on TPU, XLA elsewhere.
 
     q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0 (GQA/MQA).
+    ``window`` > 0 enables sliding-window attention (Mistral semantics:
+    query position p attends to key positions (p − window, p]; requires
+    ``causal=True``). Blocks wholly outside the band are skipped for both
+    compute and HBM traffic (reference parity:
+    inference/v2/model_implementations/mistral/model.py:202).
     """
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv, window)
     return out
 
 
@@ -408,21 +451,24 @@ def _pallas_enabled(q, k, block_q, block_kv):
     return _on_tpu() or _FORCE_INTERPRET
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_kv):
+def _flash_fwd(q, k, v, causal, block_q, block_kv, window=0):
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     if _pallas_enabled(q, k, block_q, block_kv):
-        o_hm, lse = _fwd_pallas(q, k, v, causal, block_q, block_kv,
+        o_hm, lse = _fwd_pallas(q, k, v, causal, block_q, block_kv, window,
                                 interpret=_use_interpret())
         return o_hm.transpose(0, 2, 1, 3), (q, k, v, o_hm, lse)
-    o = _attention_xla(q, k, v, causal)
+    o = _attention_xla(q, k, v, causal, window)
     return o, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_kv, res, g):
+def _flash_bwd(causal, block_q, block_kv, window, res, g):
     q, k, v, o_hm, lse = res
     if o_hm is not None and _pallas_enabled(q, k, block_q, block_kv):
         return _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv,
-                           interpret=_use_interpret())
-    _, vjp = jax.vjp(lambda q, k, v: _attention_xla(q, k, v, causal), q, k, v)
+                           window, interpret=_use_interpret())
+    _, vjp = jax.vjp(lambda q, k, v: _attention_xla(q, k, v, causal, window),
+                     q, k, v)
     return vjp(g)
 
 

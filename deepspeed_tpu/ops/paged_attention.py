@@ -63,7 +63,7 @@ def _use_interpret() -> bool:
 def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
                   k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                   block_size: int, chunk: int, groups: int,
-                  sm_scale: float, alibi: bool):
+                  sm_scale: float, alibi: bool, window: int):
     """One (n, kh, b) grid step: fold table block b of sequence n into the
     online softmax of its [G·C, D] query group."""
     n = pl.program_id(0)
@@ -79,6 +79,11 @@ def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
 
     ctx_len = startp_ref[n] + ntok_ref[n]
     live = b * block_size < ctx_len
+    if window:
+        # sliding window: the earliest position any query row of this chunk
+        # attends is startp − window + 1 — blocks wholly before it are dead
+        live = live & (b * block_size + block_size - 1
+                       >= startp_ref[n] - window + 1)
 
     @pl.when(live)
     def _compute():
@@ -101,7 +106,10 @@ def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
             for g in range(groups):
                 slope = jnp.where(gi[:, :1] == g, slopes_ref[kh, g], slope)
             s = s + slope * kvpos.astype(jnp.float32)
-        s = jnp.where((kvpos <= qpos) & (kvpos < ctx_len), s, NEG_INF)
+        keep = (kvpos <= qpos) & (kvpos < ctx_len)
+        if window:
+            keep = keep & (qpos - kvpos < window)
+        s = jnp.where(keep, s, NEG_INF)
         m_prev, l_prev = m_ref[...], l_ref[...]               # [G*C, 128]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -118,21 +126,28 @@ def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
         o_ref[0, 0] = (acc_ref[...] / l[:, :1]).astype(o_ref.dtype)
 
 
-def _clamp_tables(block_tables, ctx_len, block_size):
-    """Replace dead/unallocated table entries with the sequence's last live
-    block id so the kernel's index map repeats it (no DMA is issued when the
-    mapped block doesn't change between grid steps)."""
+def _clamp_tables(block_tables, ctx_len, block_size, start_pos=None,
+                  window=0):
+    """Replace dead/unallocated table entries with the sequence's nearest
+    live block id so the kernel's index map repeats it (no DMA is issued when
+    the mapped block doesn't change between grid steps). Dead entries are
+    those past the context length and — with a sliding window — those wholly
+    before ``start_pos − window + 1``."""
     N, MB = block_tables.shape
     live_blocks = jnp.maximum(-(-ctx_len // block_size), 1)        # [N] >= 1
     cols = jnp.arange(MB)[None, :]
     last_live = jnp.clip(live_blocks - 1, 0, MB - 1)[:, None]
     idx = jnp.minimum(cols, last_live)
+    if window and start_pos is not None:
+        first_live = jnp.clip((start_pos - window + 1) // block_size,
+                              0, MB - 1)[:, None]
+        idx = jnp.maximum(idx, first_live)
     tbl = jnp.take_along_axis(block_tables, idx, axis=1)
     return jnp.maximum(tbl, 0).astype(jnp.int32)
 
 
 def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
-                  alibi_slopes=None, interpret: bool):
+                  alibi_slopes=None, window: int = 0, interpret: bool):
     N, C, H, D = q.shape
     NB, KH, bs, _ = k_pool.shape
     G = H // KH
@@ -143,7 +158,7 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
     qh = q.transpose(0, 2, 1, 3).reshape(N, KH, G * C, D)
 
     ctx_len = start_pos + n_tokens
-    tables = _clamp_tables(block_tables, ctx_len, bs)
+    tables = _clamp_tables(block_tables, ctx_len, bs, start_pos, window)
     startp = start_pos.astype(jnp.int32)
     ntok = n_tokens.astype(jnp.int32)
     alibi = alibi_slopes is not None
@@ -152,7 +167,8 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
               if alibi else jnp.zeros((KH, G), jnp.float32))
 
     kernel = functools.partial(_paged_kernel, block_size=bs, chunk=C,
-                               groups=G, sm_scale=sm_scale, alibi=alibi)
+                               groups=G, sm_scale=sm_scale, alibi=alibi,
+                               window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(N, KH, MB),
@@ -191,7 +207,7 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
 # ----------------------------------------------------------- XLA reference
 
 def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
-                        alibi_slopes=None):
+                        alibi_slopes=None, window: int = 0):
     """Dense-gather formulation (the pre-Pallas path): gather the table into
     [N, MB*bs, KH, D] and mask. Numerically the kernel's reference."""
     N, C, H, D = q.shape
@@ -218,7 +234,11 @@ def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
     qpos = start_pos[:, None] + jnp.arange(C)[None, :]          # [N, C]
     causal = qpos[:, None, None, :, None] >= ctx_positions[None, None, None, None, :]
     valid = (ctx_positions[None, :] < ctx_len)[:, None, None, None, :]
-    s = jnp.where(causal & valid, s, NEG_INF)
+    keep = causal & valid
+    if window:
+        keep = keep & (qpos[:, None, None, :, None]
+                       - ctx_positions[None, None, None, None, :] < window)
+    s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     o = jnp.einsum("nkgcs,nksd->nckgd", p, v_ctx)
     return o.reshape(N, C, H, D)
@@ -234,7 +254,7 @@ def _pallas_ok(q, k_pool) -> bool:
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
-                    alibi_slopes=None):
+                    alibi_slopes=None, window: int = 0):
     """Block-table paged attention.
 
     q [N, C, H, D]; k/v pool [NB, KH, bs, D]; block_tables [N, MB]
@@ -243,11 +263,15 @@ def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
     reference's blocked_kv_rotary-then-blocked_flash sequence).
     ``alibi_slopes`` [H]: optional ALiBi bias slopes (BLOOM-family
     serving) — bias slope·kv_position is added to the logits in-kernel.
+    ``window`` > 0: sliding-window attention (Mistral serving — reference
+    inference/v2/model_implementations/mistral/model.py:202); KV blocks
+    wholly before the window are skipped for compute and DMA.
     Rows beyond n_tokens are garbage (masked out downstream).
     """
     if _pallas_ok(q, k_pool):
         return _paged_pallas(q, k_pool, v_pool, block_tables, start_pos,
                              n_tokens, alibi_slopes=alibi_slopes,
-                             interpret=_use_interpret())
+                             window=window, interpret=_use_interpret())
     return paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos,
-                               n_tokens, alibi_slopes=alibi_slopes)
+                               n_tokens, alibi_slopes=alibi_slopes,
+                               window=window)

@@ -218,3 +218,52 @@ def test_opt_untied_embeddings(tmp_path_factory):
     path = _save(hf, tmp_path_factory, "opt_untied")
     model = _parity(path, hf, 88)
     assert not model.cfg.tie_embeddings
+
+
+def test_mistral_sliding_window_parity(tmp_path_factory):
+    """Mistral with seq > sliding_window: logits must match HF transformers
+    (which masks keys beyond the window) — the r3 divergence where the
+    window was dropped on import is now closed. Reference:
+    inference/v2/model_implementations/mistral/model.py:202."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    cfg = MistralConfig(vocab_size=120, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=64,
+                        sliding_window=8, tie_word_embeddings=False,
+                        attn_implementation="eager")
+    torch.manual_seed(0)
+    hf = MistralForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "mistral_swa")
+    # seq=20 > window=8: past-window keys must be masked
+    model = _parity(path, hf, 120, seq=20)
+    assert model.cfg.sliding_window == 8
+
+
+def test_mistral_sliding_window_generate(tmp_path_factory):
+    """v1 generate with a window shorter than the prompt matches HF greedy
+    generation token-for-token."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = MistralConfig(vocab_size=120, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=64,
+                        sliding_window=8, tie_word_embeddings=False,
+                        attn_implementation="eager")
+    torch.manual_seed(1)
+    hf = MistralForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "mistral_swa_gen")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngine(model, params=params)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 120, size=(2, 12))
+    ours = np.asarray(engine.generate(jnp.asarray(prompt, jnp.int32),
+                                      max_new_tokens=8))
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt), max_new_tokens=8,
+                             do_sample=False).numpy()
+    np.testing.assert_array_equal(ours, theirs)

@@ -198,3 +198,44 @@ def test_v2_put_matches_dense_alibi(monkeypatch):
     np.testing.assert_allclose(np.asarray(logits)[0],
                                np.asarray(full)[0, -1], atol=2e-3,
                                rtol=2e-3)
+
+
+@pytest.mark.parametrize("window", [8, 20, 48])
+def test_sliding_window_pallas_matches_xla(window, monkeypatch):
+    """Windowed paged kernel (Mistral serving) vs the XLA gather reference
+    with the same window clamp."""
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    N, C, H, KH, D, bs, MB, NB = 3, 4, 4, 2, 64, 16, 4, 16
+    rng = np.random.default_rng(1)
+    q, kp, vp, tbl, sp, nt = _build_case(rng, N, C, H, KH, D, bs, MB, NB,
+                                         [4, 37, 64])
+    ref = pa.paged_attention_xla(q, kp, vp, tbl, sp, nt, window=window)
+    out = pa.paged_attention(q, kp, vp, tbl, sp, nt, window=window)
+    for i in range(N):
+        v = int(nt[i])
+        np.testing.assert_allclose(np.asarray(out)[i, :v],
+                                   np.asarray(ref)[i, :v],
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_drops_old_context(monkeypatch):
+    """A decode step whose window excludes the early context must ignore it:
+    perturbing pre-window K/V slots must not change the output."""
+    monkeypatch.setattr(pa, "_FORCE_INTERPRET", True)
+    N, C, H, KH, D, bs, MB, NB = 1, 1, 2, 2, 64, 8, 8, 16
+    window = 16
+    rng = np.random.default_rng(2)
+    ctx = 60                               # decode at position 59
+    q, kp, vp, tbl, sp, nt = _build_case(rng, N, C, H, KH, D, bs, MB, NB,
+                                         [ctx])
+    out = pa.paged_attention(q, kp, vp, tbl, sp, nt, window=window)
+    # positions attended: (59 − 16, 59] = [44, 59] → pool blocks holding
+    # positions < 40 are entirely outside the window; scramble them
+    kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+    dead_blocks = np.asarray(tbl)[0, :5]   # positions 0..39
+    kp2[dead_blocks] = rng.standard_normal(kp2[dead_blocks].shape)
+    vp2[dead_blocks] = rng.standard_normal(vp2[dead_blocks].shape)
+    out2 = pa.paged_attention(q, jnp.asarray(kp2), jnp.asarray(vp2), tbl,
+                              sp, nt, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               atol=1e-6, rtol=1e-6)
