@@ -141,9 +141,20 @@ class InferenceEngine:
         sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
         return jnp.where(temperature <= 0, greedy, sampled)
 
+    # Paged-cache block size for the decode loop. 128 = one full VMEM tile
+    # of KV per (block, kv-head) slab in the Pallas kernel.
+    DECODE_BLOCK = 128
+
     def _generate_fn(self, max_len: int, max_new: int, top_k: int):
         """Build (and cache) the jitted prefill+scan-decode program. Cache
-        key is shapes + top_k only — temperature is a traced argument."""
+        key is shapes + top_k only — temperature is a traced argument.
+
+        The decode loop runs through the paged-attention kernel over a
+        pool-layout cache (the contiguous cache is the trivial-block-table
+        case), so per-token attention cost follows each sequence's live
+        context length — never the [B, S] mask materialization of the old
+        reference-attention path (reference decode hot loop:
+        csrc/transformer/inference/csrc/pt_binding.cpp)."""
         key = (max_len, max_new, top_k)
         if key in self._gen_cache:
             return self._gen_cache[key]
@@ -151,9 +162,13 @@ class InferenceEngine:
 
         def gen(params, tokens, prompt_len, rng, temperature):
             B, T = tokens.shape
-            cache = module.init_cache(B, max_len)
-            logits, cache = module.prefill(params, tokens, cache)
-            # logits at the last *real* prompt token
+            # fixed 128-slot blocks: the kernel's [bs, D] KV slab must stay
+            # tile-aligned; a short sequence just under-fills its one block
+            cache, tables = module.init_paged_cache(B, max_len,
+                                                    self.DECODE_BLOCK)
+            logits, cache = module.prefill_paged(params, tokens, prompt_len,
+                                                 cache, tables)
+            # logits at the last *real* prompt token (ragged prompts)
             last = jnp.take_along_axis(
                 logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
 
@@ -161,13 +176,20 @@ class InferenceEngine:
                 cache, cur, rng = carry
                 rng, sub = jax.random.split(rng)
                 nxt = self._sample(cur, sub, temperature, top_k)
-                pos = prompt_len[0] + i  # uniform prompt length per batch
-                logits, cache = module.decode_step(params, cache, nxt, pos)
+                pos = prompt_len + i               # per-sequence positions
+                logits, cache = module.decode_step_paged(
+                    params, cache, tables, nxt, pos)
                 return (cache, logits, rng), nxt
 
             (_, _, _), out_tokens = jax.lax.scan(
                 step, (cache, last, rng), jnp.arange(max_new))
-            return out_tokens.T  # [B, max_new]
+            out_tokens = out_tokens.T              # [B, max_new]
+            # place each sequence's new tokens right after its prompt
+            out = jnp.zeros((B, T + max_new), jnp.int32)
+            out = out.at[:, :T].set(tokens)
+            idx = prompt_len[:, None] + jnp.arange(max_new)[None, :]
+            return jax.vmap(lambda row, i, v: row.at[i].set(v))(
+                out, idx, out_tokens)
 
         fn = jax.jit(gen)
         self._gen_cache[key] = fn
@@ -175,12 +197,40 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, rng=None,
-                 **kwargs):
-        """HF-style generate. ``input_ids`` [B, T] (uniform length; the v2
-        engine handles ragged prompts). Returns [B, T + n] where n is
-        ``max_new_tokens`` clamped to the model's context window."""
-        tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
+                 prompt_len=None, **kwargs):
+        """HF-style generate with ragged-prompt support.
+
+        ``input_ids``: [B, T] array, or a list of per-sequence token
+        sequences (ragged — right-padded internally, like the reference v1
+        engine's variable-length serving). ``prompt_len`` [B] optionally
+        marks the real length of each row of a padded [B, T] array.
+        Returns [B, T + n] with each sequence's new tokens placed directly
+        after its prompt and pad id 0 beyond ``prompt_len[b] + n``."""
+        if isinstance(input_ids, (list, tuple)) and input_ids \
+                and isinstance(input_ids[0], (list, tuple, np.ndarray)):
+            lens = [len(p) for p in input_ids]
+            T = max(lens)
+            padded = np.zeros((len(input_ids), T), np.int32)
+            for i, p in enumerate(input_ids):
+                padded[i, :len(p)] = p
+            tokens = jnp.asarray(padded)
+            prompt_len = jnp.asarray(lens, jnp.int32)
+        else:
+            tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         B, T = tokens.shape
+        if prompt_len is None:
+            prompt_len = jnp.full((B,), T, jnp.int32)
+        else:
+            prompt_len = jnp.asarray(np.asarray(prompt_len), jnp.int32)
+            pl = np.asarray(prompt_len)
+            if pl.shape != (B,) or (pl < 1).any() or (pl > T).any():
+                raise ValueError(
+                    f"prompt_len must be [B]={B} values in [1, {T}]; got "
+                    f"shape {pl.shape}, range [{pl.min()}, {pl.max()}]")
+            # pad id 0 past each prompt so the region beyond prompt_len+n
+            # is deterministic regardless of the caller's pad token
+            tokens = jnp.where(jnp.arange(T)[None, :] < prompt_len[:, None],
+                               tokens, 0)
         ctx = self.module.cfg.max_seq_len
         if T >= ctx:
             raise ValueError(f"prompt length {T} >= max_seq_len {ctx}")
@@ -190,12 +240,10 @@ class InferenceEngine:
                 f"max_new_tokens clamped {max_new_tokens} → {max_new} "
                 f"(context window {ctx}, prompt {T})")
         max_len = T + max_new
-        prompt_len = jnp.full((B,), T, jnp.int32)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         fn = self._generate_fn(max_len, max_new, top_k)
-        new_tokens = fn(self.params, tokens, prompt_len, rng,
-                        jnp.asarray(temperature, jnp.float32))
-        return jnp.concatenate([tokens, new_tokens], axis=1)
+        return fn(self.params, tokens, prompt_len, rng,
+                  jnp.asarray(temperature, jnp.float32))
 
     # parity helpers --------------------------------------------------------
     def profile_model_time(self, use_cuda_events: bool = False):

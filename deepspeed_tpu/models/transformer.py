@@ -202,13 +202,19 @@ def rope_table(max_len: int, head_dim: int, theta: float) -> Tuple[jnp.ndarray, 
 
 
 def apply_rope(x, cos, sin):
-    """x: [B, T, H, D]; cos/sin: [T, R/2] with R ≤ D (partial rotary — the
-    GPT-NeoX rotary_pct layout — leaves the trailing D−R dims unrotated)."""
+    """x: [B, T, H, D]; cos/sin: [T, R/2] (shared positions) or [B, T, R/2]
+    (per-sequence positions — the ragged decode path), with R ≤ D (partial
+    rotary — the GPT-NeoX rotary_pct layout leaves the trailing D−R dims
+    unrotated)."""
     rot = cos.shape[-1] * 2
     xr, x_pass = x[..., :rot], x[..., rot:]
     x1, x2 = jnp.split(xr, 2, axis=-1)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 3:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     if x_pass.shape[-1]:
         out = jnp.concatenate([out, x_pass], axis=-1)
@@ -765,9 +771,11 @@ class CausalLM:
         shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.head_dim)
         return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
 
-    def prefill(self, params, tokens, cache):
-        """Process a full prompt, filling cache[:, :, :T]. Returns
-        (logits [B, T, V], cache)."""
+    def _prefill_impl(self, params, tokens, cache, write_kv):
+        """Shared prompt-processing scaffold: embed → layer scan (each layer
+        hands its K/V to ``write_kv(kc, vc, k, v) -> (kc, vc)``) → final
+        norm → logits. The contiguous and paged caches differ only in the
+        write."""
         cfg = self.cfg
         B, T = tokens.shape
         x = params["embed"]["wte"][tokens].astype(cfg.dtype)
@@ -782,8 +790,7 @@ class CausalLM:
             x = carry
             lp, kc, vc = xs
             x, k, v = self._block_kv(x, lp, cos, sin)
-            kc = lax.dynamic_update_slice(kc, k, (0, 0, 0, 0))
-            vc = lax.dynamic_update_slice(vc, v, (0, 0, 0, 0))
+            kc, vc = write_kv(kc, vc, k, v)
             return x, (kc, vc)
 
         x, (new_k, new_v) = lax.scan(body, x,
@@ -792,6 +799,15 @@ class CausalLM:
                   cfg.norm, cfg.norm_eps)
         logits = self._unembed(params, x)
         return logits, {"k": new_k, "v": new_v}
+
+    def prefill(self, params, tokens, cache):
+        """Process a full prompt, filling cache[:, :, :T]. Returns
+        (logits [B, T, V], cache)."""
+        def write(kc, vc, k, v):
+            return (lax.dynamic_update_slice(kc, k, (0, 0, 0, 0)),
+                    lax.dynamic_update_slice(vc, v, (0, 0, 0, 0)))
+
+        return self._prefill_impl(params, tokens, cache, write)
 
     def decode_step(self, params, cache, tokens, pos):
         """One decode step: tokens [B] at position ``pos`` (scalar int32).
@@ -815,6 +831,102 @@ class CausalLM:
 
         x, (new_k, new_v) = lax.scan(body, x,
                                      (params["layers"], cache["k"], cache["v"]))
+        x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
+                  cfg.norm, cfg.norm_eps)
+        logits = self._unembed(params, x)[:, 0]
+        return logits, {"k": new_k, "v": new_v}
+
+    # -- paged KV-cache inference (v1 decode through the paged kernel —
+    # the contiguous cache is the trivial-block-table case; reference decode
+    # hot loop: csrc/transformer/inference/csrc/pt_binding.cpp) -------------
+    def init_paged_cache(self, batch_size: int, max_len: int,
+                         block_size: int = 128):
+        """Pool-layout KV cache: [L, B·NB, KH, bs, D] with sequence b owning
+        the contiguous block range [b·NB, (b+1)·NB). Returns (cache, tables).
+        Unlike ``init_cache``'s [B, S, ...] layout, the pool layout feeds
+        ``ops/paged_attention.py`` directly — decode never materializes a
+        [*, S] mask or attends past each sequence's live length."""
+        cfg = self.cfg
+        nb = -(-max_len // block_size)
+        shape = (cfg.num_layers, batch_size * nb, cfg.kv_heads, block_size,
+                 cfg.head_dim)
+        tables = jnp.arange(batch_size * nb,
+                            dtype=jnp.int32).reshape(batch_size, nb)
+        return ({"k": jnp.zeros(shape, cfg.dtype),
+                 "v": jnp.zeros(shape, cfg.dtype)}, tables)
+
+    def prefill_paged(self, params, tokens, prompt_len, cache, tables):
+        """Ragged prefill: ``tokens`` [B, T] right-padded, ``prompt_len``
+        [B]. Causal attention over the padded batch (pad positions produce
+        garbage K/V but are overwritten by decode before any query can
+        attend them — the per-seq context mask in the paged kernel keeps
+        them dead). Returns (logits [B, T, V], cache)."""
+        cfg = self.cfg
+        B, T = tokens.shape
+        bs = cache["k"].shape[3]
+        # scatter coordinates: position t of sequence b → (table[b, t//bs],
+        # slot t%bs) — precomputed once, shared by every layer
+        pos = jnp.arange(T)
+        blk = jnp.take_along_axis(tables, (pos // bs)[None, :], axis=1)  # [B,T]
+        write_blk = blk.reshape(-1)
+        write_off = jnp.tile(pos % bs, B)
+
+        def write(kc, vc, k, v):
+            kc = kc.at[write_blk, :, write_off, :].set(
+                k.reshape(B * T, cfg.kv_heads, cfg.head_dim))
+            vc = vc.at[write_blk, :, write_off, :].set(
+                v.reshape(B * T, cfg.kv_heads, cfg.head_dim))
+            return kc, vc
+
+        return self._prefill_impl(params, tokens, cache, write)
+
+    def decode_step_paged(self, params, cache, tables, tokens, pos):
+        """One ragged decode step: ``tokens`` [B] at per-sequence positions
+        ``pos`` [B]. Attention runs through the Pallas paged kernel (XLA
+        gather fallback off-TPU) — per-token cost scales with each
+        sequence's live context, not the cache capacity. Returns
+        (logits [B, V], cache)."""
+        cfg = self.cfg
+        B = tokens.shape[0]
+        bs = cache["k"].shape[3]
+        x = params["embed"]["wte"][tokens][:, None, :].astype(cfg.dtype)
+        if cfg.embedding_layernorm:
+            x = _norm(x, params["embed"]["ln_w"], params["embed"].get("ln_b"),
+                      cfg.norm, cfg.norm_eps)
+        pos = jnp.asarray(pos, jnp.int32)
+        cos, sin = self._pos_tables(1, pos)
+        if cfg.position == "rope":
+            cos, sin = cos[:, None, :], sin[:, None, :]   # per-seq [B,1,R/2]
+        if cfg.position == "learned":
+            x = x + params["embed"]["wpe"][pos][:, None, :].astype(cfg.dtype)
+        slopes = (alibi_slopes(cfg.num_heads) if cfg.position == "alibi"
+                  else None)
+
+        write_blk = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                                        axis=1)[:, 0]                 # [B]
+        write_off = pos % bs
+        n_tok = jnp.ones((B,), jnp.int32)
+
+        def body(carry, xs):
+            x = carry
+            lp, kc, vc = xs
+            h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm,
+                       cfg.norm_eps)
+            q, k, v = self._qkv(h1, lp, cos, sin, B, 1)
+            kc = kc.at[write_blk, :, write_off, :].set(k[:, 0])
+            vc = vc.at[write_blk, :, write_off, :].set(v[:, 0])
+            from ..ops.paged_attention import paged_attention
+
+            attn = paged_attention(q, kc, vc, tables, pos, n_tok,
+                                   alibi_slopes=slopes,
+                                   window=cfg.sliding_window or 0)
+            attn = _linear(attn.reshape(B, 1, -1), lp["wo"], lp.get("wo_b"),
+                           cfg.dtype)
+            return self._attn_mlp_merge(x, attn, lp), (kc, vc)
+
+        x, (new_k, new_v) = lax.scan(body, x,
+                                     (params["layers"], cache["k"],
+                                      cache["v"]))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         logits = self._unembed(params, x)[:, 0]
