@@ -69,7 +69,26 @@ def initialize(args=None,
             from .models import build_model
 
             model = build_model(model)
-        engine = ZeroInfinityEngine(model, cfg_obj, rng=rng)
+        # Mesh composition: streaming runs under fsdp×data sharding (the
+        # reference's NVMe swap runs under ZeRO-3 partitioning the same
+        # way — stage3.py:72); other axes don't compose with streaming.
+        from .parallel import topology as _topo
+
+        mesh = None
+        if "mesh" in cfg_obj.model_fields_set:
+            # mesh requested explicitly → shard streaming over fsdp×data;
+            # without a mesh block the engine stays single-device (the
+            # pre-round-4 behavior)
+            topo_obj = _topo.MeshTopology.build(cfg_obj.mesh)
+            bad_axes = {a: topo_obj.axis_size(a)
+                        for a in ("tensor", "pipe", "sequence", "expert")
+                        if topo_obj.axis_size(a) > 1}
+            if bad_axes:
+                raise ValueError(
+                    f"offload_param streaming composes with data/fsdp mesh "
+                    f"axes only; got {bad_axes}")
+            mesh = topo_obj.mesh
+        engine = ZeroInfinityEngine(model, cfg_obj, rng=rng, mesh=mesh)
         return engine, None, None, None
 
     engine_cls = DeepSpeedTpuEngine

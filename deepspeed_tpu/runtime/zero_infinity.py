@@ -48,12 +48,16 @@ from .swap_tensor.async_swapper import AsyncTensorSwapper
 
 class _HostStore:
     """Per-group param/moment store: NVMe files via the aio swapper, or
-    plain host arrays when device == 'cpu'. Counters prove streaming."""
+    plain host arrays when device == 'cpu'. Counters prove streaming —
+    ``bytes_read`` lets tests assert that a mesh-sharded engine pages only
+    its 1/F-sized shards, never whole leaves."""
 
     def __init__(self, device: str, nvme_path: Optional[str], n_threads: int):
         self.device = device
         self.reads = 0
         self.writes = 0
+        self.bytes_read = 0
+        self.read_keys: set = set()
         self._mem: Dict[str, np.ndarray] = {}
         self._shapes: Dict[str, tuple] = {}
         self.swapper = None
@@ -73,14 +77,18 @@ class _HostStore:
 
     def get(self, key: str, out: Optional[np.ndarray] = None) -> np.ndarray:
         self.reads += 1
+        self.read_keys.add(key)
         if self.swapper is not None:
             shape, dtype = self._shapes[key]
             buf = out if out is not None and out.shape == shape \
                 else np.empty(shape, dtype)
             self.swapper.swap_in(key, buf)
             self.swapper.wait()
+            self.bytes_read += buf.nbytes
             return buf
-        return self._mem[key]
+        arr = self._mem[key]
+        self.bytes_read += arr.nbytes
+        return arr
 
     def close(self):
         if self.swapper is not None:
@@ -97,7 +105,7 @@ class ZeroInfinityEngine:
     """
 
     def __init__(self, model: CausalLM, config, rng=None,
-                 group_layers: Optional[int] = None):
+                 group_layers: Optional[int] = None, mesh=None):
         if model.cfg.tie_embeddings:
             raise ValueError("ZeRO-Infinity streaming requires "
                              "tie_embeddings=False (wte would need to be "
@@ -114,6 +122,16 @@ class ZeroInfinityEngine:
         self.store = _HostStore(str(oc.device.value), oc.nvme_path,
                                 config.aio.thread_count)
 
+        # Mesh composition (round-4: the reference's NVMe swap runs *under*
+        # ZeRO-3 sharding — stage3.py:72 + partitioned_param_swapper.py:36
+        # swap per-rank partitions): the device-resident layer group is
+        # sharded over the ``fsdp`` axis and the batch over ``data``; the
+        # host store holds per-shard files so each process pages only its
+        # own 1/F of every leaf, and the host optimizer steps per shard.
+        self.mesh = mesh
+        self.fsdp = int(mesh.shape["fsdp"]) if mesh is not None else 1
+        self.dp = int(mesh.shape["data"]) if mesh is not None else 1
+
         L = self.cfg.num_layers
         self.group_layers = group_layers or max(1, math.ceil(L / 4))
         self.groups: List[slice] = [
@@ -126,22 +144,27 @@ class ZeroInfinityEngine:
         shapes = jax.eval_shape(model.init, rng)
         seedseq = np.random.SeedSequence(int(config.seed))
         self._layer_keys = sorted(shapes["layers"].keys())
+        self._shard_axis = {
+            k: self._pick_shard_axis(tuple(shapes["layers"][k].shape[1:]))
+            for k in self._layer_keys}
         self.param_bytes = 0
         for gi, sl in enumerate(self.groups):
             for k in self._layer_keys:
                 full = shapes["layers"][k]
                 shape = (sl.stop - sl.start,) + tuple(full.shape[1:])
                 arr = self._init_leaf(f"layers.{k}", shape, seedseq)
-                self.store.put(f"layers.{k}.g{gi}", arr)
-                self.store.put(f"opt_m.layers.{k}.g{gi}", np.zeros_like(arr))
-                self.store.put(f"opt_v.layers.{k}.g{gi}", np.zeros_like(arr))
+                for key, piece in self._shards(f"layers.{k}.g{gi}", k, arr):
+                    self.store.put(key, piece)
+                    self.store.put(f"opt_m.{key}", np.zeros_like(piece))
+                    self.store.put(f"opt_v.{key}", np.zeros_like(piece))
                 self.param_bytes += arr.nbytes
         self._edge_params = {}   # embed/final_norm/lm_head stay resident
         for grp in ("embed", "final_norm", "lm_head"):
             if grp in shapes:
                 self._edge_params[grp] = {
-                    k: jnp.asarray(self._init_leaf(f"{grp}.{k}",
-                                                   tuple(v.shape), seedseq))
+                    k: self._replicate(self._init_leaf(f"{grp}.{k}",
+                                                       tuple(v.shape),
+                                                       seedseq))
                     for k, v in shapes[grp].items()}
         self._edge_m = jax.tree.map(np.zeros_like,
                                     jax.tree.map(np.asarray, self._edge_params))
@@ -153,7 +176,59 @@ class ZeroInfinityEngine:
         logger.info(
             f"ZeRO-Infinity: {len(self.groups)} groups × {self.group_layers} "
             f"layers, params {self.param_bytes / 1e6:.1f} MB on "
-            f"{self.store.device}")
+            f"{self.store.device}"
+            + (f", sharded fsdp={self.fsdp} × data={self.dp}"
+               if mesh is not None else ""))
+
+    # ------------------------------------------------------- mesh sharding
+    def _pick_shard_axis(self, rest_shape) -> Optional[int]:
+        """Absolute axis (>=1; 0 is the stacked-layer dim) along which a
+        layer leaf is split over fsdp — the largest dim divisible by F.
+        None → leaf replicated (small norm weights/biases)."""
+        if self.fsdp <= 1:
+            return None
+        best = None
+        for d, extent in enumerate(rest_shape):
+            if extent % self.fsdp == 0 and extent >= self.fsdp:
+                if best is None or extent > rest_shape[best - 1]:
+                    best = d + 1
+        return best
+
+    def _shards(self, base_key: str, leaf_key: str, arr: np.ndarray):
+        """Yield (store key, host piece) pairs — one per fsdp shard for
+        sharded leaves, a single full copy for replicated ones."""
+        ax = self._shard_axis[leaf_key]
+        if ax is None:
+            yield base_key, arr
+            return
+        for si, piece in enumerate(np.split(arr, self.fsdp, axis=ax)):
+            yield f"{base_key}.s{si}", np.ascontiguousarray(piece)
+
+    def _leaf_sharding(self, leaf_key: str):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ax = self._shard_axis[leaf_key]
+        if ax is None:
+            return NamedSharding(self.mesh, P())
+        parts = [None] * (ax + 1)
+        parts[ax] = "fsdp"
+        return NamedSharding(self.mesh, P(*parts))
+
+    def _data_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P("data"))
+
+    def _repl_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def _replicate(self, arr):
+        """Edge params live replicated on every mesh device."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, self._repl_sharding())
 
     def _init_leaf(self, name: str, shape, seedseq) -> np.ndarray:
         """Same init families as CausalLM.init (models/transformer.py:285):
@@ -196,42 +271,121 @@ class ZeroInfinityEngine:
                                        axis=-1)[..., 0]
             return jnp.mean(logz - gold)
 
-        self._group_fwd = jax.jit(group_fwd)
-        self._group_bwd = jax.jit(
-            lambda gp, x, cos, sin, dy: jax.vjp(
-                lambda gp_, x_: group_fwd(gp_, x_, cos, sin), gp, x)[1](dy))
-        self._embed_fwd = jax.jit(embed_fwd)
-        self._embed_bwd = jax.jit(
-            lambda ep, tokens, positions, dy: jax.vjp(
-                lambda ep_: embed_fwd(ep_, tokens, positions), ep)[1](dy)[0])
-        self._head_grad = jax.jit(jax.value_and_grad(head_loss, argnums=(0, 1)))
+        group_bwd = lambda gp, x, cos, sin, dy: jax.vjp(      # noqa: E731
+            lambda gp_, x_: group_fwd(gp_, x_, cos, sin), gp, x)[1](dy)
+        embed_bwd = lambda ep, tokens, positions, dy: jax.vjp(  # noqa: E731
+            lambda ep_: embed_fwd(ep_, tokens, positions), ep)[1](dy)[0]
+        head_grad = jax.value_and_grad(head_loss, argnums=(0, 1))
+
+        if self.mesh is None:
+            self._group_fwd = jax.jit(group_fwd)
+            self._group_bwd = jax.jit(group_bwd)
+            self._embed_fwd = jax.jit(embed_fwd)
+            self._embed_bwd = jax.jit(embed_bwd)
+            self._head_grad = jax.jit(head_grad)
+            return
+
+        # Mesh mode: activations ride the data axis, param grads land
+        # reduce-scattered onto their fsdp shards, edge grads land
+        # replicated (GSPMD inserts the data-axis psum / reduce-scatter to
+        # satisfy the out_shardings — the ZeRO-3 grad flow).
+        data_s = self._data_sharding()
+        repl_s = self._repl_sharding()
+        gp_s = {k: self._leaf_sharding(k) for k in self._layer_keys}
+        self._group_fwd = jax.jit(group_fwd, out_shardings=data_s)
+        self._group_bwd = jax.jit(group_bwd, out_shardings=(gp_s, data_s))
+        self._embed_fwd = jax.jit(embed_fwd, out_shardings=data_s)
+        self._embed_bwd = jax.jit(embed_bwd, out_shardings=repl_s)
+        self._head_grad = jax.jit(
+            head_grad,
+            out_shardings=(repl_s, (repl_s, data_s)))
 
     # ------------------------------------------------------------- streaming
-    def _load_group(self, gi: int) -> Dict[str, np.ndarray]:
-        return {k: self.store.get(f"layers.{k}.g{gi}")
+    def _local_shards(self, leaf_key: str):
+        """Shard indices this process pages for a leaf: all of them in a
+        single-process mesh; only the fsdp coordinates of local devices in
+        a multi-process one (per-host paging of per-host shards)."""
+        if self.mesh is None or self._shard_axis[leaf_key] is None:
+            return [None]
+        fa = list(self.mesh.axis_names).index("fsdp")
+        sis = set()
+        for d in self.mesh.local_devices:
+            coord = np.argwhere(self.mesh.devices == d)[0]
+            sis.add(int(coord[fa]))
+        return sorted(sis)
+
+    def _key(self, k: str, gi: int, si) -> str:
+        base = f"layers.{k}.g{gi}"
+        return base if si is None else f"{base}.s{si}"
+
+    def _load_group(self, gi: int) -> Dict[str, Dict]:
+        """Page one group's masters off the store — per fsdp shard."""
+        return {k: {si: self.store.get(self._key(k, gi, si))
+                    for si in self._local_shards(k)}
                 for k in self._layer_keys}
 
     def _group_to_device(self, host_group):
-        return {k: jnp.asarray(v) for k, v in host_group.items()}
+        if self.mesh is None:
+            # single-device: the inner dict is {None: full_leaf}
+            return {k: jnp.asarray(shards[None])
+                    for k, shards in host_group.items()}
+        out = {}
+        for k, shards in host_group.items():
+            ax = self._shard_axis[k]
+            if ax is None:
+                out[k] = jax.device_put(shards[None], self._repl_sharding())
+                continue
+            some = next(iter(shards.values()))
+            full = list(some.shape)
+            full[ax] *= self.fsdp
+            shard_len = some.shape[ax]
+
+            def cb(idx, shards=shards, ax=ax, shard_len=shard_len):
+                si = (idx[ax].start or 0) // shard_len
+                return shards[si]
+
+            out[k] = jax.make_array_from_callback(
+                tuple(full), self._leaf_sharding(k), cb)
+        return out
+
+    def _grads_to_host(self, dgp) -> Dict[str, Dict]:
+        """Per-shard host grads: {leaf: {si: np}} — each process touches
+        only its addressable shards (grads arrive fsdp-sharded and already
+        data-reduced, per the out_shardings)."""
+        out = {}
+        for k in self._layer_keys:
+            g = dgp[k]
+            ax = self._shard_axis[k]
+            if self.mesh is None or ax is None:
+                out[k] = {None: np.asarray(g, np.float32)}
+                continue
+            shard_len = g.shape[ax] // self.fsdp
+            d = {}
+            for sh in g.addressable_shards:
+                si = (sh.index[ax].start or 0) // shard_len
+                if si not in d:
+                    d[si] = np.asarray(sh.data, np.float32)
+            out[k] = d
+        return out
 
     def _update_group(self, gi: int, host_group, dev_grads):
-        """C++ host optimizer on one group's masters; page back out."""
+        """C++ host optimizer on one group's master shards; page back out."""
         for k in self._layer_keys:
-            g = np.ascontiguousarray(
-                np.asarray(dev_grads[k], np.float32).reshape(-1))
-            master = host_group[k].reshape(-1)
-            m = self.store.get(f"opt_m.layers.{k}.g{gi}").reshape(-1)
-            v = self.store.get(f"opt_v.layers.{k}.g{gi}").reshape(-1)
-            # bias-correction counter synthesized from the engine step (one
-            # shared counter; every leaf advances once per global step)
-            st = {"m": m, "v": v,
-                  "step": np.asarray([self.opt_step - 1], np.float32)}
-            self.cpu_opt.step(master, g, st, lr=self.lr)
-            self.store.put(f"layers.{k}.g{gi}", host_group[k])
-            self.store.put(f"opt_m.layers.{k}.g{gi}",
-                           m.reshape(host_group[k].shape))
-            self.store.put(f"opt_v.layers.{k}.g{gi}",
-                           v.reshape(host_group[k].shape))
+            for si, master_arr in host_group[k].items():
+                key = self._key(k, gi, si)
+                g = np.ascontiguousarray(
+                    dev_grads[k][si].reshape(-1))
+                master = master_arr.reshape(-1)
+                m = self.store.get(f"opt_m.{key}").reshape(-1)
+                v = self.store.get(f"opt_v.{key}").reshape(-1)
+                # bias-correction counter synthesized from the engine step
+                # (one shared counter; every leaf advances once per step)
+                st = {"m": m, "v": v,
+                      "step": np.asarray([self.opt_step - 1], np.float32)}
+                self.cpu_opt.step(master, g, st, lr=self.lr)
+                self.store.put(key, master_arr)
+                self.store.put(f"opt_m.{key}", m.reshape(master_arr.shape))
+                self.store.put(f"opt_v.{key}", v.reshape(master_arr.shape))
 
     # ------------------------------------------------------------------ step
     def train_batch(self, batch) -> float:
@@ -244,10 +398,20 @@ class ZeroInfinityEngine:
             raise TypeError(
                 "train_batch expects a batch dict or an iterator; wrap "
                 "lists/datasets in iter(...) so consumption is stateful")
-        tokens = jnp.asarray(np.asarray(data["input_ids"]), jnp.int32)
-        labels = tokens[:, 1:]
-        tokens = tokens[:, :-1]
-        B, T = tokens.shape
+        host_tokens = np.asarray(data["input_ids"])
+        labels_np = host_tokens[:, 1:]
+        tokens_np = host_tokens[:, :-1]
+        B, T = tokens_np.shape
+        if self.mesh is None:
+            tokens = jnp.asarray(tokens_np, jnp.int32)
+            labels = jnp.asarray(labels_np, jnp.int32)
+        else:
+            if B % self.dp != 0:
+                raise ValueError(f"batch {B} not divisible by data axis "
+                                 f"{self.dp}")
+            ds = self._data_sharding()
+            tokens = jax.device_put(tokens_np.astype(np.int32), ds)
+            labels = jax.device_put(labels_np.astype(np.int32), ds)
         positions = jnp.arange(T)
         cos, sin = self.module._pos_tables(T, None)
         self.opt_step += 1
@@ -279,7 +443,7 @@ class ZeroInfinityEngine:
                 fut = self._prefetch.submit(self._load_group, gi - 1)
             gp = self._group_to_device(host_group)
             dgp, dx = self._group_bwd(gp, boundary[gi], cos, sin, dx)
-            dgp_host = {k: np.asarray(v) for k, v in dgp.items()}
+            dgp_host = self._grads_to_host(dgp)
             if pending_update is not None:
                 pending_update.result()
             pending_update = self._prefetch.submit(
@@ -305,7 +469,7 @@ class ZeroInfinityEngine:
                  "v": self._edge_v[grp][k].reshape(-1),
                  "step": np.asarray([self.opt_step - 1], np.float32)},
                 lr=self.lr)
-            self._edge_params[grp][k] = jnp.asarray(
+            self._edge_params[grp][k] = self._replicate(
                 p.reshape(self._edge_params[grp][k].shape))
 
     def _apply_edge_head(self, dhp):

@@ -114,3 +114,88 @@ def test_device_budget_accounting(tmp_path):
         "anything")
     assert len(engine.groups) == 4
     engine.close()
+
+
+# ---------------------------------------------------------------- mesh × streaming
+
+def make_mesh_engine(tmp_path, data=2, fsdp=4, group_layers=2,
+                     device="cpu"):
+    """Streaming engine over a data×fsdp mesh (round-4: the reference's
+    NVMe swap runs under ZeRO-3 partitioning — stage3.py:72 +
+    partitioned_param_swapper.py:36 page per-rank shards)."""
+    topo.reset_topology()
+    from deepspeed_tpu.runtime.config import load_config
+    from deepspeed_tpu.runtime.zero_infinity import ZeroInfinityEngine
+
+    t = topo.MeshTopology.build(data=data, fsdp=fsdp)
+    config = load_config({
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": device,
+                              "nvme_path": str(tmp_path / "swap")},
+        },
+        "steps_per_print": 10**9,
+    })
+    return ZeroInfinityEngine(CausalLM(CFG), config,
+                              group_layers=group_layers, mesh=t.mesh)
+
+
+def test_mesh_streaming_loss_matches_single_device(tmp_path):
+    """fsdp×data-sharded streaming reproduces the single-device streaming
+    loss trajectory (same seeded host init)."""
+    single = make_engine(tmp_path / "a", device="cpu")
+    meshed = make_mesh_engine(tmp_path / "b", data=2, fsdp=4)
+    data = batch()
+    for step in range(3):
+        ls = single.train_batch(dict(data))
+        lm = meshed.train_batch(dict(data))
+        np.testing.assert_allclose(lm, ls, rtol=2e-4,
+                                   err_msg=f"step {step}")
+    single.close()
+    meshed.close()
+
+
+def test_mesh_streaming_pages_per_shard(tmp_path):
+    """I/O counters prove per-shard paging: every sharded leaf is read as
+    fsdp-many 1/F-sized pieces, never as a whole leaf."""
+    engine = make_mesh_engine(tmp_path, data=2, fsdp=4)
+    bytes_before = engine.store.bytes_read
+    engine.train_batch(batch())
+    step_bytes = engine.store.bytes_read - bytes_before
+    # fwd pages params once, bwd pages params + both moments once
+    assert step_bytes > 0
+    assert step_bytes <= 4.5 * engine.param_bytes, (
+        "paging volume should be ~4x param bytes per step (1 fwd + 1 bwd "
+        f"read of params + m + v), got {step_bytes / engine.param_bytes:.1f}x")
+    shard_keys = [k for k in engine.store.read_keys
+                  if k.startswith("layers.") and ".s" in k]
+    assert shard_keys, "no per-shard reads recorded"
+    # all fsdp shard indices show up
+    sis = {int(k.rsplit(".s", 1)[1]) for k in shard_keys}
+    assert sis == {0, 1, 2, 3}, sis
+    # sharded leaves are never read whole: for every leaf with a shard
+    # axis, no un-suffixed key was read
+    for k in engine._layer_keys:
+        if engine._shard_axis[k] is not None:
+            for gi in range(len(engine.groups)):
+                assert f"layers.{k}.g{gi}" not in engine.store.read_keys
+    # each piece is 1/F of the leaf
+    some_key = next(k for k in engine._layer_keys
+                    if engine._shard_axis[k] is not None)
+    piece = engine.store.get(engine._key(some_key, 0, 0))
+    whole_elems = np.prod(
+        jax.eval_shape(engine.module.init, jax.random.PRNGKey(0))
+        ["layers"][some_key].shape[1:])
+    assert piece.size == (engine.groups[0].stop - engine.groups[0].start) \
+        * whole_elems // 4
+    engine.close()
+
+
+def test_mesh_streaming_converges(tmp_path):
+    engine = make_mesh_engine(tmp_path, data=2, fsdp=4)
+    data = batch()
+    losses = [engine.train_batch(dict(data)) for _ in range(8)]
+    assert losses[-1] < losses[0] - 0.5, f"no convergence: {losses}"
+    engine.close()
