@@ -28,9 +28,20 @@ all cross-worker communication — exactly the reference's contract where the
 1-bit optimizer takes over gradient averaging from the engine
 (``runtime/engine.py:1194`` skips the engine allreduce for these types).
 
+Wire formats (``wire_bits``):
+- **1 (default)**: true packed-bit two-phase reduction, the reference's
+  ``compressed_allreduce`` (runtime/comm/nccl.py:16) re-expressed with XLA
+  collectives: sign bits packed 8-per-uint8 (``jnp.packbits``), phase 1
+  ``all_to_all`` scatters each worker's per-segment bit chunks + an
+  all-gather of the per-worker scales, local unpack/average produces this
+  worker's segment of the mean, phase 2 re-compresses the segment against
+  a *server* error-feedback buffer (the reference's server_error) and
+  ``all_gather``s packed bits + scales. Wire bytes ≈ 2·numel/8 per step —
+  the reference's ~32x over fp32, ~8x less than the int8 format below.
+- **8**: int8 sign ``psum`` — one fused all-reduce, no bit twiddling; the
+  better trade on small ICI meshes where latency, not bytes, dominates.
+
 Documented divergences from the reference (design, not omission):
-- int8 wire format (4x) instead of packed 1-bit (32x): XLA all-reduce has no
-  sub-byte dtype; the error-feedback algebra is identical.
 - ZeroOneAdam's *local-step* intervals (skipping sync entirely for k steps)
   cannot be expressed under SPMD with replicated parameters — every worker
   must hold identical params. Its variance-freeze policy and compressed
@@ -52,6 +63,53 @@ from jax import lax
 from .optimizers import Optimizer, OptimizerState, _tmap, _unzip
 
 AXIS = "data"
+
+
+def _seg_len(n: int, dp: int) -> int:
+    """Per-worker segment length for the two-phase wire: numel padded up so
+    every worker's segment is a whole number of bytes of sign bits."""
+    padded = -(-n // (dp * 8)) * dp * 8
+    return padded // dp
+
+
+def _sign_compress_two_phase(c, e_srv, dp: int):
+    """Packed-bit two-phase compressed all-reduce (reference
+    runtime/comm/nccl.py:16 semantics) over the data axis; runs inside
+    shard_map.
+
+    ``c``: this worker's error-compensated buffer (any shape);
+    ``e_srv`` [seg]: this worker's *server* error-feedback segment.
+    Returns ``(avg, worker_err, e_srv_new)`` where ``avg`` is the
+    twice-compressed mean of the workers' contributions and ``worker_err``
+    = c − sign(c)·scale is next step's worker residual.
+    """
+    n = c.size
+    seg = _seg_len(n, dp)
+    flat = jnp.pad(c.reshape(-1), (0, seg * dp - n))
+    scale = jnp.mean(jnp.abs(c))
+    sign_pos = flat >= 0
+    packed = jnp.packbits(sign_pos)                       # [dp·seg/8] uint8
+    # phase 1: worker i keeps segment i of everyone's buffer
+    recv = lax.all_to_all(packed.reshape(dp, seg // 8), AXIS, 0, 0)
+    scales = lax.all_gather(scale, AXIS)                  # [dp]
+    signs = jnp.where(jnp.unpackbits(recv.reshape(-1)).astype(jnp.bool_),
+                      1.0, -1.0).astype(c.dtype).reshape(dp, seg)
+    seg_avg = jnp.mean(signs * scales[:, None], axis=0)   # [seg]
+    # phase 2: re-compress the averaged segment against the server error
+    w = lax.axis_index(AXIS)
+    live = (w * seg + jnp.arange(seg)) < n                # mask pad tail
+    s = jnp.where(live, seg_avg + e_srv, 0.0)
+    scale2 = lax.pmean(jnp.sum(jnp.abs(s)), AXIS) * (dp / max(n, 1))
+    sign2_pos = s >= 0
+    e_srv_new = jnp.where(live, s - jnp.where(sign2_pos, scale2, -scale2),
+                          0.0)
+    all_packed = lax.all_gather(jnp.packbits(sign2_pos), AXIS)  # [dp, seg/8]
+    full_signs = jnp.where(
+        jnp.unpackbits(all_packed.reshape(-1)).astype(jnp.bool_),
+        scale2, -scale2).astype(c.dtype)
+    avg = full_signs[:n].reshape(c.shape)
+    err = c - jnp.where(sign_pos[:n].reshape(c.shape), scale, -scale)
+    return avg, err, e_srv_new
 
 
 def _sign_compress_psum(c, dp: int):
@@ -90,13 +148,37 @@ class OneBitOptimizer(Optimizer):
       collectives).
     """
 
-    dp_moment_keys = frozenset({"e"})
+    dp_moment_keys = frozenset({"e", "e2"})
     dp_size = 1
     freeze_step = 0
+    wire_bits = 1
 
     def _error_init(self, params):
         return _tmap(
             lambda p: jnp.zeros((self.dp_size,) + p.shape, p.dtype), params)
+
+    def _server_error_init(self, params):
+        """Per-worker server-error segments for the packed two-phase wire
+        (reference nccl.py server_error); one 1/dp-sized flat segment per
+        worker per leaf. Zero-length segments under the int8 wire keep the
+        moments pytree uniform at no memory cost."""
+        seg = (lambda p: _seg_len(p.size, self.dp_size)) \
+            if self.wire_bits == 1 else (lambda p: 0)
+        return _tmap(
+            lambda p: jnp.zeros((self.dp_size, seg(p)), p.dtype), params)
+
+    def _compress(self, c, e2, dp):
+        """Dispatch on the wire format. Returns (avg, worker_err, e2_new)."""
+        if self.wire_bits == 1:
+            return _sign_compress_two_phase(c, e2[0], dp)
+        avg, err = _sign_compress_psum(c, dp)
+        return avg, err, e2[0]
+
+    def _check_wire_bits(self):
+        if self.wire_bits not in (1, 8):
+            raise ValueError(
+                f"wire_bits must be 1 (packed two-phase) or 8 (int8 psum); "
+                f"got {self.wire_bits}")
 
     def step(self, params, grads, state, lr):
         raise TypeError(
@@ -120,18 +202,21 @@ class OneBitAdam(OneBitOptimizer):
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, freeze_step=100000, bias_correction=True,
-                 **_):
+                 wire_bits=1, **_):
         self.lr, self.betas, self.eps = lr, tuple(betas), eps
         self.weight_decay = weight_decay
         self.freeze_step = int(freeze_step)
         self.bias_correction = bias_correction
+        self.wire_bits = int(wire_bits)
+        self._check_wire_bits()
 
     def init(self, params):
         zeros = _tmap(jnp.zeros_like, params)
         return OptimizerState(
             step=jnp.zeros((), jnp.int32),
             moments={"m": zeros, "v": _tmap(jnp.zeros_like, params),
-                     "e": self._error_init(params)})
+                     "e": self._error_init(params),
+                     "e2": self._server_error_init(params)})
 
     def _corrections(self, tf):
         if not self.bias_correction:
@@ -145,20 +230,22 @@ class OneBitAdam(OneBitOptimizer):
         c1, c2 = self._corrections(t.astype(jnp.float32))
         wd = self.weight_decay
 
-        def upd(p, g_local, m, v, e):
+        def upd(p, g_local, m, v, e, e2):
             g = lax.pmean(g_local, AXIS)
             if wd:  # classic Adam L2 (reference adam.py warmup path)
                 g = g + wd * p
             m2 = b1 * m + (1 - b1) * g
             v2 = b2 * v + (1 - b2) * jnp.square(g)
             update = (m2 / c1) / (jnp.sqrt(v2 / c2) + self.eps)
-            return p - lr * update, m2, v2, e
+            return p - lr * update, m2, v2, e, e2
 
         out = _tmap(upd, params, grads, state.moments["m"],
-                    state.moments["v"], state.moments["e"])
-        new_p, new_m, new_v, new_e = _unzip(out, 4)
+                    state.moments["v"], state.moments["e"],
+                    state.moments["e2"])
+        new_p, new_m, new_v, new_e, new_e2 = _unzip(out, 5)
         return new_p, OptimizerState(
-            step=t, moments={"m": new_m, "v": new_v, "e": new_e})
+            step=t, moments={"m": new_m, "v": new_v, "e": new_e,
+                             "e2": new_e2})
 
     def compressed_step_local(self, params, grads, state, lr):
         b1, _ = self.betas
@@ -166,19 +253,21 @@ class OneBitAdam(OneBitOptimizer):
         wd = self.weight_decay
         dp = self.dp_size
 
-        def upd(p, g, m, v, e):
+        def upd(p, g, m, v, e, e2):
             c = b1 * m + (1 - b1) * g + e[0]
-            m2, err = _sign_compress_psum(c, dp)
+            m2, err, e2n = self._compress(c, e2, dp)
             update = m2 / (jnp.sqrt(v) + self.eps)   # v frozen at freeze_step
             if wd:
                 update = update + wd * p
-            return p - lr * update, m2, v, err[None]
+            return p - lr * update, m2, v, err[None], e2n[None]
 
         out = _tmap(upd, params, grads, state.moments["m"],
-                    state.moments["v"], state.moments["e"])
-        new_p, new_m, new_v, new_e = _unzip(out, 4)
+                    state.moments["v"], state.moments["e"],
+                    state.moments["e2"])
+        new_p, new_m, new_v, new_e, new_e2 = _unzip(out, 5)
         return new_p, OptimizerState(
-            step=t, moments={"m": new_m, "v": new_v, "e": new_e})
+            step=t, moments={"m": new_m, "v": new_v, "e": new_e,
+                             "e2": new_e2})
 
 
 class ZeroOneAdam(OneBitAdam):
@@ -193,11 +282,13 @@ class ZeroOneAdam(OneBitAdam):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, var_freeze_step=100000,
                  var_update_scaler=16, local_step_scaler=32678,
-                 local_step_clipper=16, bias_correction=True, **_):
+                 local_step_clipper=16, bias_correction=True, wire_bits=1,
+                 **_):
         super().__init__(lr=lr, betas=betas, eps=eps,
                          weight_decay=weight_decay,
                          freeze_step=var_freeze_step,
-                         bias_correction=bias_correction)
+                         bias_correction=bias_correction,
+                         wire_bits=wire_bits)
         self.var_update_scaler = var_update_scaler
         self.local_step_scaler = local_step_scaler
         self.local_step_clipper = local_step_clipper
@@ -211,15 +302,16 @@ class OneBitLamb(OneBitOptimizer):
     compressed averaged momentum and frozen variance."""
 
     name = "onebitlamb"
-    dp_moment_keys = frozenset({"e"})
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
                  weight_decay=0.0, freeze_step=100000, max_coeff=10.0,
-                 min_coeff=0.01, **_):
+                 min_coeff=0.01, wire_bits=1, **_):
         self.lr, self.betas, self.eps = lr, tuple(betas), eps
         self.weight_decay = weight_decay
         self.freeze_step = int(freeze_step)
         self.max_coeff, self.min_coeff = max_coeff, min_coeff
+        self.wire_bits = int(wire_bits)
+        self._check_wire_bits()
 
     def init(self, params):
         return OptimizerState(
@@ -227,7 +319,8 @@ class OneBitLamb(OneBitOptimizer):
             moments={"m": _tmap(jnp.zeros_like, params),
                      "v": _tmap(jnp.zeros_like, params),
                      "ratio": _tmap(lambda p: jnp.ones((), p.dtype), params),
-                     "e": self._error_init(params)})
+                     "e": self._error_init(params),
+                     "e2": self._server_error_init(params)})
 
     def warmup_step_local(self, params, grads, state, lr):
         b1, b2 = self.betas
@@ -235,7 +328,7 @@ class OneBitLamb(OneBitOptimizer):
         tf = t.astype(jnp.float32)
         c1, c2 = 1.0 - b1 ** tf, 1.0 - b2 ** tf
 
-        def upd(p, g_local, m, v, r, e):
+        def upd(p, g_local, m, v, r, e, e2):
             g = lax.pmean(g_local, AXIS)
             m2 = b1 * m + (1 - b1) * g
             v2 = b2 * v + (1 - b2) * jnp.square(g)
@@ -246,34 +339,34 @@ class OneBitLamb(OneBitOptimizer):
             trust = jnp.where(
                 u_norm > 0, jnp.where(p_norm > 0, p_norm / u_norm, 1.0), 1.0)
             trust = jnp.clip(trust, self.min_coeff, self.max_coeff)
-            return p - lr * trust * u, m2, v2, trust.astype(r.dtype), e
+            return p - lr * trust * u, m2, v2, trust.astype(r.dtype), e, e2
 
         out = _tmap(upd, params, grads, state.moments["m"],
                     state.moments["v"], state.moments["ratio"],
-                    state.moments["e"])
-        new_p, new_m, new_v, new_r, new_e = _unzip(out, 5)
+                    state.moments["e"], state.moments["e2"])
+        new_p, new_m, new_v, new_r, new_e, new_e2 = _unzip(out, 6)
         return new_p, OptimizerState(
             step=t, moments={"m": new_m, "v": new_v, "ratio": new_r,
-                             "e": new_e})
+                             "e": new_e, "e2": new_e2})
 
     def compressed_step_local(self, params, grads, state, lr):
         b1, _ = self.betas
         t = state.step + 1
         dp = self.dp_size
 
-        def upd(p, g, m, v, r, e):
+        def upd(p, g, m, v, r, e, e2):
             c = b1 * m + (1 - b1) * g + e[0]
-            m2, err = _sign_compress_psum(c, dp)
+            m2, err, e2n = self._compress(c, e2, dp)
             u = m2 / (jnp.sqrt(v) + self.eps) + self.weight_decay * p
-            return p - lr * r * u, m2, v, r, err[None]
+            return p - lr * r * u, m2, v, r, err[None], e2n[None]
 
         out = _tmap(upd, params, grads, state.moments["m"],
                     state.moments["v"], state.moments["ratio"],
-                    state.moments["e"])
-        new_p, new_m, new_v, new_r, new_e = _unzip(out, 5)
+                    state.moments["e"], state.moments["e2"])
+        new_p, new_m, new_v, new_r, new_e, new_e2 = _unzip(out, 6)
         return new_p, OptimizerState(
             step=t, moments={"m": new_m, "v": new_v, "ratio": new_r,
-                             "e": new_e})
+                             "e": new_e, "e2": new_e2})
 
 
 ONEBIT_OPTIMIZERS = {

@@ -307,12 +307,13 @@ class ZeroInfinityEngine:
         a multi-process one (per-host paging of per-host shards)."""
         if self.mesh is None or self._shard_axis[leaf_key] is None:
             return [None]
-        fa = list(self.mesh.axis_names).index("fsdp")
-        sis = set()
-        for d in self.mesh.local_devices:
-            coord = np.argwhere(self.mesh.devices == d)[0]
-            sis.add(int(coord[fa]))
-        return sorted(sis)
+        if not hasattr(self, "_local_sis"):
+            # invariant for the engine's lifetime — computed once
+            fa = list(self.mesh.axis_names).index("fsdp")
+            self._local_sis = sorted(
+                {int(np.argwhere(self.mesh.devices == d)[0][fa])
+                 for d in self.mesh.local_devices})
+        return self._local_sis
 
     def _key(self, k: str, gi: int, si) -> str:
         base = f"layers.{k}.g{gi}"

@@ -64,4 +64,6 @@ def test_engine_comms_report_zero3(devices8):
                 "mesh": {"data": -1, "fsdp": 1},
                 "steps_per_print": 10**9})
     rep1 = onebit.comms_report(print_log=False)
-    assert "s8" in rep1.get("all-reduce", {}).get("dtypes", set()), rep1
+    # packed two-phase wire: sign bits ride u8 all-to-all + all-gather
+    assert "u8" in rep1.get("all-to-all", {}).get("dtypes", set()), rep1
+    assert "u8" in rep1.get("all-gather", {}).get("dtypes", set()), rep1
