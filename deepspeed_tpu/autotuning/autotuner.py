@@ -58,6 +58,57 @@ class Autotuner:
         return {"num_params": n_params,
                 "activation_bytes_per_token": 4 * act_per_token}
 
+    # ---------------------------------------------------------- memory model
+    def _mem_budget_bytes(self) -> Optional[int]:
+        """Per-device HBM budget: explicit ``autotuning.max_device_memory_gb``
+        beats runtime introspection beats device-kind defaults. None (e.g.
+        CPU test meshes with no configured budget) disables pruning."""
+        gb = self.at_cfg.get("max_device_memory_gb")
+        if gb:
+            return int(float(gb) * 1e9)
+        dev = jax.devices()[0]
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+        if stats.get("bytes_limit"):
+            return int(stats["bytes_limit"] * 0.95)
+        kind = getattr(dev, "device_kind", "").lower()
+        # order matters: v6e reports "TPU v6 lite" (32G) — match the
+        # generation before the generic "lite" (v5e, 16G) catch-all
+        for key, hbm in (("v6", 32e9), ("v5p", 95e9), ("v4", 32e9),
+                         ("lite", 16e9), ("v5e", 16e9), ("v5", 95e9)):
+            if key in kind:
+                return int(hbm)
+        return None
+
+    def _mem_estimate_bytes(self, stage: int, micro: int,
+                            mesh: Dict[str, int]) -> int:
+        """Analytic per-device bytes for a candidate — the reference's
+        memory-model pruning (autotuner.py:663 model_info_profile_run →
+        max-micro-batch estimation) re-derived for the mesh/ZeRO design:
+        fp32 masters (+bf16 compute copy) sharded by stage, Adam moments,
+        grads, activations, and the logits buffer."""
+        info = self.model_info_profile_run()
+        P = info["num_params"]
+        n = len(jax.devices())
+        fsdp = mesh.get("fsdp", 1)
+        fsdp = n if fsdp == -1 else max(1, fsdp)
+        data = mesh.get("data", 1)
+        data = max(1, n // fsdp) if data == -1 else max(1, data)
+        dp = data * fsdp
+        param_shard = fsdp if stage >= 3 else 1
+        grad_shard = dp if stage >= 2 else 1
+        opt_shard = dp if stage >= 1 else 1
+        bf16 = bool(self.base.get("bf16", {}).get("enabled"))
+        param_b = P * 4 // param_shard + (P * 2 // param_shard if bf16 else 0)
+        grad_b = P * 4 // grad_shard
+        opt_b = P * 8 // opt_shard
+        act_b = micro * self.seq_len * info["activation_bytes_per_token"]
+        vocab = getattr(getattr(self.model, "cfg", None), "vocab_size", 0)
+        logits_b = micro * self.seq_len * vocab * 4
+        return int(1.1 * (param_b + grad_b + opt_b + act_b + logits_b))
+
     # ------------------------------------------------------------ candidates
     def _mesh_candidates(self) -> List[Dict[str, int]]:
         n = len(jax.devices())
@@ -141,6 +192,34 @@ class Autotuner:
         trials = list(itertools.product(self._stage_candidates(),
                                         self._micro_batch_candidates(),
                                         self._mesh_candidates()))
+        # Memory-model pre-filter (reference autotuner.py:663): candidates
+        # whose analytic footprint exceeds the device budget are recorded
+        # as pruned WITHOUT paying their XLA compile — at 70B scale one
+        # compile is minutes, so this is the difference between a grid
+        # sweep and a usable tuner.
+        budget = self._mem_budget_bytes()
+        if budget:
+            estimates = [(t, self._mem_estimate_bytes(*t)) for t in trials]
+            kept = [t for t, est in estimates if est <= budget]
+            pruned = [(t, est) for t, est in estimates if est > budget]
+            if not kept:
+                # nothing fits the model's budget — run the analytically
+                # smallest candidate anyway so the tuner returns something
+                smallest = min(pruned, key=lambda te: te[1])
+                pruned.remove(smallest)
+                kept = [smallest[0]]
+                logger.warning(
+                    "autotune: every candidate exceeds the memory budget; "
+                    "timing the smallest-footprint one anyway")
+            for (stage, micro, mesh), est in pruned:
+                self.results.append({
+                    "zero_stage": stage, "micro_batch": micro,
+                    "mesh": mesh, "status": "pruned_memory",
+                    "est_bytes": est, "budget_bytes": budget,
+                    "tokens_per_sec": 0.0})
+            logger.info(f"autotune: memory model pruned "
+                        f"{len(pruned)}/{len(trials)} candidates")
+            trials = kept
         max_trials = max_trials or int(self.at_cfg.get("tuner_num_trials", 50))
         early_stop = int(self.at_cfg.get("tuner_early_stopping", 5))
         best_metric, since_best = float("-inf"), 0

@@ -12,11 +12,20 @@ instead of `launch.py`'s N-rank fork + RANK/LOCAL_RANK bookkeeping.
 
 Modes:
 - single host: exec the script directly (world_size 1).
+- ``--launcher local --num_local_procs N``: N rank processes on this host
+  (reference launch.py's per-node fork), babysat as a group.
 - ``--hostfile``: ssh/pdsh to each host, set env, run the same command
   (reference MultiNodeRunner, multinode_runner.py:18,51).
 - under SLURM (``SLURM_PROCID`` set) or GKE/TPU-pod env
   (``TPU_WORKER_ID``/``MEGASCALE_SLICE_ID``): derive rank/world/coordinator
   from the environment and exec in-place.
+
+Process lifecycle (round 4 — reference launch.py:118,132): children spawn
+in their own sessions, a babysitter kills every survivor's process tree the
+moment any rank fails (no more hung jobs at a dead rank's collective), and
+``--max_restarts N`` wraps the whole job in a restart supervisor — scripts
+reload their latest (universal) checkpoint and re-derive the elastic batch
+when they come back up.
 """
 
 from __future__ import annotations
@@ -108,6 +117,92 @@ def build_cmd(args, rank: int, world: int, coord: str) -> List[str]:
     return cmd
 
 
+# -------------------------------------------------- child monitoring / restart
+
+def terminate_process_tree(proc: subprocess.Popen, timeout: float = 5.0):
+    """SIGTERM the child's whole process group (children spawn with
+    ``start_new_session=True`` so the group id == the child pid), escalate
+    to SIGKILL after ``timeout`` (reference launcher/launch.py:118
+    ``terminate_process_tree``)."""
+    import signal
+
+    if proc.poll() is not None:
+        return
+    try:
+        os.killpg(proc.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        proc.terminate()
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            proc.kill()
+        proc.wait()
+
+
+def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3) -> int:
+    """Monitor children until all exit; on the FIRST failure, kill every
+    survivor's process tree so a dead rank can't leave the job hung at a
+    collective (reference launcher/launch.py:132 monitoring loop — the
+    r3 'spawn and forget' gap). Returns the job's exit code."""
+    import time
+
+    alive = list(procs)
+    try:
+        while alive:
+            for p in list(alive):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                alive.remove(p)
+                if rc != 0:
+                    logger.error(
+                        f"rank process {p.pid} exited rc={rc}; terminating "
+                        f"{len(alive)} surviving rank(s)")
+                    for q in alive:
+                        terminate_process_tree(q)
+                    return rc
+            time.sleep(poll_interval)
+        return 0
+    except (KeyboardInterrupt, SystemExit):
+        # children run in their own sessions and never see the terminal's
+        # SIGINT — bring every tree down before propagating
+        for q in alive:
+            terminate_process_tree(q)
+        raise
+
+
+def supervise(spawn_fn, max_restarts: int = 0,
+              between_attempts=None) -> int:
+    """Restart supervisor (reference elasticity/elastic_agent.py:28, TPU
+    restart-based flavor): spawn + babysit; on failure relaunch the whole
+    job up to ``max_restarts`` times. Training scripts are expected to
+    resume from their latest (universal) checkpoint and re-derive the
+    elastic batch on re-entry — the supervisor only owns the process
+    lifecycle. ``between_attempts`` runs before each relaunch (remote-rank
+    cleanup for the ssh/pdsh paths)."""
+    attempt = 0
+    while True:
+        rc = babysit(spawn_fn())
+        if rc == 0:
+            return 0
+        attempt += 1
+        if attempt > max_restarts:
+            if max_restarts:
+                logger.error(f"job failed rc={rc} after {max_restarts} "
+                             "restart(s); giving up")
+            return rc
+        logger.warning(f"job failed rc={rc}; restarting "
+                       f"({attempt}/{max_restarts})")
+        if between_attempts is not None:
+            try:
+                between_attempts()
+            except Exception as e:
+                logger.warning(f"pre-restart cleanup failed: {e}")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="deepspeed_tpu launcher (TPU-pod aware; 1 process/host)")
@@ -119,6 +214,12 @@ def main(argv=None):
     parser.add_argument("--ssh_port", type=int, default=22)
     parser.add_argument("--launcher", type=str, default="ssh",
                         choices=["ssh", "pdsh", "local"])
+    parser.add_argument("--num_local_procs", type=int, default=1,
+                        help="rank count for --launcher local")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="restart the whole job up to N times after a "
+                             "failure (restart supervisor; scripts resume "
+                             "from their latest checkpoint)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--autotune", "--autotuning", type=str, default=None,
                         metavar="MODEL:CONFIG.json",
@@ -172,6 +273,33 @@ def main(argv=None):
         env.setdefault("WORLD_SIZE", str(world))
         os.execvpe(sys.executable, build_cmd(args, rank, world, coord), env)
 
+    if args.launcher == "local":
+        # N local rank processes on this host (single-host multi-process
+        # jobs and the supervisor's testbed; each rank sees a slice of the
+        # local devices via its own env)
+        world = args.num_local_procs
+        coord = f"127.0.0.1:{args.master_port}"
+
+        def spawn_local():
+            procs = []
+            for rank in range(world):
+                # device partitioning is the script's job (TPU chip
+                # ownership is per-PJRT-client: set TPU_VISIBLE_CHIPS /
+                # XLA_FLAGS from LOCAL_RANK in the script or its wrapper)
+                env = dict(os.environ,
+                           MASTER_ADDR="127.0.0.1",
+                           MASTER_PORT=str(args.master_port),
+                           COORDINATOR_ADDRESS=coord,
+                           RANK=str(rank), LOCAL_RANK=str(rank),
+                           WORLD_SIZE=str(world))
+                logger.info(f"launching local rank {rank}")
+                procs.append(subprocess.Popen(
+                    build_cmd(args, rank, world, coord), env=env,
+                    start_new_session=True))
+            return procs
+
+        sys.exit(supervise(spawn_local, args.max_restarts))
+
     hosts = fetch_hostfile(args.hostfile)
     hosts = parse_resource_filter(hosts, args.include, args.exclude)
 
@@ -186,28 +314,39 @@ def main(argv=None):
     coord_host = args.master_addr or host_list[0]
     coord = f"{coord_host}:{args.master_port}"
     world = len(host_list)
-    procs = []
-    for rank, host in enumerate(host_list):
-        envs = (f"COORDINATOR_ADDRESS={shlex.quote(coord)} RANK={rank} "
-                f"WORLD_SIZE={world}")
-        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {envs} " + " ".join(
-            shlex.quote(c) for c in build_cmd(args, rank, world, coord))
-        if args.launcher == "pdsh":
-            cmd = ["pdsh", "-w", host, remote_cmd]
-        else:
-            cmd = ["ssh", "-p", str(args.ssh_port), host, remote_cmd]
-        logger.info(f"launching rank {rank} on {host}")
-        procs.append(subprocess.Popen(cmd))
 
-    rc = 0
-    try:
-        for p in procs:
-            rc |= p.wait()
-    except KeyboardInterrupt:
-        for p in procs:
-            p.terminate()
-        raise
-    sys.exit(rc)
+    def spawn_remote():
+        procs = []
+        for rank, host in enumerate(host_list):
+            envs = (f"COORDINATOR_ADDRESS={shlex.quote(coord)} RANK={rank} "
+                    f"WORLD_SIZE={world}")
+            remote_cmd = f"cd {shlex.quote(os.getcwd())} && {envs} " \
+                + " ".join(shlex.quote(c)
+                           for c in build_cmd(args, rank, world, coord))
+            if args.launcher == "pdsh":
+                cmd = ["pdsh", "-w", host, remote_cmd]
+            else:
+                cmd = ["ssh", "-p", str(args.ssh_port), host, remote_cmd]
+            logger.info(f"launching rank {rank} on {host}")
+            # start_new_session so a failed job's ssh/pdsh trees die as a
+            # group (babysit kills the group on first failure)
+            procs.append(subprocess.Popen(cmd, start_new_session=True))
+        return procs
+
+    def kill_remote_ranks():
+        """Best-effort remote cleanup before a respawn: killing the local
+        ssh/pdsh client does not reliably HUP the remote command (pdsh in
+        particular), so ask each host to pkill the user script (reference
+        multinode runner's remote-kill; pattern-scoped to this script)."""
+        pattern = shlex.quote(args.user_script)
+        for host in host_list:
+            kill_cmd = (["pdsh", "-w", host] if args.launcher == "pdsh"
+                        else ["ssh", "-p", str(args.ssh_port), host])
+            subprocess.run(kill_cmd + [f"pkill -f {pattern} || true"],
+                           timeout=30, capture_output=True)
+
+    sys.exit(supervise(spawn_remote, args.max_restarts,
+                       between_attempts=kill_remote_ranks))
 
 
 if __name__ == "__main__":
