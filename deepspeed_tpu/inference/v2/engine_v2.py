@@ -39,7 +39,7 @@ class RaggedInferenceEngineConfig:
 class InferenceEngineV2:
     def __init__(self, model: Optional[CausalLM] = None, params=None,
                  config: Optional[RaggedInferenceEngineConfig] = None,
-                 checkpoint_path: Optional[str] = None):
+                 checkpoint_path: Optional[str] = None, mesh=None):
         self.config = config or RaggedInferenceEngineConfig()
         if params is None and checkpoint_path is not None:
             # pretrained weights (reference engine_v2 builds its model from a
@@ -53,15 +53,41 @@ class InferenceEngineV2:
         self.model = model
         if params is None:
             params = model.init(jax.random.PRNGKey(0))
+
+        # TP serving over a mesh with a tensor axis (reference
+        # inference/v2/model_implementations/sharding/qkv.py:166): params
+        # placed by the logical-axis TP rules, KV pool sharded over the
+        # kv-head dim, attention shard_mapped inside PagedCausalLM.
+        cache_sharding = None
+        jmesh = None
+        if mesh is not None:
+            from ...parallel import topology as topo_mod
+            from ...parallel.sharding import ZeroShardingPlan
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            topo_obj = (mesh if isinstance(mesh, topo_mod.MeshTopology)
+                        else topo_mod.MeshTopology(mesh))
+            jmesh = topo_obj.mesh
+            # raw meshes may lack a tensor axis entirely → unsharded serving
+            if dict(jmesh.shape).get("tensor", 1) > 1:
+                spec_tree = (model.param_specs()
+                             if hasattr(model, "param_specs") else None)
+                plan = ZeroShardingPlan(topo_obj, 0, spec_tree)
+                shardings = plan.params(jax.eval_shape(lambda: params))
+                params = jax.tree.map(jax.device_put, params, shardings)
+                cache_sharding = NamedSharding(
+                    jmesh, P(None, None, "tensor", None, None))
+            else:
+                jmesh = None
         self.params = params
 
         cfg = model.cfg
         max_blocks_per_seq = -(-cfg.max_seq_len // self.config.kv_block_size)
         self.state_manager = DSStateManager(
             cfg, self.config.max_tracked_sequences, self.config.kv_blocks,
-            self.config.kv_block_size)
+            self.config.kv_block_size, sharding=cache_sharding)
         self.paged = PagedCausalLM(model, self.config.kv_block_size,
-                                   max_blocks_per_seq)
+                                   max_blocks_per_seq, mesh=jmesh)
         self.batch = RaggedBatchWrapper(self.config.max_ragged_sequence_count,
                                         self.config.max_chunk_tokens,
                                         max_blocks_per_seq)

@@ -28,19 +28,75 @@ from jax import lax
 
 from ...models.transformer import (CausalLM, _linear, _norm, alibi_slopes,
                                    rope_table)
-from ...ops.paged_attention import paged_attention
 
 
 class PagedCausalLM:
-    """Wraps a CausalLM's weights with a paged ragged forward."""
+    """Wraps a CausalLM's weights with a paged ragged forward.
+
+    ``mesh``: optional ``jax.sharding.Mesh`` with a ``tensor`` axis — TP
+    serving (reference inference/v2/model_implementations/sharding/
+    qkv.py:166 head split). Projections/norms partition via GSPMD from the
+    param shardings; the Pallas paged-attention kernel — which GSPMD cannot
+    partition — runs inside ``shard_map`` over the tensor axis on each
+    device's local heads (attention is embarrassingly parallel over heads).
+    """
 
     def __init__(self, model: CausalLM, block_size: int,
-                 max_blocks_per_seq: int):
+                 max_blocks_per_seq: int, mesh=None,
+                 attn_impl: str = None):
         self.model = model
         self.cfg = model.cfg
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.mesh = mesh
+        self.tp = int(mesh.shape["tensor"]) if mesh is not None else 1
+        if self.tp > 1:
+            if self.cfg.kv_heads % self.tp or self.cfg.num_heads % self.tp:
+                raise ValueError(
+                    f"TP serving needs heads ({self.cfg.num_heads}) and "
+                    f"kv_heads ({self.cfg.kv_heads}) divisible by the "
+                    f"tensor axis ({self.tp})")
+        # attention implementation via the module registry heuristics
+        # (modules.py; reference heuristics.py:179) — overridable by name
+        from .modules import instantiate_attn
+
+        self._attn_raw = instantiate_attn(self.cfg, name=attn_impl)
         self.forward = jax.jit(self._forward)
+
+    def _attend(self, q, kc, vc, block_tables, start_pos, n_tokens, slopes):
+        """Paged attention, shard_mapped over the tensor axis when TP>1."""
+        window = self.cfg.sliding_window or 0
+        if self.tp == 1:
+            return self._attn_raw(q, kc, vc, block_tables, start_pos,
+                                  n_tokens, alibi_slopes=slopes,
+                                  window=window)
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        q_spec = P(None, None, "tensor", None)        # [N, C, H, D]
+        kv_spec = P(None, "tensor", None, None)       # [NB, KH, bs, D]
+        rep = P()
+        s_spec = rep if slopes is None else P("tensor")
+
+        attn = self._attn_raw
+
+        def local(q, kc, vc, tbl, sp, nt, sl):
+            return attn(q, kc, vc, tbl, sp, nt, alibi_slopes=sl,
+                        window=window)
+
+        if slopes is None:
+            local_fn = lambda q, kc, vc, tbl, sp, nt: (  # noqa: E731
+                attn(q, kc, vc, tbl, sp, nt, window=window))
+            return shard_map(
+                local_fn, mesh=self.mesh,
+                in_specs=(q_spec, kv_spec, kv_spec, rep, rep, rep),
+                out_specs=q_spec, check_vma=False)(
+                    q, kc, vc, block_tables, start_pos, n_tokens)
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, rep, rep, rep, s_spec),
+            out_specs=q_spec, check_vma=False)(
+                q, kc, vc, block_tables, start_pos, n_tokens, slopes)
 
     # ------------------------------------------------------------------
     def _forward(self, params, kv_cache, tokens, start_pos, n_tokens,
@@ -126,10 +182,10 @@ class PagedCausalLM:
                 v.reshape(-1, kvh, hd), mode="drop")
 
             # paged read: Pallas block-table walk (reference blocked_flash;
-            # Mistral sliding window clamps the walk to the last W positions)
-            attn = paged_attention(q, kc, vc, block_tables, start_pos,
-                                   n_tokens, alibi_slopes=slopes,
-                                   window=cfg.sliding_window or 0)
+            # Mistral sliding window clamps the walk to the last W
+            # positions; TP shard_maps the walk over the tensor axis)
+            attn = self._attend(q, kc, vc, block_tables, start_pos,
+                                n_tokens, slopes)
             attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
                                lp.get("wo_b"), dt)
             x = self.model._attn_mlp_merge(x, attn_out, lp)

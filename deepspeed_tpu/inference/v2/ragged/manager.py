@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 
 from .blocked_allocator import BlockedAllocator
@@ -36,7 +37,7 @@ class DSStateManager:
 
     def __init__(self, model_cfg, max_tracked_sequences: int = 256,
                  num_blocks: int = 256, block_size: int = 16,
-                 dtype=None):
+                 dtype=None, sharding=None):
         self.cfg = model_cfg
         self.block_size = block_size
         self.num_blocks = num_blocks
@@ -47,9 +48,18 @@ class DSStateManager:
         # [L, NB, KH, bs, D]: the per-(block, kv-head) slab is the trailing
         # [bs, D] — one tileable VMEM block, DMA'd directly by the Pallas
         # paged-attention index maps (ops/paged_attention.py).
+        # ``sharding``: optional NamedSharding placing KH over the tensor
+        # axis (TP serving — reference v2 sharding/qkv.py:166 head split).
         shape = (model_cfg.num_layers, num_blocks, model_cfg.kv_heads,
                  block_size, model_cfg.head_dim)
-        self.kv_cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        if sharding is None:
+            zeros = jnp.zeros(shape, dt)
+        else:
+            # allocate each device's shard directly — a full pool on one
+            # device before resharding could OOM exactly when TP matters
+            zeros = jax.jit(lambda: jnp.zeros(shape, dt),
+                            out_shardings=sharding)()
+        self.kv_cache = {"k": zeros, "v": zeros}
 
     # -- sequence registry -------------------------------------------------
     def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:

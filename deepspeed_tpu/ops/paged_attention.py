@@ -246,11 +246,20 @@ def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
 
 # ------------------------------------------------------------------- public
 
+def pallas_supported(num_heads: int, kv_heads: int, head_dim: int,
+                     force_interpret: bool = False) -> bool:
+    """Static eligibility of the Pallas kernel for a head geometry — the
+    single source of truth shared by the runtime dispatch below and the
+    v2 module registry's heuristics (inference/v2/modules.py)."""
+    return (_HAS_PALLAS and kv_heads > 0 and num_heads % kv_heads == 0
+            and head_dim % 8 == 0
+            and (_on_tpu() or force_interpret or _FORCE_INTERPRET))
+
+
 def _pallas_ok(q, k_pool) -> bool:
     N, C, H, D = q.shape
     KH = k_pool.shape[1]
-    return (_HAS_PALLAS and H % KH == 0 and D % 8 == 0
-            and (_on_tpu() or _FORCE_INTERPRET))
+    return pallas_supported(H, KH, D)
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
