@@ -10,11 +10,34 @@ reported as the fraction of that target achieved on this config.
 """
 
 import json
+import os
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+# The axon TPU tunnel is known to wedge: jax backend discovery (or a later
+# device sync) blocks forever (observed >20 min) instead of erroring. A
+# whole-run watchdog converts that hang into a clean rc=1 JSON line so the
+# driver's bench step can't stall the round. BENCH_TIMEOUT_S=0 disables.
+_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
+_bench_done = threading.Event()
+
+
+def _watchdog():
+    if not _bench_done.wait(_TIMEOUT_S):
+        print(json.dumps({"metric": "train_mfu", "value": 0.0,
+                          "unit": "fraction_of_peak", "vs_baseline": 0.0,
+                          "detail": {"error": "bench timed out after "
+                                     f"{_TIMEOUT_S}s (wedged TPU "
+                                     "tunnel?)"}}), flush=True)
+        os._exit(1)
+
+
+if _TIMEOUT_S > 0:
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 # Peak dense matmul FLOPs/s per chip (bf16), by TPU generation.
 PEAK_FLOPS = {
@@ -200,3 +223,4 @@ def main():
 
 if __name__ == "__main__":
     main()
+    _bench_done.set()
