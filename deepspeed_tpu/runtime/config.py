@@ -309,6 +309,10 @@ class DeepSpeedTpuConfig(DSConfigModel):
     gradient_predivide_factor: float = 1.0
     sparse_gradients: bool = False
     gradient_clipping: float = 0.0
+    # numeric sanitizer (reference runtime/utils.py CheckOverflow): raise
+    # with offending leaf paths on non-finite loss/grad-norm (debug mode —
+    # forces a host sync per micro step)
+    check_numerics: bool = False
     communication_data_type: Optional[str] = None
     seq_parallel_communication_data_type: str = "fp32"
     disable_allgather: bool = False

@@ -852,7 +852,30 @@ class DeepSpeedTpuEngine:
             self._autotune_layouts(batch, step_rng)
         self.state, loss = self._micro_fn(self.state, batch, step_rng)
         self._pending_loss = loss
+        if self.config.check_numerics and not self.fp16_enabled \
+                and not np.isfinite(float(loss)):
+            # numeric sanitizer (reference runtime/utils.py CheckOverflow /
+            # loss_scaler._has_inf_or_nan): name the poisoned leaves rather
+            # than letting NaNs propagate silently. Debug mode — the float()
+            # forces a device sync per micro step.
+            raise FloatingPointError(
+                f"check_numerics: non-finite loss {float(loss)} at micro "
+                f"step {self.micro_steps}; offending state leaves: "
+                f"{self._numerics_scan()}")
         return loss
+
+    def _numerics_scan(self):
+        """Per-leaf finiteness scan of params + accumulated grads; returns
+        the pytree paths of non-finite leaves (reference fp16
+        loss_scaler.py _has_inf_or_nan per-tensor scan, as one jitted
+        tree-map instead of a host loop)."""
+        tree = {"params": self.state.params, "grad_acc": self.state.grad_acc}
+        flags = jax.jit(lambda t: jax.tree.map(
+            lambda x: jnp.all(jnp.isfinite(x.astype(jnp.float32))), t))(tree)
+        return sorted(
+            jax.tree_util.keystr(kp)
+            for kp, ok in jax.tree_util.tree_flatten_with_path(flags)[0]
+            if not bool(ok))
 
     @staticmethod
     def _is_device_batch(batch):
@@ -872,6 +895,12 @@ class DeepSpeedTpuEngine:
         """Reference engine.py:2096: optimizer step at accumulation boundary."""
         if not self.is_gradient_accumulation_boundary():
             return
+        # sanitizer scan must run BEFORE the update: the jitted update
+        # zeroes grad_acc and overflow-gates the param write, so post-hoc
+        # state would name nothing
+        pre_scan = (self._numerics_scan()
+                    if self.config.check_numerics and not self.fp16_enabled
+                    else None)
         if self._offload_plan is not None:
             metrics = self._offload_step()
         elif self._onebit and self.global_steps < self.opt.freeze_step:
@@ -880,6 +909,14 @@ class DeepSpeedTpuEngine:
             self.state, metrics = self._update_warm_fn(self.state)
         else:
             self.state, metrics = self._update_fn(self.state)
+        if pre_scan is not None \
+                and not np.isfinite(float(metrics.get("grad_norm", 0.0))):
+            # under fp16 the dynamic-loss-scale automaton owns overflow
+            # (skip + rescale); everywhere else a non-finite grad norm is a
+            # real numeric fault — fail loudly with the leaf names
+            raise FloatingPointError(
+                f"check_numerics: non-finite grad norm at step "
+                f"{self.global_steps}; offending state leaves: {pre_scan}")
         self.global_steps += 1
         self.lr_scheduler.step()
         self._last_metrics = metrics

@@ -481,6 +481,29 @@ class ZeroInfinityEngine:
     def get_lr(self):
         return [self.lr]
 
+    def streaming_report(self) -> Dict[str, Any]:
+        """Quantify the streaming-vs-resident trade (r3 weak #3): paging
+        volume, measured I/O counters, and the recompute factor the
+        grouped-vjp backward pays (each group's forward runs twice — the
+        activation-checkpointing 4/3-step-FLOPs factor, reference
+        partitioned_param_coordinator prefetch trades the same way)."""
+        steps = max(self.global_steps, 1)
+        return {
+            "param_bytes": self.param_bytes,
+            "groups": len(self.groups),
+            "fsdp": self.fsdp,
+            "data": self.dp,
+            "store_device": self.store.device,
+            "bytes_read_total": self.store.bytes_read,
+            "bytes_read_per_step": self.store.bytes_read // steps,
+            # fwd params once + bwd params again + both moments ≈ 4x
+            "expected_bytes_per_step": 4 * self.param_bytes,
+            "reads_per_step": self.store.reads // steps,
+            # grouped-vjp backward recomputes each group's forward: step
+            # FLOPs are ~8ND vs the resident engine's 6ND
+            "recompute_flops_factor": 8 / 6,
+        }
+
     def close(self):
         self._prefetch.shutdown(wait=True)
         self.store.close()

@@ -153,3 +153,55 @@ def test_zero3_param_sharding():
     from deepspeed_tpu.parallel.topology import FSDP_AXIS
 
     assert FSDP_AXIS in str(wte.sharding.spec), wte.sharding
+
+
+def test_check_numerics_names_poisoned_leaves(devices8):
+    """The numeric sanitizer (reference runtime/utils.py CheckOverflow /
+    loss_scaler._has_inf_or_nan) must fail loudly with the offending leaf
+    paths instead of letting NaNs propagate."""
+    import jax
+
+    import deepspeed_tpu.parallel.topology as topo
+
+    topo.reset_topology()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=build_model("tiny"),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "check_numerics": True,
+                "mesh": {"data": -1, "fsdp": 1},
+                "steps_per_print": 10**9})
+    rng = np.random.default_rng(0)
+    dp = engine.topology.get_data_parallel_world_size()
+    data = {"input_ids": rng.integers(0, 256, size=(2 * dp, 33),
+                                      dtype=np.int64)}
+    # clean step passes
+    loss = engine(dict(data))
+    engine.backward(loss)
+    engine.step()
+
+    # poison one param leaf -> the next micro step must raise and name it.
+    # The jitted step donates its input buffers, so snapshot with copies.
+    import jax.numpy as jnp
+
+    clean = jax.tree_util.tree_map(jnp.copy, engine.state.params)
+    poisoned = jax.tree_util.tree_map(jnp.copy, clean)
+    poisoned["final_norm"]["w"] = poisoned["final_norm"]["w"] * jnp.nan
+    engine.state = engine.state._replace(params=poisoned)
+    with pytest.raises(FloatingPointError) as e:
+        engine(dict(data))
+    assert "final_norm" in str(e.value)
+
+    # step-path: poisoned accumulated grads must be named too (the scan
+    # runs BEFORE the update zeroes grad_acc / skips the param write)
+    engine.state = engine.state._replace(params=clean)
+    loss = engine(dict(data))
+    engine.backward(loss)
+    acc = jax.tree_util.tree_map(jnp.copy, engine.state.grad_acc)
+    acc["embed"]["wte"] = acc["embed"]["wte"] * jnp.nan
+    engine.state = engine.state._replace(grad_acc=acc)
+    with pytest.raises(FloatingPointError) as e:
+        engine.step()
+    assert "grad_acc" in str(e.value) and "wte" in str(e.value)
+    topo.reset_topology()
