@@ -404,14 +404,9 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
     if cfg.attention_impl == "ring":
         from ..sequence.ring_attention import ring_attention
 
-        if cfg.sliding_window:
-            raise NotImplementedError(
-                "sliding_window does not compose with ring attention yet: "
-                "the ring pass carries no window clamp; use the Ulysses "
-                "path (attention_impl='flash') for windowed models under "
-                "sequence parallelism")
         fn = shard_map(_partial(ring_attention, causal=causal,
-                                axis_name=topo.SEQUENCE_AXIS),
+                                axis_name=topo.SEQUENCE_AXIS,
+                                window=cfg.sliding_window or 0),
                        mesh=t.mesh, in_specs=(spec_, spec_, spec_),
                        out_specs=spec_, check_vma=False)
         return fn(q, k, v)

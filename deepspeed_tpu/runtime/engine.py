@@ -868,10 +868,13 @@ class DeepSpeedTpuEngine:
         """Per-leaf finiteness scan of params + accumulated grads; returns
         the pytree paths of non-finite leaves (reference fp16
         loss_scaler.py _has_inf_or_nan per-tensor scan, as one jitted
-        tree-map instead of a host loop)."""
+        tree-map instead of a host loop). The jitted scanner is cached —
+        a fresh jit per call would retrace every step."""
+        if not hasattr(self, "_numerics_scan_fn"):
+            self._numerics_scan_fn = jax.jit(lambda t: jax.tree.map(
+                lambda x: jnp.all(jnp.isfinite(x.astype(jnp.float32))), t))
         tree = {"params": self.state.params, "grad_acc": self.state.grad_acc}
-        flags = jax.jit(lambda t: jax.tree.map(
-            lambda x: jnp.all(jnp.isfinite(x.astype(jnp.float32))), t))(tree)
+        flags = jax.device_get(self._numerics_scan_fn(tree))
         return sorted(
             jax.tree_util.keystr(kp)
             for kp, ok in jax.tree_util.tree_flatten_with_path(flags)[0]

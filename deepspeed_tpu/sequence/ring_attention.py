@@ -29,11 +29,13 @@ from ..parallel import topology as topo
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, q_off, k_off, causal: bool):
+def _block_attn(q, k, v, q_off, k_off, causal: bool, window: int = 0):
     """Partial attention of local q against one kv block, returning
     (unnormalized out, row max m, row sum l) for online-softmax merging.
 
     q [B, Tq, H, D], k/v [B, Tk, KH, D]; offsets are global positions.
+    ``window`` > 0: sliding-window band by global position (Mistral
+    semantics — query p attends keys in (p − window, p]).
     """
     B, Tq, H, D = q.shape
     KH = k.shape[2]
@@ -41,10 +43,13 @@ def _block_attn(q, k, v, q_off, k_off, causal: bool):
         k = jnp.repeat(k, H // KH, axis=2)
         v = jnp.repeat(v, H // KH, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / math.sqrt(D)
-    if causal:
+    if causal or window:
         rows = q_off + jnp.arange(Tq)[:, None]
         cols = k_off + jnp.arange(k.shape[1])[None, :]
-        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+        keep = rows >= cols if causal else (rows == rows)
+        if window:
+            keep = keep & (rows - cols < window)
+        s = jnp.where(keep[None, None], s, NEG_INF)
     m = jnp.max(s, axis=-1)                                   # [B,H,Tq]
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)                                   # [B,H,Tq]
@@ -53,11 +58,15 @@ def _block_attn(q, k, v, q_off, k_off, causal: bool):
 
 
 def ring_attention(q, k, v, causal: bool = True,
-                   axis_name: str = topo.SEQUENCE_AXIS):
+                   axis_name: str = topo.SEQUENCE_AXIS, window: int = 0):
     """Blockwise ring attention inside shard_map.
 
     q/k/v: local sequence shards [B, T_loc, H|KH, D]. Returns [B, T_loc, H, D].
+    ``window``: sliding-window attention by global position (long-context
+    Mistral training under context parallelism).
     """
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     P = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
@@ -69,7 +78,7 @@ def ring_attention(q, k, v, causal: bool = True,
         src = (my - s) % P                      # whose KV block we hold now
         out, m, l = _block_attn(q, k_cur, v_cur,
                                 q_off=my * Tq, k_off=src * T_loc,
-                                causal=causal)
+                                causal=causal, window=window)
         # online softmax merge
         m_new = jnp.maximum(m_acc, m)
         a_old = jnp.exp(m_acc - m_new)
@@ -102,7 +111,7 @@ def ring_attention(q, k, v, causal: bool = True,
 
 def ring_attention_sharded(q, k, v, causal: bool = True,
                            axis_name: str = topo.SEQUENCE_AXIS,
-                           batch_axes=None):
+                           batch_axes=None, window: int = 0):
     """Host-callable wrapper: shard_map ring_attention over the current mesh
     (q/k/v global [B, T, H, D], sequence-sharded on dim 1). ``batch_axes``
     (e.g. the engine's data axes) additionally split the batch dim; default
@@ -112,7 +121,8 @@ def ring_attention_sharded(q, k, v, causal: bool = True,
 
     mesh = topo.get_topology().mesh
     spec = P(batch_axes, axis_name, None, None)
-    fn = shard_map(partial(ring_attention, causal=causal, axis_name=axis_name),
+    fn = shard_map(partial(ring_attention, causal=causal,
+                           axis_name=axis_name, window=window),
                    mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                    check_vma=False)
     return fn(q, k, v)

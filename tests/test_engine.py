@@ -194,8 +194,13 @@ def test_check_numerics_names_poisoned_leaves(devices8):
     assert "final_norm" in str(e.value)
 
     # step-path: poisoned accumulated grads must be named too (the scan
-    # runs BEFORE the update zeroes grad_acc / skips the param write)
-    engine.state = engine.state._replace(params=clean)
+    # runs BEFORE the update zeroes grad_acc / skips the param write).
+    # Restore grad_acc too — the poisoned forward above NaN'd every leaf,
+    # which would make the leaf-isolation assertion vacuous.
+    engine.state = engine.state._replace(
+        params=clean,
+        grad_acc=jax.tree_util.tree_map(jnp.zeros_like,
+                                        engine.state.grad_acc))
     loss = engine(dict(data))
     engine.backward(loss)
     acc = jax.tree_util.tree_map(jnp.copy, engine.state.grad_acc)

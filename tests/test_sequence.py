@@ -116,3 +116,59 @@ def test_seq_parallel_matches_single_device_loss():
     topo.set_topology(t2)
     loss_sp = float(model.loss(params, batch))
     np.testing.assert_allclose(loss_sp, loss_dense, rtol=1e-4)
+
+
+@pytest.mark.parametrize("window", [5, 12])
+def test_ring_attention_sliding_window(window):
+    """Windowed ring attention (long-context Mistral under context
+    parallelism) matches the dense windowed reference, including blocks
+    wholly outside the band."""
+    t = topo.MeshTopology.build(sequence=4, data=-1)
+    topo.set_topology(t)
+    q, k, v = _qkv(T=32)
+    out = ring_attention_sharded(q, k, v, causal=True, window=window)
+    ref = attention_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_sliding_window_grads():
+    t = topo.MeshTopology.build(sequence=2, data=-1)
+    topo.set_topology(t)
+    q, k, v = _qkv(T=16)
+    g_ring = jax.grad(lambda q: jnp.sum(
+        ring_attention_sharded(q, k, v, window=6)))(q)
+    g_ref = jax.grad(lambda q: jnp.sum(
+        attention_reference(q, k, v, window=6)))(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["flash", "ring"])
+def test_windowed_model_under_sequence_parallelism(impl):
+    """A sliding-window model trained under a sequence mesh axis (Ulysses
+    or ring) reproduces the single-device loss."""
+    cfg = dataclasses.replace(TINY_TEST, num_kv_heads=4,
+                              sliding_window=8, attention_impl=impl,
+                              use_flash_attention=False)
+    model_cfg = {"train_micro_batch_size_per_gpu": 2,
+                 "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                 "zero_optimization": {"stage": 0},
+                 "steps_per_print": 10**9}
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 256, size=(8, 33),
+                                      dtype=np.int64)}
+
+    topo.reset_topology()
+    single, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(dataclasses.replace(cfg, attention_impl="reference")),
+        config=dict(model_cfg, mesh={"data": -1, "fsdp": 1}))
+    loss_single = float(single(dict(data)))
+
+    topo.reset_topology()
+    sp, _, _, _ = deepspeed_tpu.initialize(
+        model=CausalLM(cfg),
+        config=dict(model_cfg, mesh={"data": 2, "sequence": 4}))
+    loss_sp = float(sp(dict(data)))
+    np.testing.assert_allclose(loss_sp, loss_single, rtol=2e-5)
+    topo.reset_topology()
