@@ -43,10 +43,10 @@ def _block_attn(q, k, v, q_off, k_off, causal: bool, window: int = 0):
         k = jnp.repeat(k, H // KH, axis=2)
         v = jnp.repeat(v, H // KH, axis=2)
     s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / math.sqrt(D)
-    if causal or window:
+    if causal:
         rows = q_off + jnp.arange(Tq)[:, None]
         cols = k_off + jnp.arange(k.shape[1])[None, :]
-        keep = rows >= cols if causal else (rows == rows)
+        keep = rows >= cols
         if window:
             keep = keep & (rows - cols < window)
         s = jnp.where(keep[None, None], s, NEG_INF)
@@ -76,9 +76,29 @@ def ring_attention(q, k, v, causal: bool = True,
     def merge(carry, s, k_cur, v_cur):
         acc, m_acc, l_acc = carry
         src = (my - s) % P                      # whose KV block we hold now
-        out, m, l = _block_attn(q, k_cur, v_cur,
-                                q_off=my * Tq, k_off=src * T_loc,
-                                causal=causal, window=window)
+        q_lo = my * Tq
+        k_lo = src * T_loc
+        # band-overlap skip: blocks entirely in the future (causal) or
+        # entirely before the sliding window contribute only NEG_INF rows —
+        # skip their QK^T at runtime (the skip is per-device: wrap-around
+        # future blocks on low ranks, pre-window blocks on high ranks).
+        # The band predicate is flash_attention's — one source of truth
+        # for the Mistral window semantics.
+        from ..ops.flash_attention import _window_live
+
+        live = jnp.asarray(_window_live(causal, window, my, src, Tq, T_loc,
+                                        0), jnp.bool_)
+
+        def dead():
+            return (jnp.zeros((B, Tq, H, D), q.dtype),
+                    jnp.full((B, H, Tq), NEG_INF, jnp.float32),
+                    jnp.zeros((B, H, Tq), jnp.float32))
+
+        out, m, l = lax.cond(
+            live,
+            lambda: _block_attn(q, k_cur, v_cur, q_off=q_lo, k_off=k_lo,
+                                causal=causal, window=window),
+            dead)
         # online softmax merge
         m_new = jnp.maximum(m_acc, m)
         a_old = jnp.exp(m_acc - m_new)
@@ -96,15 +116,23 @@ def ring_attention(q, k, v, causal: bool = True,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt) + softmax_carry, None
 
+    # causal + window: ring step s delivers the s-th predecessor block, and
+    # no query attends past window-1 positions back — the ring only needs
+    # enough steps to cover the band, not the whole sequence (the ICI/FLOP
+    # saving that makes windowed CP worthwhile at long context)
+    n_steps = P
+    if causal and window:
+        n_steps = min(P, -(-(window - 1) // T_loc) + 1) if T_loc else P
+
     acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
     m0 = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tq), jnp.float32)
-    if P > 1:
+    if n_steps > 1:
         # rotate on all but the final block (the last rotation's result
         # would be discarded — pure ICI waste at long-context scale)
         (k, v, acc0, m0, l0), _ = lax.scan(
-            step, (k, v, acc0, m0, l0), jnp.arange(P - 1))
-    acc, m, l = merge((acc0, m0, l0), P - 1, k, v)
+            step, (k, v, acc0, m0, l0), jnp.arange(n_steps - 1))
+    acc, m, l = merge((acc0, m0, l0), n_steps - 1, k, v)
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return (acc / denom).astype(q.dtype)
 

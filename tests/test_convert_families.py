@@ -267,3 +267,43 @@ def test_mistral_sliding_window_generate(tmp_path_factory):
         theirs = hf.generate(torch.tensor(prompt), max_new_tokens=8,
                              do_sample=False).numpy()
     np.testing.assert_array_equal(ours, theirs)
+
+
+def test_mistral_sliding_window_v2_serving(tmp_path_factory):
+    """The v2 ragged engine (paged kernel + sliding window) serves a
+    Mistral checkpoint with seq > window: last-token logits match the HF
+    transformers forward at every decode step."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = MistralConfig(vocab_size=120, hidden_size=32, intermediate_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=64,
+                        sliding_window=8, tie_word_embeddings=False,
+                        attn_implementation="eager")
+    torch.manual_seed(2)
+    hf = MistralForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "mistral_swa_v2")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngineV2(model, params=params,
+                               config=RaggedInferenceEngineConfig(
+                                   max_ragged_sequence_count=4,
+                                   max_chunk_tokens=32, kv_blocks=64,
+                                   kv_block_size=4))
+    rng = np.random.default_rng(5)
+    seq = rng.integers(0, 120, 20).tolist()      # 20 > window=8
+    logits = engine.put([1], [seq])
+    for step in range(5):
+        ref = _hf_logits(hf, np.asarray([seq]))[0, -1]
+        np.testing.assert_allclose(np.asarray(logits[0]), ref,
+                                   atol=4e-4, rtol=4e-4,
+                                   err_msg=f"decode step {step}")
+        if step == 4:
+            break                   # every issued put has been verified
+        nxt = int(np.argmax(ref))
+        seq.append(nxt)
+        logits = engine.put([1], [[nxt]])
