@@ -42,29 +42,44 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_init_allgather_barrier(tmp_path):
+def _run_ranks(script_text, tmp_path, marker, timeout=300, world=2):
+    """Spawn ``world`` rank subprocesses of a worker script, reap them
+    (killing on timeout so a wedged rendezvous can't leak orphans holding
+    the port), assert rc==0 and the per-rank ``marker`` line; returns the
+    marker lines by rank."""
     script = tmp_path / "worker.py"
-    script.write_text(WORKER)
+    script.write_text(script_text)
     port = _free_port()
     procs = []
-    for rank in range(2):
-        env = dict(os.environ,
-                   MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
-                   RANK=str(rank), WORLD_SIZE="2",
-                   DS_TPU_REPO=os.path.dirname(os.path.dirname(
-                       os.path.abspath(__file__))))
-        env.pop("XLA_FLAGS", None)      # 1 device per process
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=150)
-        outs.append(out)
+    try:
+        for rank in range(world):
+            env = dict(os.environ,
+                       MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
+                       RANK=str(rank), WORLD_SIZE=str(world),
+                       DS_TPU_REPO=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+            env.pop("XLA_FLAGS", None)
+            env.pop("JAX_PLATFORMS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    lines = []
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-        assert f"OK rank={rank}" in out
+        lines.append([l for l in out.splitlines() if marker in l][0])
+    return lines
+
+
+def test_two_process_init_allgather_barrier(tmp_path):
+    lines = _run_ranks(WORKER, tmp_path, marker="OK rank=", timeout=150)
+    for rank, line in enumerate(lines):
+        assert f"OK rank={rank}" in line
 
 
 ENGINE_WORKER = textwrap.dedent("""
@@ -113,27 +128,8 @@ def test_two_process_engine_train(tmp_path):
     through the coordination service, and both ranks see the same loss
     (VERDICT r3 missing #4; reference tests/unit/common.py:102
     DistributedTest runs real collectives the same way)."""
-    script = tmp_path / "engine_worker.py"
-    script.write_text(ENGINE_WORKER)
-    port = _free_port()
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ,
-                   MASTER_ADDR="127.0.0.1", MASTER_PORT=str(port),
-                   RANK=str(rank), WORLD_SIZE="2",
-                   DS_TPU_REPO=os.path.dirname(os.path.dirname(
-                       os.path.abspath(__file__))))
-        env.pop("XLA_FLAGS", None)
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    outs = [p.communicate(timeout=300)[0] for p in procs]
-    losses = set()
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-        line = [l for l in out.splitlines() if "TRAIN-OK" in l][0]
-        losses.add(line.split("loss=")[1])
+    lines = _run_ranks(ENGINE_WORKER, tmp_path, marker="TRAIN-OK")
+    losses = {line.split("loss=")[1] for line in lines}
     assert len(losses) == 1, f"ranks disagree on the loss: {losses}"
 
 
@@ -238,3 +234,57 @@ def test_supervisor_restarts_from_checkpoint(tmp_path):
     assert (tmp_path / "crashed.flag").exists(), "crash never happened"
     # the checkpoint survived the crash and fed the resumed incarnation
     assert (tmp_path / "ckpt" / "latest").exists()
+
+
+SERVING_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.environ["DS_TPU_REPO"])
+    from deepspeed_tpu import comm
+
+    comm.init_distributed()
+    assert jax.process_count() == 2 and len(jax.devices()) == 4
+
+    import dataclasses
+    import numpy as np
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models.transformer import CausalLM, TINY_TEST
+    from deepspeed_tpu.parallel import topology as topo
+
+    cfg = dataclasses.replace(TINY_TEST, num_kv_heads=4)
+    t = topo.MeshTopology.build(tensor=4, data=1)
+    # identical params on every process (seeded init is deterministic)
+    engine = InferenceEngineV2(CausalLM(cfg), mesh=t,
+        config=RaggedInferenceEngineConfig(
+            max_ragged_sequence_count=4, max_chunk_tokens=16,
+            kv_blocks=64, kv_block_size=4))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, 9).tolist()
+    logits = engine.put([1], [prompt])
+    for _ in range(3):
+        nxt = int(np.argmax(np.asarray(logits)[0]))
+        logits = engine.put([1], [[nxt]])
+    # every process must agree on the served logits
+    out = np.asarray(logits[0], np.float32)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(out)
+    np.testing.assert_allclose(gathered[0], gathered[1], atol=1e-6)
+    print(f"SERVE-OK rank={jax.process_index()} top={int(np.argmax(out))}")
+""")
+
+
+def test_two_process_tp_serving(tmp_path):
+    """v2 TP serving across two OS processes (tensor axis spanning both):
+    the paged kernel's shard_map, the TP param placement, and the block
+    allocator all agree cross-process — served logits identical on every
+    rank (multi-host FastGen; reference v2 inference_engine over deepspeed
+    launcher ranks)."""
+    lines = _run_ranks(SERVING_WORKER, tmp_path, marker="SERVE-OK")
+    tops = {line.split("top=")[1] for line in lines}
+    assert len(tops) == 1, f"ranks served different tokens: {tops}"
