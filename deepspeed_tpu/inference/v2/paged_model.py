@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ...models.transformer import (CausalLM, _linear, _norm, alibi_slopes,
-                                   rope_table)
+                                   apply_rope, rope_table)
 
 
 class PagedCausalLM:
@@ -148,19 +148,9 @@ class PagedCausalLM:
         def rope_q(q):
             if cfg.position != "rope":
                 return q
-            # apply_rope expects [B, T, H, D] with tables [T, R/2]; here the
-            # tables are per-(seq, pos): inline the (possibly partial)
-            # rotation, leaving trailing head dims unrotated (rope_pct)
-            rot = cos.shape[-1] * 2
-            qr, q_pass = q[..., :rot], q[..., rot:]
-            q1, q2 = jnp.split(qr, 2, axis=-1)
-            c = cos[:, :, None, :]
-            s = sin[:, :, None, :]
-            out = jnp.concatenate([q1 * c - q2 * s, q2 * c + q1 * s],
-                                  axis=-1)
-            if q_pass.shape[-1]:
-                out = jnp.concatenate([out, q_pass], axis=-1)
-            return out.astype(q.dtype)
+            # per-(seq, pos) tables are exactly apply_rope's ndim-3 form
+            # (rotate_half or GPT-J interleaved, partial rotary included)
+            return apply_rope(q, cos, sin, cfg.rope_interleaved)
 
         def block(x, xs):
             lp, kc, vc = xs   # kc/vc [NB, KH, bs, D]
@@ -188,7 +178,7 @@ class PagedCausalLM:
                                 n_tokens, slopes)
             attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
                                lp.get("wo_b"), dt)
-            x = self.model._attn_mlp_merge(x, attn_out, lp)
+            x = self.model._attn_mlp_merge(x, attn_out, lp, h1)
             return x, (kc, vc)
 
         x, (new_k, new_v) = lax.scan(block, x,

@@ -20,6 +20,7 @@ from .transformer import (  # noqa: F401
     BLOOM_560M,
     FALCON_7B,
     TINY_TEST,
+    GPTJ_6B,
 )
 
 from .convert import (  # noqa: F401
@@ -36,6 +37,7 @@ MODEL_CONFIGS = {
     "mistral-7b": MISTRAL_7B,
     "qwen2-7b": QWEN2_7B,
     "opt-1.3b": OPT_1B3,
+    "gpt-j-6b": GPTJ_6B,
     "pythia-1.4b": PYTHIA_1B4,
     "bloom-560m": BLOOM_560M,
     "falcon-7b": FALCON_7B,

@@ -22,6 +22,7 @@ the copy-and-slice round trip.
 
 Supported families (reference containers ``module_inject/containers/``):
 Llama/Llama-2, Mistral (sliding-window attention applied past the window),
+GPT-J (shared-LN parallel blocks, interleaved partial rotary),
 GPT-2, Qwen2 (qkv-bias), OPT (learned positions, relu), GPT-NeoX
 (parallel residual, partial rotary, interleaved fused QKV), BLOOM (ALiBi,
 embedding LayerNorm), and Falcon 7B/40B (parallel attention, MQA/grouped
@@ -467,6 +468,47 @@ def _neox_plans(cfg: TransformerConfig, shapes,
     return plans
 
 
+def _gptj_plans(cfg: TransformerConfig, shapes,
+                hf_config=None) -> Dict[str, Any]:
+    """HF GPTJForCausalLM: separate bias-free q/k/v/out projections, ONE
+    shared LayerNorm per block (ln_1 feeds both branches), biased MLP
+    (fc_in/fc_out), interleaved partial rotary, biased lm_head."""
+    L = "transformer.h.{}."
+
+    def lsrc(fmt, transpose=False):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    layers = {
+        "attn_norm_w": lsrc("ln_1.weight"),
+        "attn_norm_b": lsrc("ln_1.bias"),
+        "wq": lsrc("attn.q_proj.weight", transpose=True),
+        "wk": lsrc("attn.k_proj.weight", transpose=True),
+        "wv": lsrc("attn.v_proj.weight", transpose=True),
+        "wo": lsrc("attn.out_proj.weight", transpose=True),
+        "w_in": lsrc("mlp.fc_in.weight", transpose=True),
+        "w_in_b": lsrc("mlp.fc_in.bias"),
+        "w_out": lsrc("mlp.fc_out.weight", transpose=True),
+        "w_out_b": lsrc("mlp.fc_out.bias"),
+    }
+    plans = {
+        "embed": {"wte": LeafPlan(Src("transformer.wte.weight"),
+                                  shapes["embed"]["wte"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {
+            "w": LeafPlan(Src("transformer.ln_f.weight"),
+                          shapes["final_norm"]["w"].shape),
+            "b": LeafPlan(Src("transformer.ln_f.bias"),
+                          shapes["final_norm"]["b"].shape)},
+        "lm_head": {
+            "w": LeafPlan(Src("lm_head.weight", transpose=True),
+                          shapes["lm_head"]["w"].shape),
+            "b": LeafPlan(Src("lm_head.bias"),
+                          shapes["lm_head"]["b"].shape)},
+    }
+    return plans
+
+
 def _bloom_plans(cfg: TransformerConfig, shapes,
              hf_config=None) -> Dict[str, Any]:
     """HF BloomForCausalLM: ALiBi, embedding LayerNorm, interleaved fused
@@ -627,7 +669,7 @@ def _falcon_plans(cfg: TransformerConfig, shapes,
 _FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
              "gpt2": _gpt2_plans, "qwen2": _qwen2_plans, "opt": _opt_plans,
              "gpt_neox": _neox_plans, "bloom": _bloom_plans,
-             "falcon": _falcon_plans}
+             "falcon": _falcon_plans, "gptj": _gptj_plans}
 
 
 def _qwen2_window(hf_config: Dict[str, Any]):
@@ -684,6 +726,23 @@ def config_from_hf(hf_config: Dict[str, Any],
             max_seq_len=hf_config.get("n_positions", 1024),
             norm="layernorm", activation="gelu", position="learned",
             tie_embeddings=True, use_bias=True,
+            norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype)
+    if mt == "gptj":
+        h = hf_config["n_embd"]
+        nh = hf_config["n_head"]
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("n_inner") or 4 * h,
+            num_layers=hf_config["n_layer"],
+            num_heads=nh,
+            max_seq_len=hf_config.get("n_positions", 2048),
+            norm="layernorm", activation="gelu", position="rope",
+            rope_pct=(hf_config.get("rotary_dim") or h // nh) / (h // nh),
+            rope_interleaved=True, parallel_residual=True,
+            shared_layernorm=True, tie_embeddings=False,
+            use_bias=False, mlp_bias=True, lm_head_bias=True,
             norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
             dtype=dtype)
     if mt == "qwen2":

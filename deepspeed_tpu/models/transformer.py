@@ -53,11 +53,15 @@ class TransformerConfig:
     position: str = "learned"            # "learned" | "rope" | "alibi"
     rope_theta: float = 10000.0
     rope_pct: float = 1.0                # partial rotary (GPT-NeoX rotary_pct)
+    rope_interleaved: bool = False       # GPT-J rotate_every_two pair layout
     tie_embeddings: bool = True
     norm_eps: float = 1e-5
     use_bias: bool = False               # linear biases (GPT-2/OPT style)
     qkv_bias: bool = False               # biases on q/k/v only (Qwen2)
+    mlp_bias: Optional[bool] = None      # MLP biases; None → use_bias (GPT-J)
+    lm_head_bias: bool = False           # bias on the LM head (GPT-J)
     parallel_residual: bool = False      # x + attn(ln1 x) + mlp(ln2 x) (NeoX/Falcon)
+    shared_layernorm: bool = False       # parallel residual reads ONE ln (GPT-J)
     embedding_layernorm: bool = False    # LayerNorm after wte (BLOOM)
     dropout: float = 0.0
     dtype: Any = jnp.float32             # compute dtype (params kept fp32)
@@ -146,6 +150,15 @@ OPT_1B3 = TransformerConfig(vocab_size=50272, hidden_size=2048,
                             norm="layernorm", activation="relu",
                             position="learned", tie_embeddings=True,
                             use_bias=True, dtype=jnp.bfloat16)
+GPTJ_6B = TransformerConfig(vocab_size=50400, hidden_size=4096,
+                            intermediate_size=16384, num_layers=28,
+                            num_heads=16, max_seq_len=2048,
+                            norm="layernorm", activation="gelu",
+                            position="rope", rope_pct=0.25,
+                            rope_interleaved=True, parallel_residual=True,
+                            shared_layernorm=True, tie_embeddings=False,
+                            mlp_bias=True, lm_head_bias=True,
+                            dtype=jnp.bfloat16)
 PYTHIA_1B4 = TransformerConfig(vocab_size=50304, hidden_size=2048,
                                intermediate_size=8192, num_layers=24,
                                num_heads=16, max_seq_len=2048,
@@ -201,21 +214,31 @@ def rope_table(max_len: int, head_dim: int, theta: float) -> Tuple[jnp.ndarray, 
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def apply_rope(x, cos, sin):
+def apply_rope(x, cos, sin, interleaved: bool = False):
     """x: [B, T, H, D]; cos/sin: [T, R/2] (shared positions) or [B, T, R/2]
     (per-sequence positions — the ragged decode path), with R ≤ D (partial
     rotary — the GPT-NeoX rotary_pct layout leaves the trailing D−R dims
-    unrotated)."""
+    unrotated). ``interleaved``: GPT-J's rotate_every_two pair layout
+    (pairs are (0,1),(2,3),… instead of the rotate_half (i, i+R/2) split).
+    """
     rot = cos.shape[-1] * 2
     xr, x_pass = x[..., :rot], x[..., rot:]
-    x1, x2 = jnp.split(xr, 2, axis=-1)
+    if interleaved:
+        x1, x2 = xr[..., 0::2], xr[..., 1::2]
+    else:
+        x1, x2 = jnp.split(xr, 2, axis=-1)
     if cos.ndim == 3:
         c = cos[:, :, None, :]
         s = sin[:, :, None, :]
     else:
         c = cos[None, :, None, :]
         s = sin[None, :, None, :]
-    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    if interleaved:
+        out = jnp.stack([r1, r2], axis=-1).reshape(xr.shape)
+    else:
+        out = jnp.concatenate([r1, r2], axis=-1)
     if x_pass.shape[-1]:
         out = jnp.concatenate([out, x_pass], axis=-1)
     return out.astype(x.dtype)
@@ -476,8 +499,9 @@ class CausalLM:
             "wk": layer_stack(keys[1], (h, kvh * hd)),
             "wv": layer_stack(keys[2], (h, kvh * hd)),
             "wo": layer_stack(keys[3], (nh * hd, h), scale=std / math.sqrt(2 * L)),
-            "mlp_norm_w": ln_w,
         }
+        if not cfg.shared_layernorm:
+            layers["mlp_norm_w"] = ln_w
         E = cfg.moe_num_experts
         if E > 0:
             layers["router_wg"] = layer_stack(keys[10], (h, E), scale=1.0 / math.sqrt(h))
@@ -490,15 +514,18 @@ class CausalLM:
             layers["w_out"] = layer_stack(keys[5], (m, h), scale=std / math.sqrt(2 * L))
             if cfg.activation == "silu":
                 layers["w_gate"] = layer_stack(keys[6], (h, m))
+        mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = jnp.zeros((L, h), jnp.float32)
-            layers["mlp_norm_b"] = jnp.zeros((L, h), jnp.float32)
+            if not cfg.shared_layernorm:
+                layers["mlp_norm_b"] = jnp.zeros((L, h), jnp.float32)
         if cfg.use_bias or cfg.qkv_bias:
             layers["wq_b"] = jnp.zeros((L, nh * hd), jnp.float32)
             layers["wk_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
             layers["wv_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
         if cfg.use_bias:
             layers["wo_b"] = jnp.zeros((L, h), jnp.float32)
+        if mlp_bias:
             layers["w_in_b"] = jnp.zeros((L, m), jnp.float32)
             layers["w_out_b"] = jnp.zeros((L, h), jnp.float32)
             if cfg.activation == "silu" and E == 0:
@@ -519,6 +546,8 @@ class CausalLM:
             params["final_norm"]["b"] = jnp.zeros((h,), jnp.float32)
         if not cfg.tie_embeddings:
             params["lm_head"] = {"w": normal(keys[9], (h, v))}
+            if cfg.lm_head_bias:
+                params["lm_head"]["b"] = jnp.zeros((v,), jnp.float32)
         return params
 
     # -- sharding specs -----------------------------------------------------
@@ -532,8 +561,9 @@ class CausalLM:
             "wk": spec("layers", "embed", "kv_heads"),
             "wv": spec("layers", "embed", "kv_heads"),
             "wo": spec("layers", "heads", "embed"),
-            "mlp_norm_w": spec("layers", "embed"),
         }
+        if not cfg.shared_layernorm:
+            layers["mlp_norm_w"] = spec("layers", "embed")
         if cfg.moe_num_experts > 0:
             layers["router_wg"] = spec("layers", "embed", None)
             layers["w_in"] = spec("layers", "expert", "embed", "mlp")
@@ -545,15 +575,18 @@ class CausalLM:
             layers["w_out"] = spec("layers", "mlp", "embed")
             if cfg.activation == "silu":
                 layers["w_gate"] = spec("layers", "embed", "mlp")
+        mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
         if cfg.norm == "layernorm":
             layers["attn_norm_b"] = spec("layers", "embed")
-            layers["mlp_norm_b"] = spec("layers", "embed")
+            if not cfg.shared_layernorm:
+                layers["mlp_norm_b"] = spec("layers", "embed")
         if cfg.use_bias or cfg.qkv_bias:
             layers["wq_b"] = spec("layers", "heads")
             layers["wk_b"] = spec("layers", "kv_heads")
             layers["wv_b"] = spec("layers", "kv_heads")
         if cfg.use_bias:
             layers["wo_b"] = spec("layers", "embed")
+        if mlp_bias:
             layers["w_in_b"] = spec("layers", "mlp")
             layers["w_out_b"] = spec("layers", "embed")
             if cfg.activation == "silu" and cfg.moe_num_experts == 0:
@@ -573,6 +606,8 @@ class CausalLM:
             specs["final_norm"]["b"] = spec("embed")
         if not cfg.tie_embeddings:
             specs["lm_head"] = {"w": spec("embed", "vocab")}
+            if cfg.lm_head_bias:
+                specs["lm_head"]["b"] = spec("vocab")
         return specs
 
     # -- one transformer block ---------------------------------------------
@@ -592,10 +627,14 @@ class CausalLM:
 
         # mlp (dense or MoE; body shared with the inference paths).
         # parallel_residual (NeoX/Falcon): both branches read the SAME
-        # input x; sequential (default): mlp reads the post-attention x.
-        mlp_in = x if cfg.parallel_residual else x + attn
-        h2 = _norm(mlp_in, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm,
-                   cfg.norm_eps)
+        # input x; shared_layernorm (GPT-J): the mlp reads h1 itself;
+        # sequential (default): mlp reads the post-attention x.
+        if cfg.shared_layernorm:
+            h2 = h1
+        else:
+            mlp_in = x if cfg.parallel_residual else x + attn
+            h2 = _norm(mlp_in, lp["mlp_norm_w"], lp.get("mlp_norm_b"),
+                       cfg.norm, cfg.norm_eps)
         y, l_aux = self._mlp_body(h2, lp, rng, deterministic)
         if cfg.dropout > 0 and not deterministic:
             rng, sub = jax.random.split(rng)
@@ -751,10 +790,7 @@ class CausalLM:
             x, aux_losses = lax.scan(scan_fn, x, (params["layers"], layer_keys))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
-        if cfg.tie_embeddings:
-            logits = x @ params["embed"]["wte"].T.astype(cfg.dtype)
-        else:
-            logits = x @ params["lm_head"]["w"].astype(cfg.dtype)
+        logits = self._unembed(params, x)
         if return_aux:
             return logits, jnp.sum(aux_losses)
         return logits
@@ -917,7 +953,7 @@ class CausalLM:
                                    window=cfg.sliding_window or 0)
             attn = _linear(attn.reshape(B, 1, -1), lp["wo"], lp.get("wo_b"),
                            cfg.dtype)
-            return self._attn_mlp_merge(x, attn, lp), (kc, vc)
+            return self._attn_mlp_merge(x, attn, lp, h1), (kc, vc)
 
         x, (new_k, new_v) = lax.scan(body, x,
                                      (params["layers"], cache["k"],
@@ -941,7 +977,10 @@ class CausalLM:
         cfg = self.cfg
         if cfg.tie_embeddings:
             return x @ params["embed"]["wte"].T.astype(cfg.dtype)
-        return x @ params["lm_head"]["w"].astype(cfg.dtype)
+        y = x @ params["lm_head"]["w"].astype(cfg.dtype)
+        if "b" in params.get("lm_head", {}):
+            y = y + params["lm_head"]["b"].astype(cfg.dtype)
+        return y
 
     def _qkv(self, h1, lp, cos, sin, B, T):
         cfg = self.cfg
@@ -951,14 +990,19 @@ class CausalLM:
         k = _linear(h1, lp["wk"], lp.get("wk_b"), dt).reshape(B, T, kvh, hd)
         v = _linear(h1, lp["wv"], lp.get("wv_b"), dt).reshape(B, T, kvh, hd)
         if cfg.position == "rope":
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
+            q = apply_rope(q, cos, sin, cfg.rope_interleaved)
+            k = apply_rope(k, cos, sin, cfg.rope_interleaved)
         return q, k, v
 
-    def _attn_mlp_merge(self, x, attn_out, lp):
+    def _attn_mlp_merge(self, x, attn_out, lp, h1=None):
         """Shared residual wiring for the inference blocks: sequential
-        (mlp reads post-attention) or parallel (both branches read x)."""
+        (mlp reads post-attention), parallel (both branches read x), or
+        shared-layernorm parallel (GPT-J: mlp reads the SAME normed h1 the
+        attention read — no second norm exists)."""
         cfg = self.cfg
+        if cfg.shared_layernorm:
+            y, _ = self._mlp_body(h1, lp, None, True)
+            return x + attn_out + y
         mlp_in = x if cfg.parallel_residual else x + attn_out
         h2 = _norm(mlp_in, lp["mlp_norm_w"], lp.get("mlp_norm_b"), cfg.norm,
                    cfg.norm_eps)
@@ -974,7 +1018,7 @@ class CausalLM:
         attn = _attention(q, k, v, cfg, causal=True)
         attn = _linear(attn.reshape(B, T, -1), lp["wo"], lp.get("wo_b"),
                        cfg.dtype)
-        return self._attn_mlp_merge(x, attn, lp), k, v
+        return self._attn_mlp_merge(x, attn, lp, h1), k, v
 
     def _block_decode(self, x, lp, kc, vc, cos, sin, pos, S):
         """Decode block: single token attends over the cache."""
@@ -996,7 +1040,7 @@ class CausalLM:
                                    bias=bias)
         attn = _linear(attn.reshape(B, 1, -1), lp["wo"], lp.get("wo_b"),
                        cfg.dtype)
-        return self._attn_mlp_merge(x, attn, lp), kc, vc
+        return self._attn_mlp_merge(x, attn, lp, h1), kc, vc
 
     # -- loss ---------------------------------------------------------------
     def loss(self, params, batch, rng=None):

@@ -307,3 +307,49 @@ def test_mistral_sliding_window_v2_serving(tmp_path_factory):
         nxt = int(np.argmax(ref))
         seq.append(nxt)
         logits = engine.put([1], [[nxt]])
+
+
+def test_gptj_forward_parity(tmp_path_factory):
+    """GPT-J: shared single LayerNorm per block (ln_1 feeds both parallel
+    branches), interleaved (rotate_every_two) partial rotary, bias-free
+    attention with biased MLP, biased untied lm_head."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    cfg = GPTJConfig(vocab_size=160, n_embd=32, n_inner=64, n_layer=2,
+                     n_head=4, n_positions=64, rotary_dim=4,
+                     tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = GPTJForCausalLM(cfg).eval()
+    with torch.no_grad():
+        hf.lm_head.bias.uniform_(-0.5, 0.5)   # exercise the head bias
+    path = _save(hf, tmp_path_factory, "gptj")
+    model = _parity(path, hf, 160)
+    assert model.cfg.shared_layernorm and model.cfg.rope_interleaved
+    assert model.cfg.rot_dim == 4
+
+
+def test_gptj_generate_matches_hf(tmp_path_factory):
+    """Greedy cached generate (paged decode incl. interleaved rotary at
+    per-sequence positions) matches HF token-for-token."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = GPTJConfig(vocab_size=160, n_embd=32, n_inner=64, n_layer=2,
+                     n_head=4, n_positions=64, rotary_dim=4,
+                     tie_word_embeddings=False)
+    torch.manual_seed(1)
+    hf = GPTJForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "gptj_gen")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngine(model, params=params)
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, 160, size=(2, 10))
+    ours = np.asarray(engine.generate(jnp.asarray(prompt, jnp.int32),
+                                      max_new_tokens=8))
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt), max_new_tokens=8,
+                             do_sample=False).numpy()
+    np.testing.assert_array_equal(ours, theirs)
