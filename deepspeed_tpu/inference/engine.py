@@ -145,9 +145,11 @@ class InferenceEngine:
     # of KV per (block, kv-head) slab in the Pallas kernel.
     DECODE_BLOCK = 128
 
-    def _generate_fn(self, max_len: int, max_new: int, top_k: int):
+    def _generate_fn(self, max_len: int, max_new: int, top_k: int,
+                     eos_token_id=None):
         """Build (and cache) the jitted prefill+scan-decode program. Cache
-        key is shapes + top_k only — temperature is a traced argument.
+        key is shapes + top_k + eos ids (each distinct eos set is its own
+        compiled program); temperature stays a traced argument.
 
         The decode loop runs through the paged-attention kernel over a
         pool-layout cache (the contiguous cache is the trivial-block-table
@@ -155,7 +157,10 @@ class InferenceEngine:
         context length — never the [B, S] mask materialization of the old
         reference-attention path (reference decode hot loop:
         csrc/transformer/inference/csrc/pt_binding.cpp)."""
-        key = (max_len, max_new, top_k)
+        if eos_token_id is not None and not isinstance(eos_token_id, int):
+            # HF accepts lists of eos ids; normalize to a hashable tuple
+            eos_token_id = tuple(int(e) for e in eos_token_id)
+        key = (max_len, max_new, top_k, eos_token_id)
         if key in self._gen_cache:
             return self._gen_cache[key]
         module = self.module
@@ -173,16 +178,26 @@ class InferenceEngine:
                 logits, (prompt_len - 1)[:, None, None], axis=1)[:, 0]
 
             def step(carry, i):
-                cache, cur, rng = carry
+                cache, cur, rng, done = carry
                 rng, sub = jax.random.split(rng)
                 nxt = self._sample(cur, sub, temperature, top_k)
+                if eos_token_id is not None:
+                    # HF semantics: the EOS itself is emitted; every token
+                    # after a finished sequence is pad (0). The scan keeps
+                    # running (fixed shapes) but finished rows emit pad.
+                    eos_ids = jnp.asarray(
+                        eos_token_id if isinstance(eos_token_id, tuple)
+                        else (eos_token_id,), jnp.int32)
+                    nxt = jnp.where(done, 0, nxt)
+                    done = done | jnp.isin(nxt, eos_ids)
                 pos = prompt_len + i               # per-sequence positions
                 logits, cache = module.decode_step_paged(
                     params, cache, tables, nxt, pos)
-                return (cache, logits, rng), nxt
+                return (cache, logits, rng, done), nxt
 
-            (_, _, _), out_tokens = jax.lax.scan(
-                step, (cache, last, rng), jnp.arange(max_new))
+            (_, _, _, _), out_tokens = jax.lax.scan(
+                step, (cache, last, rng, jnp.zeros((B,), bool)),
+                jnp.arange(max_new))
             out_tokens = out_tokens.T              # [B, max_new]
             # place each sequence's new tokens right after its prompt
             out = jnp.zeros((B, T + max_new), jnp.int32)
@@ -197,13 +212,15 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, rng=None,
-                 prompt_len=None, **kwargs):
+                 prompt_len=None, eos_token_id=None, **kwargs):
         """HF-style generate with ragged-prompt support.
 
         ``input_ids``: [B, T] array, or a list of per-sequence token
         sequences (ragged — right-padded internally, like the reference v1
         engine's variable-length serving). ``prompt_len`` [B] optionally
         marks the real length of each row of a padded [B, T] array.
+        ``eos_token_id``: sequences that emit it produce pad (0) for the
+        remaining steps (HF early-stop semantics under fixed shapes).
         Returns [B, T + n] with each sequence's new tokens placed directly
         after its prompt and pad id 0 beyond ``prompt_len[b] + n``."""
         if isinstance(input_ids, (list, tuple)) and input_ids \
@@ -241,7 +258,7 @@ class InferenceEngine:
                 f"(context window {ctx}, prompt {T})")
         max_len = T + max_new
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        fn = self._generate_fn(max_len, max_new, top_k)
+        fn = self._generate_fn(max_len, max_new, top_k, eos_token_id)
         return fn(self.params, tokens, prompt_len, rng,
                   jnp.asarray(temperature, jnp.float32))
 

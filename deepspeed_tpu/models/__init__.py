@@ -21,6 +21,7 @@ from .transformer import (  # noqa: F401
     FALCON_7B,
     TINY_TEST,
     GPTJ_6B,
+    PHI_2,
 )
 
 from .convert import (  # noqa: F401
@@ -38,6 +39,7 @@ MODEL_CONFIGS = {
     "qwen2-7b": QWEN2_7B,
     "opt-1.3b": OPT_1B3,
     "gpt-j-6b": GPTJ_6B,
+    "phi-2": PHI_2,
     "pythia-1.4b": PYTHIA_1B4,
     "bloom-560m": BLOOM_560M,
     "falcon-7b": FALCON_7B,

@@ -22,7 +22,8 @@ the copy-and-slice round trip.
 
 Supported families (reference containers ``module_inject/containers/``):
 Llama/Llama-2, Mistral (sliding-window attention applied past the window),
-GPT-J (shared-LN parallel blocks, interleaved partial rotary),
+GPT-J (shared-LN parallel blocks, interleaved partial rotary), Phi
+(shared-LN parallel blocks, biased projections, rotate_half partial rotary),
 GPT-2, Qwen2 (qkv-bias), OPT (learned positions, relu), GPT-NeoX
 (parallel residual, partial rotary, interleaved fused QKV), BLOOM (ALiBi,
 embedding LayerNorm), and Falcon 7B/40B (parallel attention, MQA/grouped
@@ -509,6 +510,52 @@ def _gptj_plans(cfg: TransformerConfig, shapes,
     return plans
 
 
+def _phi_plans(cfg: TransformerConfig, shapes,
+               hf_config=None) -> Dict[str, Any]:
+    """HF PhiForCausalLM: GPT-J-style single input_layernorm per block
+    feeding both parallel branches, but with biases everywhere and
+    rotate_half partial rotary."""
+    L = "model.layers.{}."
+
+    def lsrc(fmt, transpose=False):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    layers = {
+        "attn_norm_w": lsrc("input_layernorm.weight"),
+        "attn_norm_b": lsrc("input_layernorm.bias"),
+        "wq": lsrc("self_attn.q_proj.weight", transpose=True),
+        "wq_b": lsrc("self_attn.q_proj.bias"),
+        "wk": lsrc("self_attn.k_proj.weight", transpose=True),
+        "wk_b": lsrc("self_attn.k_proj.bias"),
+        "wv": lsrc("self_attn.v_proj.weight", transpose=True),
+        "wv_b": lsrc("self_attn.v_proj.bias"),
+        "wo": lsrc("self_attn.dense.weight", transpose=True),
+        "wo_b": lsrc("self_attn.dense.bias"),
+        "w_in": lsrc("mlp.fc1.weight", transpose=True),
+        "w_in_b": lsrc("mlp.fc1.bias"),
+        "w_out": lsrc("mlp.fc2.weight", transpose=True),
+        "w_out_b": lsrc("mlp.fc2.bias"),
+    }
+    plans = {
+        "embed": {"wte": LeafPlan(Src("model.embed_tokens.weight"),
+                                  shapes["embed"]["wte"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {
+            "w": LeafPlan(Src("model.final_layernorm.weight"),
+                          shapes["final_norm"]["w"].shape),
+            "b": LeafPlan(Src("model.final_layernorm.bias"),
+                          shapes["final_norm"]["b"].shape)},
+    }
+    if not cfg.tie_embeddings:
+        plans["lm_head"] = {
+            "w": LeafPlan(Src("lm_head.weight", transpose=True),
+                          shapes["lm_head"]["w"].shape),
+            "b": LeafPlan(Src("lm_head.bias"),
+                          shapes["lm_head"]["b"].shape)}
+    return plans
+
+
 def _bloom_plans(cfg: TransformerConfig, shapes,
              hf_config=None) -> Dict[str, Any]:
     """HF BloomForCausalLM: ALiBi, embedding LayerNorm, interleaved fused
@@ -669,7 +716,8 @@ def _falcon_plans(cfg: TransformerConfig, shapes,
 _FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
              "gpt2": _gpt2_plans, "qwen2": _qwen2_plans, "opt": _opt_plans,
              "gpt_neox": _neox_plans, "bloom": _bloom_plans,
-             "falcon": _falcon_plans, "gptj": _gptj_plans}
+             "falcon": _falcon_plans, "gptj": _gptj_plans,
+             "phi": _phi_plans}
 
 
 def _qwen2_window(hf_config: Dict[str, Any]):
@@ -727,6 +775,30 @@ def config_from_hf(hf_config: Dict[str, Any],
             norm="layernorm", activation="gelu", position="learned",
             tie_embeddings=True, use_bias=True,
             norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype)
+    if mt == "phi":
+        if hf_config.get("qk_layernorm"):
+            raise ValueError(
+                "Phi with qk_layernorm=true is unsupported (per-head q/k "
+                "LayerNorms have no TransformerConfig mapping); loading it "
+                "silently would diverge from HF")
+        h = hf_config["hidden_size"]
+        nh = hf_config["num_attention_heads"]
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=nh,
+            num_kv_heads=hf_config.get("num_key_value_heads") or nh,
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            norm="layernorm", activation="gelu", position="rope",
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            rope_pct=hf_config.get("partial_rotary_factor", 0.5),
+            parallel_residual=True, shared_layernorm=True,
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+            use_bias=True, mlp_bias=True, lm_head_bias=True,
+            norm_eps=hf_config.get("layer_norm_eps", 1e-5),
             dtype=dtype)
     if mt == "gptj":
         h = hf_config["n_embd"]

@@ -159,6 +159,15 @@ GPTJ_6B = TransformerConfig(vocab_size=50400, hidden_size=4096,
                             shared_layernorm=True, tie_embeddings=False,
                             mlp_bias=True, lm_head_bias=True,
                             dtype=jnp.bfloat16)
+PHI_2 = TransformerConfig(vocab_size=51200, hidden_size=2560,
+                          intermediate_size=10240, num_layers=32,
+                          num_heads=32, max_seq_len=2048,
+                          norm="layernorm", activation="gelu",
+                          position="rope", rope_pct=0.4,
+                          parallel_residual=True, shared_layernorm=True,
+                          tie_embeddings=False, use_bias=True,
+                          mlp_bias=True, lm_head_bias=True,
+                          dtype=jnp.bfloat16)
 PYTHIA_1B4 = TransformerConfig(vocab_size=50304, hidden_size=2048,
                                intermediate_size=8192, num_layers=24,
                                num_heads=16, max_seq_len=2048,
