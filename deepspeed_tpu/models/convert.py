@@ -24,6 +24,7 @@ Supported families (reference containers ``module_inject/containers/``):
 Llama/Llama-2, Mistral (sliding-window attention applied past the window),
 GPT-J (shared-LN parallel blocks, interleaved partial rotary), Phi
 (shared-LN parallel blocks, biased projections, rotate_half partial rotary),
+StableLM (biased-LayerNorm SwiGLU, both residual layouts),
 GPT-2, Qwen2 (qkv-bias), OPT (learned positions, relu), GPT-NeoX
 (parallel residual, partial rotary, interleaved fused QKV), BLOOM (ALiBi,
 embedding LayerNorm), and Falcon 7B/40B (parallel attention, MQA/grouped
@@ -296,11 +297,13 @@ def _llama_plans(cfg: TransformerConfig, shapes,
         "wk": lsrc("self_attn.k_proj.weight"),
         "wv": lsrc("self_attn.v_proj.weight"),
         "wo": lsrc("self_attn.o_proj.weight"),
-        "mlp_norm_w": lsrc("post_attention_layernorm.weight", transpose=False),
         "w_gate": lsrc("mlp.gate_proj.weight"),
         "w_in": lsrc("mlp.up_proj.weight"),
         "w_out": lsrc("mlp.down_proj.weight"),
     }
+    if not cfg.shared_layernorm:   # StableLM parallel residual drops ln_2
+        layers["mlp_norm_w"] = lsrc("post_attention_layernorm.weight",
+                                    transpose=False)
     plans = {
         "embed": {"wte": LeafPlan(Src("model.embed_tokens.weight"),
                                   shapes["embed"]["wte"].shape)},
@@ -312,6 +315,35 @@ def _llama_plans(cfg: TransformerConfig, shapes,
     if not cfg.tie_embeddings:
         plans["lm_head"] = {"w": LeafPlan(Src("lm_head.weight", transpose=True),
                                           shapes["lm_head"]["w"].shape)}
+    return plans
+
+
+def _stablelm_plans(cfg: TransformerConfig, shapes,
+                    hf_config=None) -> Dict[str, Any]:
+    """HF StableLmForCausalLM = the Llama layout + LayerNorm biases
+    (+ final-norm bias), optional qkv biases, and — under parallel
+    residual — no post_attention_layernorm at all (the GPT-J shared-LN
+    pattern)."""
+    L = "model.layers.{}."
+
+    def lsrc(fmt: str, transpose=False):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    plans = _llama_plans(cfg, shapes, hf_config)
+    layers = dict(plans["layers"])
+    raw = {"attn_norm_b": lsrc("input_layernorm.bias")}
+    if not cfg.shared_layernorm:
+        raw["mlp_norm_b"] = lsrc("post_attention_layernorm.bias")
+    if cfg.qkv_bias:
+        raw["wq_b"] = lsrc("self_attn.q_proj.bias")
+        raw["wk_b"] = lsrc("self_attn.k_proj.bias")
+        raw["wv_b"] = lsrc("self_attn.v_proj.bias")
+    layers.update({k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in raw.items()})
+    plans["layers"] = layers
+    plans["final_norm"] = dict(
+        plans["final_norm"],
+        b=LeafPlan(Src("model.norm.bias"), shapes["final_norm"]["b"].shape))
     return plans
 
 
@@ -717,7 +749,7 @@ _FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
              "gpt2": _gpt2_plans, "qwen2": _qwen2_plans, "opt": _opt_plans,
              "gpt_neox": _neox_plans, "bloom": _bloom_plans,
              "falcon": _falcon_plans, "gptj": _gptj_plans,
-             "phi": _phi_plans}
+             "phi": _phi_plans, "stablelm": _stablelm_plans}
 
 
 def _qwen2_window(hf_config: Dict[str, Any]):
@@ -775,6 +807,30 @@ def config_from_hf(hf_config: Dict[str, Any],
             norm="layernorm", activation="gelu", position="learned",
             tie_embeddings=True, use_bias=True,
             norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype)
+    if mt == "stablelm":
+        if hf_config.get("qk_layernorm"):
+            raise ValueError(
+                "StableLM with qk_layernorm=true is unsupported (per-head "
+                "q/k LayerNorms have no TransformerConfig mapping); loading "
+                "it silently would diverge from HF")
+        h = hf_config["hidden_size"]
+        par = bool(hf_config.get("use_parallel_residual", False))
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config["intermediate_size"],
+            num_layers=hf_config["num_hidden_layers"],
+            num_heads=hf_config["num_attention_heads"],
+            num_kv_heads=hf_config.get("num_key_value_heads"),
+            max_seq_len=hf_config.get("max_position_embeddings", 4096),
+            norm="layernorm", activation="silu", position="rope",
+            rope_theta=hf_config.get("rope_theta", 10000.0),
+            rope_pct=hf_config.get("partial_rotary_factor", 0.25),
+            parallel_residual=par, shared_layernorm=par,
+            qkv_bias=bool(hf_config.get("use_qkv_bias", False)),
+            tie_embeddings=hf_config.get("tie_word_embeddings", False),
+            norm_eps=hf_config.get("layer_norm_eps", 1e-5),
             dtype=dtype)
     if mt == "phi":
         if hf_config.get("qk_layernorm"):

@@ -434,3 +434,56 @@ def test_generate_eos_early_stop_matches_hf(tmp_path_factory):
     np.testing.assert_array_equal(ours[:, :L], theirs)
     assert (ours[:, L:] == 0).all()
     assert 2 in ours[0].tolist(), "the eos token itself must be emitted"
+
+
+@pytest.mark.parametrize("parallel", [False, True])
+def test_stablelm_forward_parity(tmp_path_factory, parallel):
+    """StableLM: llama-shaped SwiGLU blocks with biased LayerNorm and
+    partial rotary; use_parallel_residual drops post_attention_layernorm
+    entirely (the GPT-J shared-LN pattern)."""
+    from transformers import StableLmConfig, StableLmForCausalLM
+
+    cfg = StableLmConfig(vocab_size=130, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=64,
+                         partial_rotary_factor=0.25, use_qkv_bias=True,
+                         use_parallel_residual=parallel,
+                         tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = StableLmForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for p in hf.parameters():
+            if p.ndim == 1:
+                p.uniform_(-0.3, 0.3)
+    path = _save(hf, tmp_path_factory, f"stablelm{int(parallel)}")
+    model = _parity(path, hf, 130)
+    assert model.cfg.qkv_bias and model.cfg.rope_pct == 0.25
+    assert model.cfg.shared_layernorm == parallel
+
+
+def test_stablelm_generate_matches_hf(tmp_path_factory):
+    from transformers import StableLmConfig, StableLmForCausalLM
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = StableLmConfig(vocab_size=130, hidden_size=32,
+                         intermediate_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_key_value_heads=2,
+                         max_position_embeddings=64,
+                         partial_rotary_factor=0.25,
+                         tie_word_embeddings=False)
+    torch.manual_seed(4)
+    hf = StableLmForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "stablelm_gen")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngine(model, params=params)
+    prompt = np.random.default_rng(13).integers(0, 130, size=(2, 9))
+    ours = np.asarray(engine.generate(jnp.asarray(prompt, jnp.int32),
+                                      max_new_tokens=7))
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt), max_new_tokens=7,
+                             do_sample=False, eos_token_id=None).numpy()
+    np.testing.assert_array_equal(ours, theirs)
