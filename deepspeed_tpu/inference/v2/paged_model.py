@@ -63,9 +63,9 @@ class PagedCausalLM:
         self._attn_raw = instantiate_attn(self.cfg, name=attn_impl)
         self.forward = jax.jit(self._forward)
 
-    def _attend(self, q, kc, vc, block_tables, start_pos, n_tokens, slopes):
+    def _attend(self, q, kc, vc, block_tables, start_pos, n_tokens, slopes,
+                window=0):
         """Paged attention, shard_mapped over the tensor axis when TP>1."""
-        window = self.cfg.sliding_window or 0
         if self.tp == 1:
             return self._attn_raw(q, kc, vc, block_tables, start_pos,
                                   n_tokens, alibi_slopes=slopes,
@@ -152,38 +152,40 @@ class PagedCausalLM:
             # (rotate_half or GPT-J interleaved, partial rotary included)
             return apply_rope(q, cos, sin, cfg.rope_interleaved)
 
-        def block(x, xs):
-            lp, kc, vc = xs   # kc/vc [NB, KH, bs, D]
-            h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
-                       cfg.norm, cfg.norm_eps)
-            nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
-            q = rope_q(_linear(h1, lp["wq"], lp.get("wq_b"),
-                               dt).reshape(N, C, nh, hd))
-            k = rope_q(_linear(h1, lp["wk"], lp.get("wk_b"),
-                               dt).reshape(N, C, kvh, hd))
-            v = _linear(h1, lp["wv"], lp.get("wv_b"),
-                        dt).reshape(N, C, kvh, hd)
+        def block_for(window):
+            def block(x, xs):
+                lp, kc, vc = xs   # kc/vc [NB, KH, bs, D]
+                h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
+                           cfg.norm, cfg.norm_eps)
+                nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+                q = rope_q(_linear(h1, lp["wq"], lp.get("wq_b"),
+                                   dt).reshape(N, C, nh, hd))
+                k = rope_q(_linear(h1, lp["wk"], lp.get("wk_b"),
+                                   dt).reshape(N, C, kvh, hd))
+                v = _linear(h1, lp["wv"], lp.get("wv_b"),
+                            dt).reshape(N, C, kvh, hd)
 
-            # paged KV write (reference linear_blocked_kv_rotary kernel):
-            # token t lands at kc[block(t), :, slot(t), :]
-            kc = kc.at[write_blk, :, write_off, :].set(
-                k.reshape(-1, kvh, hd), mode="drop")
-            vc = vc.at[write_blk, :, write_off, :].set(
-                v.reshape(-1, kvh, hd), mode="drop")
+                # paged KV write (reference linear_blocked_kv_rotary
+                # kernel): token t lands at kc[block(t), :, slot(t), :]
+                kc = kc.at[write_blk, :, write_off, :].set(
+                    k.reshape(-1, kvh, hd), mode="drop")
+                vc = vc.at[write_blk, :, write_off, :].set(
+                    v.reshape(-1, kvh, hd), mode="drop")
 
-            # paged read: Pallas block-table walk (reference blocked_flash;
-            # Mistral sliding window clamps the walk to the last W
-            # positions; TP shard_maps the walk over the tensor axis)
-            attn = self._attend(q, kc, vc, block_tables, start_pos,
-                                n_tokens, slopes)
-            attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
-                               lp.get("wo_b"), dt)
-            x = self.model._attn_mlp_merge(x, attn_out, lp, h1)
-            return x, (kc, vc)
+                # paged read: Pallas block-table walk (reference
+                # blocked_flash; Mistral sliding window clamps the walk to
+                # the last W positions; TP shard_maps the walk over the
+                # tensor axis)
+                attn = self._attend(q, kc, vc, block_tables, start_pos,
+                                    n_tokens, slopes, window=window)
+                attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
+                                   lp.get("wo_b"), dt)
+                x = self.model._attn_mlp_merge(x, attn_out, lp, h1)
+                return x, (kc, vc)
+            return block
 
-        x, (new_k, new_v) = lax.scan(block, x,
-                                     (params["layers"], kv_cache["k"],
-                                      kv_cache["v"]))
+        x, (new_k, new_v) = self.model._scan_layers(
+            block_for, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         # logits_gather: only the last valid token per sequence

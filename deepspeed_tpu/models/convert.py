@@ -753,24 +753,30 @@ _FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
 
 
 def _qwen2_window(hf_config: Dict[str, Any]):
-    """Qwen2 applies SWA only to layers >= max_window_layers (HF semantics:
-    the first max_window_layers layers use full attention). A single global
-    window can represent the all-SWA (max_window_layers <= 0) and no-SWA
-    (max_window_layers >= num layers, or use_sliding_window false) configs;
-    mixed per-layer windows are rejected rather than silently mis-masked."""
+    """Qwen2 applies SWA only to layers >= max_window_layers (HF
+    configuration_qwen2.py: "the first max_window_layers layers will use
+    full attention"); an explicit ``layer_types`` list — HF's general
+    form — overrides. Returns None (no SWA anywhere), an int (uniform
+    window), or a per-layer tuple for mixed schedules, which
+    TransformerConfig.sliding_window accepts directly (window_segments
+    compiles one scan per constant-window run — 2 for this schedule)."""
     if not hf_config.get("use_sliding_window"):
         return None
+    w = hf_config.get("sliding_window")
+    if not w:
+        return None
     n_layers = hf_config["num_hidden_layers"]
-    mwl = hf_config.get("max_window_layers", n_layers)
-    if mwl >= n_layers:
+    lt = hf_config.get("layer_types")
+    if lt:
+        wins = tuple(w if t == "sliding_attention" else None for t in lt)
+    else:
+        mwl = hf_config.get("max_window_layers", n_layers)
+        wins = tuple(None if i < mwl else w for i in range(n_layers))
+    if not any(wins):
         return None                       # no layer is windowed
-    if mwl <= 0:
-        return hf_config.get("sliding_window")
-    raise ValueError(
-        f"Qwen2 with mixed attention layers (max_window_layers={mwl} of "
-        f"{n_layers}) is unsupported: the first {mwl} layers use full "
-        "attention in HF while the rest use SWA, and TransformerConfig has "
-        "one global sliding_window")
+    if all(wins):
+        return w                          # uniform SWA
+    return wins
 
 
 def config_from_hf(hf_config: Dict[str, Any],

@@ -46,7 +46,16 @@ class TransformerConfig:
     num_heads: int = 12
     num_kv_heads: Optional[int] = None   # GQA; None => MHA
     max_seq_len: int = 1024
-    sliding_window: Optional[int] = None  # Mistral sliding-window attention
+    # Sliding-window attention (Mistral/Qwen2). Either one global window
+    # (int) or a per-layer tuple of length num_layers (None/0 entries =
+    # full attention) — Qwen2's mixed schedule ("the first
+    # max_window_layers layers use full attention", HF configuration_
+    # qwen2.py; reference plumb-through: inference/v2/model_
+    # implementations/mistral/model.py:202). Per-layer windows compile
+    # one lax.scan per contiguous constant-window run (see
+    # window_segments), so schedules with few transitions stay O(1) in
+    # depth.
+    sliding_window: Optional[Any] = None  # int | tuple[Optional[int], ...]
     # architecture switches
     norm: str = "layernorm"              # "layernorm" | "rmsnorm"
     activation: str = "gelu"             # "gelu" | "silu" (SwiGLU) | "relu"
@@ -101,6 +110,33 @@ class TransformerConfig:
     def rot_dim(self) -> int:
         """Rotary dims per head (even; < head_dim for partial rotary)."""
         return int(self.head_dim * self.rope_pct) // 2 * 2
+
+    def layer_windows(self) -> Tuple[int, ...]:
+        """Per-layer sliding windows, length num_layers; 0 = full
+        attention. A scalar ``sliding_window`` broadcasts to all layers."""
+        sw = self.sliding_window
+        if sw is None or isinstance(sw, int):
+            return (int(sw or 0),) * self.num_layers
+        if len(sw) != self.num_layers:
+            raise ValueError(
+                f"sliding_window tuple has {len(sw)} entries for "
+                f"{self.num_layers} layers")
+        return tuple(int(w or 0) for w in sw)
+
+    def window_segments(self) -> Tuple[Tuple[int, int, int], ...]:
+        """Contiguous (start, length, window) runs of equal window over
+        the layer dim. Each run scans separately (the Pallas kernels take
+        the window statically — it prunes the KV grid), so a schedule
+        with R transitions costs R compiled block bodies. Qwen2's
+        full-then-SWA schedule is R=2; uniform windows stay R=1."""
+        ws = self.layer_windows()
+        segs = []
+        start = 0
+        for i in range(1, len(ws) + 1):
+            if i == len(ws) or ws[i] != ws[start]:
+                segs.append((start, i - start, ws[start]))
+                start = i
+        return tuple(segs)
 
     def num_params(self) -> int:
         h, m, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_layers
@@ -343,8 +379,7 @@ def _sparse_layout(cfg: TransformerConfig, seq_len: int):
     return _SPARSE_LAYOUT_CACHE[key]
 
 
-def _local_attention(q, k, v, cfg: TransformerConfig, causal=True):
-    window = cfg.sliding_window or 0
+def _local_attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
     if cfg.attention_impl == "sparse" and q.shape[1] == k.shape[1]:
         from ..ops.sparse_attention import sparse_attention as sparse_attn
 
@@ -390,7 +425,7 @@ def _pipe_parallel_size() -> int:
     return topo.get_topology().get_pipe_parallel_world_size()
 
 
-def _attention(q, k, v, cfg: TransformerConfig, causal=True):
+def _attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
     """Dispatch: dense local attention, Ulysses all-to-all, or ring CP.
 
     Under sequence parallelism (mesh ``sequence`` axis > 1) the attention
@@ -416,7 +451,7 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
 
     sp = _seq_parallel_size()
     if sp <= 1:
-        return _local_attention(q, k, v, cfg, causal)
+        return _local_attention(q, k, v, cfg, causal, window=window)
     if cfg.attention_impl == "sparse":
         raise NotImplementedError(
             "attention_impl='sparse' does not compose with the sequence "
@@ -438,7 +473,7 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
 
         fn = shard_map(_partial(ring_attention, causal=causal,
                                 axis_name=topo.SEQUENCE_AXIS,
-                                window=cfg.sliding_window or 0),
+                                window=window),
                        mesh=t.mesh, in_specs=(spec_, spec_, spec_),
                        out_specs=spec_, check_vma=False)
         return fn(q, k, v)
@@ -446,7 +481,7 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True):
     # Ulysses: all-to-all heads↔sequence around dense local attention
     from ..sequence.layer import ulysses_attention
 
-    local = _partial(_local_attention, cfg=cfg, causal=causal)
+    local = _partial(_local_attention, cfg=cfg, causal=causal, window=window)
 
     def shard_fn(q, k, v):
         return ulysses_attention(local, q, k, v)
@@ -620,14 +655,14 @@ class CausalLM:
         return specs
 
     # -- one transformer block ---------------------------------------------
-    def _block(self, x, lp, cos, sin, rng, deterministic: bool):
+    def _block(self, x, lp, cos, sin, rng, deterministic: bool, window=0):
         cfg = self.cfg
         B, T, H = x.shape
 
         # attention (projections shared with the KV-cache/paged paths)
         h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
         q, k, v = self._qkv(h1, lp, cos, sin, B, T)
-        attn = _attention(q, k, v, cfg, causal=True)
+        attn = _attention(q, k, v, cfg, causal=True, window=window)
         attn = _linear(attn.reshape(B, T, -1), lp["wo"], lp.get("wo_b"),
                        cfg.dtype)
         if cfg.dropout > 0 and not deterministic:
@@ -765,9 +800,11 @@ class CausalLM:
                 policy = jax.checkpoint_policies.dots_saveable
             elif cfg.remat_policy == "nothing_saveable":
                 policy = jax.checkpoint_policies.nothing_saveable
-            block = jax.checkpoint(block, policy=policy, static_argnums=(5,))
+            block = jax.checkpoint(block, policy=policy,
+                                   static_argnums=(5, 6))
 
         layer_keys = jax.random.split(rng, cfg.num_layers)
+        segs = cfg.window_segments()
         pp = _pipe_parallel_size()
         if pp > 1:
             # SPMD pipeline: layer dim sharded over the pipe axis, microbatch
@@ -775,13 +812,21 @@ class CausalLM:
             from ..parallel.pipeline import pipelined_layer_apply
             from ..parallel import topology as topo
 
+            if len(segs) > 1:
+                raise NotImplementedError(
+                    "per-layer sliding windows do not compose with "
+                    "pipeline parallelism: the pipe loop runs ONE compiled "
+                    "block body over the layer-sharded stack; a mixed "
+                    "window schedule needs one body per window run")
+            win = segs[0][2]
+
             def layer_fn(carry, layer_slice, micro_idx):
                 lp, key = layer_slice
                 if self.layer_transform is not None:
                     lp = self.layer_transform(lp)
                 # distinct dropout mask per microbatch
                 key = jax.random.fold_in(key, micro_idx)
-                return block(carry, lp, cos, sin, key, deterministic)
+                return block(carry, lp, cos, sin, key, deterministic, win)
 
             num_micro = cfg.pipeline_microbatches or pp
             x, aux_sum = pipelined_layer_apply(
@@ -789,20 +834,41 @@ class CausalLM:
                 mesh=topo.get_topology().mesh)
             aux_losses = aux_sum[None]
         else:
-            def scan_fn(carry, layer_params_and_key):
-                lp, key = layer_params_and_key
-                if self.layer_transform is not None:
-                    lp = self.layer_transform(lp)
-                x, aux = block(carry, lp, cos, sin, key, deterministic)
-                return x, aux
+            def scan_for(win):
+                def scan_fn(carry, layer_params_and_key):
+                    lp, key = layer_params_and_key
+                    if self.layer_transform is not None:
+                        lp = self.layer_transform(lp)
+                    x, aux = block(carry, lp, cos, sin, key, deterministic,
+                                   win)
+                    return x, aux
+                return scan_fn
 
-            x, aux_losses = lax.scan(scan_fn, x, (params["layers"], layer_keys))
+            x, aux_losses = self._scan_layers(
+                scan_for, x, (params["layers"], layer_keys))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         logits = self._unembed(params, x)
         if return_aux:
             return logits, jnp.sum(aux_losses)
         return logits
+
+    def _scan_layers(self, body_for_window, carry, xs):
+        """``lax.scan`` over the stacked layer dim, split into the config's
+        contiguous constant-window segments (``window_segments``).
+        ``body_for_window(w)`` returns a scan body with the static window
+        ``w`` baked in — the Pallas kernels prune their KV grids from it.
+        Uniform windows take the single-scan fast path unchanged."""
+        segs = self.cfg.window_segments()
+        if len(segs) == 1:
+            return lax.scan(body_for_window(segs[0][2]), carry, xs)
+        ys = []
+        for (start, n, win) in segs:
+            seg_xs = jax.tree.map(lambda a: a[start:start + n], xs)
+            carry, y = lax.scan(body_for_window(win), carry, seg_xs)
+            ys.append(y)
+        return carry, jax.tree.map(lambda *a: jnp.concatenate(a, axis=0),
+                                   *ys)
 
     # -- KV-cache inference (reference inference v1: model_implementations/
     # transformers/ds_transformer.py decode path) ---------------------------
@@ -826,15 +892,17 @@ class CausalLM:
         if cfg.position == "learned":
             x = x + params["embed"]["wpe"][jnp.arange(T)].astype(cfg.dtype)
 
-        def body(carry, xs):
-            x = carry
-            lp, kc, vc = xs
-            x, k, v = self._block_kv(x, lp, cos, sin)
-            kc, vc = write_kv(kc, vc, k, v)
-            return x, (kc, vc)
+        def body_for(win):
+            def body(carry, xs):
+                x = carry
+                lp, kc, vc = xs
+                x, k, v = self._block_kv(x, lp, cos, sin, window=win)
+                kc, vc = write_kv(kc, vc, k, v)
+                return x, (kc, vc)
+            return body
 
-        x, (new_k, new_v) = lax.scan(body, x,
-                                     (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = self._scan_layers(
+            body_for, x, (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         logits = self._unembed(params, x)
@@ -863,14 +931,17 @@ class CausalLM:
         if cfg.position == "learned":
             x = x + params["embed"]["wpe"][jnp.asarray(pos)[None]].astype(cfg.dtype)
 
-        def body(carry, xs):
-            x = carry
-            lp, kc, vc = xs
-            x, kc, vc = self._block_decode(x, lp, kc, vc, cos, sin, pos, S)
-            return x, (kc, vc)
+        def body_for(win):
+            def body(carry, xs):
+                x = carry
+                lp, kc, vc = xs
+                x, kc, vc = self._block_decode(x, lp, kc, vc, cos, sin, pos,
+                                               S, window=win)
+                return x, (kc, vc)
+            return body
 
-        x, (new_k, new_v) = lax.scan(body, x,
-                                     (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = self._scan_layers(
+            body_for, x, (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         logits = self._unembed(params, x)[:, 0]
@@ -947,26 +1018,26 @@ class CausalLM:
         write_off = pos % bs
         n_tok = jnp.ones((B,), jnp.int32)
 
-        def body(carry, xs):
-            x = carry
-            lp, kc, vc = xs
-            h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm,
-                       cfg.norm_eps)
-            q, k, v = self._qkv(h1, lp, cos, sin, B, 1)
-            kc = kc.at[write_blk, :, write_off, :].set(k[:, 0])
-            vc = vc.at[write_blk, :, write_off, :].set(v[:, 0])
-            from ..ops.paged_attention import paged_attention
+        def body_for(win):
+            def body(carry, xs):
+                x = carry
+                lp, kc, vc = xs
+                h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
+                           cfg.norm, cfg.norm_eps)
+                q, k, v = self._qkv(h1, lp, cos, sin, B, 1)
+                kc = kc.at[write_blk, :, write_off, :].set(k[:, 0])
+                vc = vc.at[write_blk, :, write_off, :].set(v[:, 0])
+                from ..ops.paged_attention import paged_attention
 
-            attn = paged_attention(q, kc, vc, tables, pos, n_tok,
-                                   alibi_slopes=slopes,
-                                   window=cfg.sliding_window or 0)
-            attn = _linear(attn.reshape(B, 1, -1), lp["wo"], lp.get("wo_b"),
-                           cfg.dtype)
-            return self._attn_mlp_merge(x, attn, lp, h1), (kc, vc)
+                attn = paged_attention(q, kc, vc, tables, pos, n_tok,
+                                       alibi_slopes=slopes, window=win)
+                attn = _linear(attn.reshape(B, 1, -1), lp["wo"],
+                               lp.get("wo_b"), cfg.dtype)
+                return self._attn_mlp_merge(x, attn, lp, h1), (kc, vc)
+            return body
 
-        x, (new_k, new_v) = lax.scan(body, x,
-                                     (params["layers"], cache["k"],
-                                      cache["v"]))
+        x, (new_k, new_v) = self._scan_layers(
+            body_for, x, (params["layers"], cache["k"], cache["v"]))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         logits = self._unembed(params, x)[:, 0]
@@ -1018,18 +1089,18 @@ class CausalLM:
         y, _ = self._mlp_body(h2, lp, None, True)
         return x + attn_out + y
 
-    def _block_kv(self, x, lp, cos, sin):
+    def _block_kv(self, x, lp, cos, sin, window=0):
         """Forward block that also returns this layer's K/V (for prefill)."""
         cfg = self.cfg
         B, T, _ = x.shape
         h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"), cfg.norm, cfg.norm_eps)
         q, k, v = self._qkv(h1, lp, cos, sin, B, T)
-        attn = _attention(q, k, v, cfg, causal=True)
+        attn = _attention(q, k, v, cfg, causal=True, window=window)
         attn = _linear(attn.reshape(B, T, -1), lp["wo"], lp.get("wo_b"),
                        cfg.dtype)
         return self._attn_mlp_merge(x, attn, lp, h1), k, v
 
-    def _block_decode(self, x, lp, kc, vc, cos, sin, pos, S):
+    def _block_decode(self, x, lp, kc, vc, cos, sin, pos, S, window=0):
         """Decode block: single token attends over the cache."""
         cfg = self.cfg
         B = x.shape[0]
@@ -1038,8 +1109,8 @@ class CausalLM:
         kc = lax.dynamic_update_slice(kc, k, (0, pos, 0, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, pos, 0, 0))
         keep = jnp.arange(S) <= pos
-        if cfg.sliding_window:
-            keep = keep & (pos - jnp.arange(S) < cfg.sliding_window)
+        if window:
+            keep = keep & (pos - jnp.arange(S) < window)
         mask = keep[None, None, None, :]                     # [1,1,1,S]
         bias = None
         if cfg.position == "alibi":

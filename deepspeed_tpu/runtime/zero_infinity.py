@@ -110,6 +110,12 @@ class ZeroInfinityEngine:
             raise ValueError("ZeRO-Infinity streaming requires "
                              "tie_embeddings=False (wte would need to be "
                              "resident for both embed and head groups)")
+        if len(model.cfg.window_segments()) > 1:
+            raise ValueError(
+                "ZeRO-Infinity streaming requires a uniform sliding_window: "
+                "the group walk runs ONE compiled group_fwd program over "
+                "every layer group, so a mixed per-layer window schedule "
+                "cannot be baked in statically")
         self.module = model
         self.cfg = model.cfg
         self.config = config
@@ -246,10 +252,14 @@ class ZeroInfinityEngine:
         model = self.module
         cfg = self.cfg
 
+        # uniform across layers (mixed schedules rejected in __init__),
+        # so the one shared group_fwd program bakes it in statically
+        window = cfg.layer_windows()[0]
+
         def group_fwd(gp, x, cos, sin):
             def body(carry, lp):
                 y, _ = model._block(carry, lp, cos, sin,
-                                    jax.random.PRNGKey(0), True)
+                                    jax.random.PRNGKey(0), True, window)
                 return y, None
 
             out, _ = jax.lax.scan(body, x, gp)

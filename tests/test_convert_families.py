@@ -487,3 +487,98 @@ def test_stablelm_generate_matches_hf(tmp_path_factory):
         theirs = hf.generate(torch.tensor(prompt), max_new_tokens=7,
                              do_sample=False, eos_token_id=None).numpy()
     np.testing.assert_array_equal(ours, theirs)
+
+
+def test_qwen2_mixed_window_schedule_parity(tmp_path_factory):
+    """Qwen2 with 0 < max_window_layers < num_layers (HF: the first
+    max_window_layers layers use full attention, the rest SWA) imports as
+    a per-layer window tuple and matches HF logits at seq > window — the
+    r4 rejection in convert.py is gone. Reference window plumb-through:
+    inference/v2/model_implementations/mistral/model.py:202."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    cfg = Qwen2Config(vocab_size=120, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=4, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      use_sliding_window=True, sliding_window=8,
+                      max_window_layers=2, tie_word_embeddings=False,
+                      attn_implementation="eager")
+    torch.manual_seed(6)
+    hf = Qwen2ForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "qwen2_mixed_swa")
+    # seq=20 > window=8: the two SWA layers must mask past-window keys
+    # while the two full layers must not
+    model = _parity(path, hf, 120, seq=20)
+    assert model.cfg.layer_windows() == (0, 0, 8, 8)
+    assert model.cfg.window_segments() == ((0, 2, 0), (2, 2, 8))
+
+
+def test_qwen2_mixed_window_generate(tmp_path_factory):
+    """v1 generate through the mixed full/SWA layer schedule matches HF
+    greedy generation token-for-token (decode runs the segmented layer
+    scan with a per-segment window mask)."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = Qwen2Config(vocab_size=120, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=4, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      use_sliding_window=True, sliding_window=8,
+                      max_window_layers=2, tie_word_embeddings=False,
+                      attn_implementation="eager")
+    torch.manual_seed(8)
+    hf = Qwen2ForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "qwen2_mixed_swa_gen")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngine(model, params=params)
+    prompt = np.random.default_rng(21).integers(0, 120, size=(2, 12))
+    ours = np.asarray(engine.generate(jnp.asarray(prompt, jnp.int32),
+                                      max_new_tokens=8))
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt), max_new_tokens=8,
+                             do_sample=False, eos_token_id=None).numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_qwen2_mixed_window_v2_serving(tmp_path_factory):
+    """The v2 ragged engine serves the mixed full/SWA Qwen2 schedule (the
+    segmented layer scan passes each run's window to the paged kernel):
+    last-token logits match the HF forward at every decode step."""
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = Qwen2Config(vocab_size=120, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=4, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      use_sliding_window=True, sliding_window=8,
+                      max_window_layers=2, tie_word_embeddings=False,
+                      attn_implementation="eager")
+    torch.manual_seed(11)
+    hf = Qwen2ForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "qwen2_mixed_swa_v2")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngineV2(model, params=params,
+                               config=RaggedInferenceEngineConfig(
+                                   max_ragged_sequence_count=4,
+                                   max_chunk_tokens=32, kv_blocks=64,
+                                   kv_block_size=4))
+    rng = np.random.default_rng(7)
+    seq = rng.integers(0, 120, 20).tolist()      # 20 > window=8
+    logits = engine.put([1], [seq])
+    for step in range(4):
+        ref = _hf_logits(hf, np.asarray([seq]))[0, -1]
+        np.testing.assert_allclose(np.asarray(logits[0]), ref,
+                                   atol=4e-4, rtol=4e-4,
+                                   err_msg=f"decode step {step}")
+        if step == 3:
+            break
+        nxt = int(np.argmax(ref))
+        seq.append(nxt)
+        logits = engine.put([1], [[nxt]])

@@ -13,7 +13,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models import build_model, MODEL_CONFIGS
 from deepspeed_tpu.models.transformer import (
-    CausalLM, TINY_TEST, attention_reference, apply_rope, rope_table)
+    CausalLM, TINY_TEST, TransformerConfig, attention_reference, apply_rope,
+    rope_table)
 from deepspeed_tpu.ops.flash_attention import flash_attention, _attention_xla
 from deepspeed_tpu.parallel import topology as topo
 from deepspeed_tpu.parallel.sharding import ZeroShardingPlan, tree_shardings
@@ -151,3 +152,71 @@ def test_new_family_presets_forward():
         logits = model.apply(params, tokens)
         assert logits.shape == (2, 16, 128), name
         assert np.isfinite(np.asarray(logits)).all(), name
+
+
+def test_layer_windows_and_segments():
+    """Per-layer sliding-window schedules (Qwen2 mixed full/SWA): scalar
+    broadcast, tuple normalization, and the contiguous constant-window
+    run segmentation the layer scans compile from."""
+    cfg = TransformerConfig(num_layers=4, sliding_window=8)
+    assert cfg.layer_windows() == (8, 8, 8, 8)
+    assert cfg.window_segments() == ((0, 4, 8),)
+    cfg = TransformerConfig(num_layers=4)
+    assert cfg.window_segments() == ((0, 4, 0),)
+    cfg = TransformerConfig(num_layers=4, sliding_window=(None, None, 8, 8))
+    assert cfg.layer_windows() == (0, 0, 8, 8)
+    assert cfg.window_segments() == ((0, 2, 0), (2, 2, 8))
+    cfg = TransformerConfig(num_layers=4, sliding_window=(4, None, 4, None))
+    assert cfg.window_segments() == ((0, 1, 4), (1, 1, 0), (2, 1, 4),
+                                     (3, 1, 0))
+    with pytest.raises(ValueError, match="entries"):
+        TransformerConfig(num_layers=4, sliding_window=(8,)).layer_windows()
+
+
+def test_mixed_window_forward_matches_manual_mask():
+    """A mixed full/SWA schedule through the segmented layer scan equals
+    running the same layers with per-layer reference masks; remat composes
+    (the window is a static checkpoint arg)."""
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_layers=4, num_heads=4,
+                            max_seq_len=32, norm="rmsnorm",
+                            activation="silu", position="rope",
+                            sliding_window=(None, 6, None, 6),
+                            attention_impl="reference")
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+    out = model.apply(params, tokens)
+
+    # remat path must agree exactly (same program, checkpointed)
+    cfg_remat = dataclasses.replace(cfg, remat=True)
+    out_remat = CausalLM(cfg_remat).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_remat),
+                               atol=1e-6)
+
+    # ground truth: an explicit unrolled per-layer loop with each layer's
+    # own window — catches swapped segments / wrong window assignment
+    from deepspeed_tpu.models.transformer import _norm, rope_table
+
+    T = tokens.shape[1]
+    x = params["embed"]["wte"][tokens].astype(cfg.dtype)
+    cos_full, sin_full = rope_table(cfg.max_seq_len, cfg.rot_dim,
+                                    cfg.rope_theta)
+    cos, sin = cos_full[:T], sin_full[:T]
+    for i, win in enumerate(cfg.layer_windows()):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        x, _ = model._block(x, lp, cos, sin, jax.random.PRNGKey(0), True,
+                            win)
+    x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
+              cfg.norm, cfg.norm_eps)
+    expected = model._unembed(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=1e-5, rtol=1e-5)
+
+    # and the mixed schedule is genuinely distinct from both uniform ones
+    full = CausalLM(dataclasses.replace(cfg, sliding_window=None)).apply(
+        params, tokens)
+    swa = CausalLM(dataclasses.replace(cfg, sliding_window=6)).apply(
+        params, tokens)
+    assert not np.allclose(np.asarray(out), np.asarray(full))
+    assert not np.allclose(np.asarray(out), np.asarray(swa))
