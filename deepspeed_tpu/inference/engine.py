@@ -146,7 +146,7 @@ class InferenceEngine:
     DECODE_BLOCK = 128
 
     def _generate_fn(self, max_len: int, max_new: int, top_k: int,
-                     eos_token_id=None):
+                     eos_token_id=None, pad_token_id: int = 0):
         """Build (and cache) the jitted prefill+scan-decode program. Cache
         key is shapes + top_k + eos ids (each distinct eos set is its own
         compiled program); temperature stays a traced argument.
@@ -160,7 +160,7 @@ class InferenceEngine:
         if eos_token_id is not None and not isinstance(eos_token_id, int):
             # HF accepts lists of eos ids; normalize to a hashable tuple
             eos_token_id = tuple(int(e) for e in eos_token_id)
-        key = (max_len, max_new, top_k, eos_token_id)
+        key = (max_len, max_new, top_k, eos_token_id, pad_token_id)
         if key in self._gen_cache:
             return self._gen_cache[key]
         module = self.module
@@ -183,12 +183,12 @@ class InferenceEngine:
                 nxt = self._sample(cur, sub, temperature, top_k)
                 if eos_token_id is not None:
                     # HF semantics: the EOS itself is emitted; every token
-                    # after a finished sequence is pad (0). The scan keeps
+                    # after a finished sequence is pad. The scan keeps
                     # running (fixed shapes) but finished rows emit pad.
                     eos_ids = jnp.asarray(
                         eos_token_id if isinstance(eos_token_id, tuple)
                         else (eos_token_id,), jnp.int32)
-                    nxt = jnp.where(done, 0, nxt)
+                    nxt = jnp.where(done, pad_token_id, nxt)
                     done = done | jnp.isin(nxt, eos_ids)
                 pos = prompt_len + i               # per-sequence positions
                 logits, cache = module.decode_step_paged(
@@ -200,7 +200,7 @@ class InferenceEngine:
                 jnp.arange(max_new))
             out_tokens = out_tokens.T              # [B, max_new]
             # place each sequence's new tokens right after its prompt
-            out = jnp.zeros((B, T + max_new), jnp.int32)
+            out = jnp.full((B, T + max_new), pad_token_id, jnp.int32)
             out = out.at[:, :T].set(tokens)
             idx = prompt_len[:, None] + jnp.arange(max_new)[None, :]
             return jax.vmap(lambda row, i, v: row.at[i].set(v))(
@@ -212,22 +212,25 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, rng=None,
-                 prompt_len=None, eos_token_id=None, **kwargs):
+                 prompt_len=None, eos_token_id=None, pad_token_id: int = 0,
+                 **kwargs):
         """HF-style generate with ragged-prompt support.
 
         ``input_ids``: [B, T] array, or a list of per-sequence token
         sequences (ragged — right-padded internally, like the reference v1
         engine's variable-length serving). ``prompt_len`` [B] optionally
         marks the real length of each row of a padded [B, T] array.
-        ``eos_token_id``: sequences that emit it produce pad (0) for the
-        remaining steps (HF early-stop semantics under fixed shapes).
-        Returns [B, T + n] with each sequence's new tokens placed directly
-        after its prompt and pad id 0 beyond ``prompt_len[b] + n``."""
+        ``eos_token_id``: sequences that emit it produce ``pad_token_id``
+        for the remaining steps (HF early-stop semantics under fixed
+        shapes). Returns [B, T + n] with each sequence's new tokens placed
+        directly after its prompt and ``pad_token_id`` (default 0 — pass
+        the tokenizer's id when 0 is a real token) beyond
+        ``prompt_len[b] + n``."""
         if isinstance(input_ids, (list, tuple)) and input_ids \
                 and isinstance(input_ids[0], (list, tuple, np.ndarray)):
             lens = [len(p) for p in input_ids]
             T = max(lens)
-            padded = np.zeros((len(input_ids), T), np.int32)
+            padded = np.full((len(input_ids), T), pad_token_id, np.int32)
             for i, p in enumerate(input_ids):
                 padded[i, :len(p)] = p
             tokens = jnp.asarray(padded)
@@ -244,10 +247,10 @@ class InferenceEngine:
                 raise ValueError(
                     f"prompt_len must be [B]={B} values in [1, {T}]; got "
                     f"shape {pl.shape}, range [{pl.min()}, {pl.max()}]")
-            # pad id 0 past each prompt so the region beyond prompt_len+n
-            # is deterministic regardless of the caller's pad token
+            # re-pad past each prompt so the region beyond prompt_len+n
+            # is deterministic regardless of the caller's padding
             tokens = jnp.where(jnp.arange(T)[None, :] < prompt_len[:, None],
-                               tokens, 0)
+                               tokens, pad_token_id)
         ctx = self.module.cfg.max_seq_len
         if T >= ctx:
             raise ValueError(f"prompt length {T} >= max_seq_len {ctx}")
@@ -258,7 +261,8 @@ class InferenceEngine:
                 f"(context window {ctx}, prompt {T})")
         max_len = T + max_new
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        fn = self._generate_fn(max_len, max_new, top_k, eos_token_id)
+        fn = self._generate_fn(max_len, max_new, top_k, eos_token_id,
+                               int(pad_token_id))
         return fn(self.params, tokens, prompt_len, rng,
                   jnp.asarray(temperature, jnp.float32))
 

@@ -42,6 +42,29 @@ from ..utils.logging import logger
 DLTS_HOSTFILE = "/job/hostfile"
 
 
+def local_chip_count() -> int:
+    """Number of TPU chips attached to this host (0 when none/unknown).
+    TPU VMs expose one ``/dev/accel*`` (older runtimes: ``/dev/vfio/N``)
+    node per chip; no PJRT client is created — probing via jax would
+    *claim* the chips the spawned ranks need."""
+    import glob
+
+    return (len(glob.glob("/dev/accel[0-9]*"))
+            or len(glob.glob("/dev/vfio/[0-9]*")))
+
+
+def chip_assignment(chips: int, world: int, rank: int):
+    """Default per-rank ``TPU_VISIBLE_CHIPS`` value for ``--launcher
+    local``: an even slice of the host's chips per rank, or None when no
+    sane default exists (no chips detected, or more ranks than chips).
+    Without this, every spawned PJRT client tries to own ALL local chips
+    and single-host multi-process mode fails out of the box on TPU."""
+    if chips <= 0 or world > chips:
+        return None
+    per = chips // world
+    return ",".join(str(i) for i in range(rank * per, (rank + 1) * per))
+
+
 def fetch_hostfile(path: str) -> Dict[str, int]:
     """Parse ``host slots=N`` lines (reference launcher/runner.py:201)."""
     if not os.path.isfile(path):
@@ -280,18 +303,25 @@ def main(argv=None):
         world = args.num_local_procs
         coord = f"127.0.0.1:{args.master_port}"
 
+        chips = local_chip_count()
+
         def spawn_local():
             procs = []
             for rank in range(world):
-                # device partitioning is the script's job (TPU chip
-                # ownership is per-PJRT-client: set TPU_VISIBLE_CHIPS /
-                # XLA_FLAGS from LOCAL_RANK in the script or its wrapper)
                 env = dict(os.environ,
                            MASTER_ADDR="127.0.0.1",
                            MASTER_PORT=str(args.master_port),
                            COORDINATOR_ADDRESS=coord,
                            RANK=str(rank), LOCAL_RANK=str(rank),
                            WORLD_SIZE=str(world))
+                # TPU chip ownership is per-PJRT-client: by default give
+                # each rank an even slice of the local chips so N clients
+                # don't contend for the same hardware. The user's env
+                # (or the script itself) overrides.
+                if "TPU_VISIBLE_CHIPS" not in os.environ:
+                    vis = chip_assignment(chips, world, rank)
+                    if vis is not None:
+                        env["TPU_VISIBLE_CHIPS"] = vis
                 logger.info(f"launching local rank {rank}")
                 procs.append(subprocess.Popen(
                     build_cmd(args, rank, world, coord), env=env,

@@ -121,6 +121,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         m_cur = jnp.max(s, axis=-1, keepdims=True)              # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)                      # [bq, 128]
         alpha = jnp.exp(m_prev - m_new)
+        # Self-healing invariant (do not break): a q row fully masked in
+        # its first live KV block has m_new == NEG_INF, so p = exp(s -
+        # NEG_INF) = exp(0) = 1 transiently pollutes acc/l. This is
+        # harmless ONLY because (a) NEG_INF is finite (-1e30, never -inf:
+        # -inf - -inf = nan) and (b) the KV loop ascends j with the
+        # diagonal block always live, so a later block with finite max
+        # rescales the garbage by alpha = exp(NEG_INF - m) = 0 exactly.
+        # Reordering the loop or switching NEG_INF to -inf silently
+        # corrupts windowed outputs.
         p = jnp.exp(s - m_new[:, :1])                           # [bq, bkv]
         l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[...] = m_new

@@ -86,7 +86,9 @@ def _sign_compress_two_phase(c, e_srv, dp: int):
     n = c.size
     seg = _seg_len(n, dp)
     flat = jnp.pad(c.reshape(-1), (0, seg * dp - n))
-    scale = jnp.mean(jnp.abs(c))
+    # RMS scale ‖c‖/√numel — the reference's worker_scale
+    # (runtime/comm/nccl.py compressed_allreduce), not mean|c|
+    scale = jnp.sqrt(jnp.mean(jnp.square(c)))
     sign_pos = flat >= 0
     packed = jnp.packbits(sign_pos)                       # [dp·seg/8] uint8
     # phase 1: worker i keeps segment i of everyone's buffer
@@ -95,19 +97,25 @@ def _sign_compress_two_phase(c, e_srv, dp: int):
     signs = jnp.where(jnp.unpackbits(recv.reshape(-1)).astype(jnp.bool_),
                       1.0, -1.0).astype(c.dtype).reshape(dp, seg)
     seg_avg = jnp.mean(signs * scales[:, None], axis=0)   # [seg]
-    # phase 2: re-compress the averaged segment against the server error
+    # phase 2: re-compress the averaged segment against the server error.
+    # Per-chunk server scale (each worker compresses ITS segment with its
+    # own RMS scale, then the scales ride the gather — the reference's
+    # per-chunk server_scale), masked to the live (non-pad) positions.
     w = lax.axis_index(AXIS)
     live = (w * seg + jnp.arange(seg)) < n                # mask pad tail
+    n_live = jnp.sum(live.astype(jnp.float32))
     s = jnp.where(live, seg_avg + e_srv, 0.0)
-    scale2 = lax.pmean(jnp.sum(jnp.abs(s)), AXIS) * (dp / max(n, 1))
+    scale2 = jnp.sqrt(jnp.sum(jnp.square(s)) / jnp.maximum(n_live, 1.0))
     sign2_pos = s >= 0
     e_srv_new = jnp.where(live, s - jnp.where(sign2_pos, scale2, -scale2),
-                          0.0)
+                          0.0).astype(e_srv.dtype)   # n_live is strong f32;
+    # don't let it promote the server-error moment past its init dtype
     all_packed = lax.all_gather(jnp.packbits(sign2_pos), AXIS)  # [dp, seg/8]
+    scales2 = lax.all_gather(scale2, AXIS)                # [dp]
     full_signs = jnp.where(
         jnp.unpackbits(all_packed.reshape(-1)).astype(jnp.bool_),
-        scale2, -scale2).astype(c.dtype)
-    avg = full_signs[:n].reshape(c.shape)
+        1.0, -1.0).astype(c.dtype).reshape(dp, seg) * scales2[:, None]
+    avg = full_signs.reshape(-1)[:n].reshape(c.shape).astype(c.dtype)
     err = c - jnp.where(sign_pos[:n].reshape(c.shape), scale, -scale)
     return avg, err, e_srv_new
 

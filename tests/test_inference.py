@@ -388,3 +388,24 @@ def test_paged_model_attn_impl_override(model_and_params):
     logits2 = e1.put([5], [p])
     np.testing.assert_allclose(np.asarray(logits), np.asarray(logits2),
                                atol=1e-6)
+
+
+def test_generate_pad_token_id(model_and_params):
+    """pad_token_id threads through generate: the region beyond each
+    ragged prompt + its new tokens carries the caller's pad id (models
+    whose tokenizer uses a real token id 0 need this), and the generated
+    tokens themselves are unchanged."""
+    model, params = model_and_params
+    engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, CFG.vocab_size, n).tolist() for n in (3, 7)]
+    out0 = np.asarray(engine.generate(prompts, max_new_tokens=4))
+    out9 = np.asarray(engine.generate(prompts, max_new_tokens=4,
+                                      pad_token_id=99))
+    for i, p in enumerate(prompts):
+        n = len(p)
+        # same tokens where it matters
+        np.testing.assert_array_equal(out9[i, :n + 4], out0[i, :n + 4])
+        # pad region carries the chosen id
+        assert (out9[i, n + 4:] == 99).all()
+        assert (out0[i, n + 4:] == 0).all()

@@ -61,3 +61,19 @@ def test_env_report_runs(capsys):
     out = capsys.readouterr().out
     assert "deepspeed_tpu version" in out
     assert "accelerator" in out
+
+
+def test_chip_assignment_defaults():
+    """--launcher local per-rank TPU_VISIBLE_CHIPS defaults: an even slice
+    of the host's chips per rank; no default when chips are unknown or
+    oversubscribed (the script/env then owns partitioning)."""
+    from deepspeed_tpu.launcher.runner import chip_assignment
+
+    assert chip_assignment(4, 2, 0) == "0,1"
+    assert chip_assignment(4, 2, 1) == "2,3"
+    assert chip_assignment(4, 4, 3) == "3"
+    assert chip_assignment(8, 2, 1) == "4,5,6,7"
+    # 3 ranks on 4 chips: floor slice of 1 chip each, chip 3 idle
+    assert chip_assignment(4, 3, 2) == "2"
+    assert chip_assignment(0, 2, 0) is None     # no chips detected
+    assert chip_assignment(2, 4, 0) is None     # more ranks than chips

@@ -252,9 +252,10 @@ def test_two_phase_error_feedback_invariants(devices8):
         out_specs=(P("data"), P("data"), P("data")), check_vma=False)(cs, e2)
     avg, err, e2n = map(np.asarray, (avg, err, e2n))
 
-    # worker error: exact residual of the local compression
+    # worker error: exact residual of the local compression (RMS scale —
+    # the reference's worker_scale ‖c‖/√numel, nccl.py compressed_allreduce)
     for i in range(dp):
-        scale = np.mean(np.abs(cs[i]))
+        scale = np.sqrt(np.mean(np.asarray(cs[i]) ** 2))
         q = np.where(np.asarray(cs[i]) >= 0, scale, -scale)
         np.testing.assert_allclose(err[i], np.asarray(cs[i]) - q,
                                    rtol=1e-5, atol=1e-6)
@@ -264,7 +265,8 @@ def test_two_phase_error_feedback_invariants(devices8):
         np.testing.assert_array_equal(avg[0], avg[i])
 
     # avg + server error == phase-1 mean (pad positions excluded)
-    scales = np.array([np.mean(np.abs(cs[i])) for i in range(dp)])
+    scales = np.array([np.sqrt(np.mean(np.asarray(cs[i]) ** 2))
+                       for i in range(dp)])
     signs = np.where(np.asarray(cs) >= 0, 1.0, -1.0)
     phase1 = np.zeros(seg * dp, np.float32)
     phase1[:n] = np.mean(signs * scales[:, None], axis=0)
