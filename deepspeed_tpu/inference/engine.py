@@ -22,7 +22,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.transformer import CausalLM
 from ..parallel import topology as topo
 from ..parallel.sharding import ZeroShardingPlan
 from ..utils.logging import logger
@@ -74,8 +73,10 @@ class InferenceEngine:
         dtype = {"fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16,
                  "float32": jnp.float32, "float16": jnp.float16,
                  "bfloat16": jnp.bfloat16}.get(str(self.config.dtype), jnp.bfloat16)
-        if isinstance(self.module, CausalLM) and self.module.cfg.dtype != dtype:
-            self.module = CausalLM(dataclasses.replace(self.module.cfg, dtype=dtype))
+        if hasattr(self.module, "cfg") and self.module.cfg.dtype != dtype:
+            # works for CausalLM and EncoderLM alike (same ctor contract)
+            self.module = type(self.module)(
+                dataclasses.replace(self.module.cfg, dtype=dtype))
 
         spec_tree = (self.module.param_specs()
                      if hasattr(self.module, "param_specs") else None)
@@ -116,17 +117,47 @@ class InferenceEngine:
 
             params = quantize_param_tree(params, bits=self.config.quant.bits)
         self.params = params
-        self._decode_jit = jax.jit(self.module.decode_step)
-        self._prefill_jit = jax.jit(self.module.prefill)
+        self._is_encoder = not hasattr(self.module, "decode_step")
+        if not self._is_encoder:
+            self._decode_jit = jax.jit(self.module.decode_step)
+            self._prefill_jit = jax.jit(self.module.prefill)
+        else:
+            # encoder serving (reference ds_bert.py role): one jitted
+            # bidirectional forward, no cache/decode machinery
+            self._encode_jit = jax.jit(self.module.apply)
+            self._mlm_jit = (jax.jit(self.module.mlm_logits)
+                             if self.module.cfg.with_mlm_head else None)
         self._gen_cache: Dict[tuple, Any] = {}
 
     # ------------------------------------------------------------------ API
     def forward(self, tokens, *args, **kwargs):
-        """Plain forward → logits (reference engine forward)."""
+        """Plain forward → logits (reference engine forward). For encoder
+        models this is ``encode`` (hidden states + pooled output)."""
+        if self._is_encoder:
+            return self.encode(tokens, *args, **kwargs)
         tokens = jnp.asarray(tokens)
         return self.module.apply(self.params, tokens)
 
     __call__ = forward
+
+    def encode(self, input_ids, attention_mask=None, token_type_ids=None):
+        """Encoder forward: ``(hidden [B,T,H], pooled [B,H] | None)`` —
+        the BertModel serving surface (reference ds_bert.py)."""
+        if not self._is_encoder:
+            raise ValueError("encode() is for encoder models; use forward()")
+        args = [jnp.asarray(np.asarray(input_ids), jnp.int32)]
+        for a in (attention_mask, token_type_ids):
+            args.append(None if a is None
+                        else jnp.asarray(np.asarray(a), jnp.int32))
+        return self._encode_jit(self.params, *args)
+
+    def mlm(self, input_ids, attention_mask=None, token_type_ids=None):
+        """Masked-LM logits [B, T, V] (BertForMaskedLM serving surface)."""
+        if not self._is_encoder or self._mlm_jit is None:
+            raise ValueError("model has no MLM head (not an encoder, or "
+                             "with_mlm_head=False)")
+        hidden, _ = self.encode(input_ids, attention_mask, token_type_ids)
+        return self._mlm_jit(self.params, hidden)
 
     @staticmethod
     def _sample(logits, rng, temperature, top_k: int):
@@ -226,6 +257,9 @@ class InferenceEngine:
         directly after its prompt and ``pad_token_id`` (default 0 — pass
         the tokenizer's id when 0 is a real token) beyond
         ``prompt_len[b] + n``."""
+        if self._is_encoder:
+            raise ValueError("generate() needs a causal LM; encoder models "
+                             "serve via encode()/mlm()")
         if isinstance(input_ids, (list, tuple)) and input_ids \
                 and isinstance(input_ids[0], (list, tuple, np.ndarray)):
             lens = [len(p) for p in input_ids]

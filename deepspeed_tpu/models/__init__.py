@@ -24,8 +24,16 @@ from .transformer import (  # noqa: F401
     PHI_2,
 )
 
+from .encoder import (  # noqa: F401
+    EncoderConfig,
+    EncoderLM,
+    BERT_BASE,
+    BERT_LARGE,
+)
+
 from .convert import (  # noqa: F401
     config_from_hf,
+    encoder_config_from_hf,
     from_pretrained,
     is_hf_checkpoint,
     load_hf_checkpoint,

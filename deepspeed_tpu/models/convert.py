@@ -971,11 +971,153 @@ def config_from_hf(hf_config: Dict[str, Any],
                      f"(supported: {sorted(_FAMILIES)})")
 
 
+# ----------------------------------------------------- encoder (BERT) family
+
+def _encoder_arch(hf_config) -> str:
+    archs = hf_config.get("architectures") or [""]
+    return archs[0] if archs else ""
+
+
+def _encoder_prefix_and_heads(hf_config):
+    """(prefix, with_pooler, with_mlm_head) from the checkpoint's saved
+    architecture: ``BertModel``/``RobertaModel`` save unprefixed weights
+    with a pooler; the task models prefix with the model_type and the MLM
+    variants carry the prediction head instead of (BERT) or alongside
+    (RoBERTa has no pooler at all in ForMaskedLM) the pooler."""
+    mt = hf_config.get("model_type")
+    arch = _encoder_arch(hf_config)
+    if arch in ("BertModel", "RobertaModel"):
+        return "", True, False
+    if "ForMaskedLM" in arch:
+        return mt + ".", False, True
+    if "ForPreTraining" in arch:
+        return mt + ".", True, True
+    # Only these BERT task heads keep the pooler; BertForQuestionAnswering/
+    # TokenClassification and every RobertaFor* task model save with
+    # add_pooling_layer=False — assuming a pooler there would chase a
+    # missing tensor at load time.
+    pooled = arch in ("BertForSequenceClassification",
+                      "BertForNextSentencePrediction",
+                      "BertForMultipleChoice")
+    return mt + ".", pooled, False
+
+
+def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
+    """HF BERT/RoBERTa ``config.json`` → EncoderConfig (reference policy:
+    module_inject/containers/bert.py HFBertLayerPolicy)."""
+    from .encoder import EncoderConfig
+
+    mt = hf_config.get("model_type")
+    if mt not in _ENCODER_FAMILIES:
+        raise ValueError(f"not an encoder model_type: {mt!r}")
+    # RoBERTa offsets position ids by pad_token_id+1 (fairseq legacy);
+    # its max_position_embeddings already includes the offset
+    offset = (hf_config.get("pad_token_id", 1) + 1) if mt == "roberta" else 0
+    _, pooler, mlm = _encoder_prefix_and_heads(hf_config)
+    act = ("gelu_exact" if hf_config.get("hidden_act", "gelu") == "gelu"
+           else "gelu_new")
+    return EncoderConfig(
+        vocab_size=hf_config["vocab_size"],
+        hidden_size=hf_config["hidden_size"],
+        intermediate_size=hf_config["intermediate_size"],
+        num_layers=hf_config["num_hidden_layers"],
+        num_heads=hf_config["num_attention_heads"],
+        max_seq_len=hf_config.get("max_position_embeddings", 512) - offset,
+        type_vocab_size=hf_config.get("type_vocab_size", 2),
+        norm_eps=hf_config.get("layer_norm_eps", 1e-12),
+        activation=act, with_pooler=pooler, with_mlm_head=mlm,
+        position_offset=offset, dtype=dtype)
+
+
+def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
+    """HF BertModel/BertForMaskedLM (and the name-identical RoBERTa
+    encoder) → EncoderLM leaves. Reference setters:
+    model_implementations/transformers/ds_bert.py + containers/bert.py."""
+    p, _, _ = _encoder_prefix_and_heads(hf_config)
+    mt = hf_config.get("model_type")
+    L = p + "encoder.layer.{}."
+
+    def lsrc(fmt: str, transpose=True):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    def stacked(name, make):
+        return StackedLeafPlan(make, shapes["layers"][name].shape)
+
+    layers = {
+        "wq": stacked("wq", lsrc("attention.self.query.weight")),
+        "wq_b": stacked("wq_b", lsrc("attention.self.query.bias", False)),
+        "wk": stacked("wk", lsrc("attention.self.key.weight")),
+        "wk_b": stacked("wk_b", lsrc("attention.self.key.bias", False)),
+        "wv": stacked("wv", lsrc("attention.self.value.weight")),
+        "wv_b": stacked("wv_b", lsrc("attention.self.value.bias", False)),
+        "wo": stacked("wo", lsrc("attention.output.dense.weight")),
+        "wo_b": stacked("wo_b", lsrc("attention.output.dense.bias", False)),
+        "attn_ln_w": stacked("attn_ln_w",
+                             lsrc("attention.output.LayerNorm.weight",
+                                  False)),
+        "attn_ln_b": stacked("attn_ln_b",
+                             lsrc("attention.output.LayerNorm.bias", False)),
+        "w_in": stacked("w_in", lsrc("intermediate.dense.weight")),
+        "w_in_b": stacked("w_in_b", lsrc("intermediate.dense.bias", False)),
+        "w_out": stacked("w_out", lsrc("output.dense.weight")),
+        "w_out_b": stacked("w_out_b", lsrc("output.dense.bias", False)),
+        "mlp_ln_w": stacked("mlp_ln_w", lsrc("output.LayerNorm.weight",
+                                             False)),
+        "mlp_ln_b": stacked("mlp_ln_b", lsrc("output.LayerNorm.bias",
+                                             False)),
+    }
+    E = p + "embeddings."
+    plans = {
+        "embed": {
+            "wte": LeafPlan(Src(E + "word_embeddings.weight"),
+                            shapes["embed"]["wte"].shape),
+            "wpe": LeafPlan(Src(E + "position_embeddings.weight"),
+                            shapes["embed"]["wpe"].shape),
+            "tte": LeafPlan(Src(E + "token_type_embeddings.weight"),
+                            shapes["embed"]["tte"].shape),
+            "ln_w": LeafPlan(Src(E + "LayerNorm.weight"),
+                             shapes["embed"]["ln_w"].shape),
+            "ln_b": LeafPlan(Src(E + "LayerNorm.bias"),
+                             shapes["embed"]["ln_b"].shape),
+        },
+        "layers": layers,
+    }
+    if cfg.with_pooler:
+        plans["pooler"] = {
+            "w": LeafPlan(Src(p + "pooler.dense.weight", transpose=True),
+                          shapes["pooler"]["w"].shape),
+            "b": LeafPlan(Src(p + "pooler.dense.bias"),
+                          shapes["pooler"]["b"].shape),
+        }
+    if cfg.with_mlm_head:
+        if mt == "roberta":
+            head = {"w": "lm_head.dense.weight", "b": "lm_head.dense.bias",
+                    "ln_w": "lm_head.layer_norm.weight",
+                    "ln_b": "lm_head.layer_norm.bias",
+                    "bias": "lm_head.bias"}
+        else:
+            head = {"w": "cls.predictions.transform.dense.weight",
+                    "b": "cls.predictions.transform.dense.bias",
+                    "ln_w": "cls.predictions.transform.LayerNorm.weight",
+                    "ln_b": "cls.predictions.transform.LayerNorm.bias",
+                    "bias": "cls.predictions.bias"}
+        plans["mlm"] = {
+            k: LeafPlan(Src(v, transpose=(k == "w")),
+                        shapes["mlm"][k].shape)
+            for k, v in head.items()}
+    return plans
+
+
+_ENCODER_FAMILIES = {"bert": _encoder_plans, "roberta": _encoder_plans}
+
+
 # ------------------------------------------------------------------ top level
 
-def build_leaf_plans(model: CausalLM, model_type: str,
+def build_leaf_plans(model, model_type: str,
                      hf_config=None) -> Dict[str, Any]:
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    if model_type in _ENCODER_FAMILIES:
+        return _ENCODER_FAMILIES[model_type](model.cfg, shapes, hf_config)
     if model_type not in _FAMILIES:
         raise ValueError(f"unsupported model_type {model_type!r}")
     return _FAMILIES[model_type](model.cfg, shapes, hf_config)
@@ -1006,7 +1148,12 @@ def load_hf_checkpoint(path: str,
     if model_type is None:
         raise ValueError(f"{path} has no config.json; pass model_type=")
     if model is None:
-        model = CausalLM(config_from_hf(hf_cfg))
+        if model_type in _ENCODER_FAMILIES:
+            from .encoder import EncoderLM
+
+            model = EncoderLM(encoder_config_from_hf(hf_cfg))
+        else:
+            model = CausalLM(config_from_hf(hf_cfg))
     if param_dtype is None:
         param_dtype = model.cfg.dtype
 
@@ -1065,22 +1212,39 @@ def from_pretrained(path: str, sharding_plan=None, param_dtype=None,
     cfg_file = os.path.join(path, "config.json")
     with open(cfg_file) as f:
         hf_cfg = json.load(f)
-    cfg = config_from_hf(hf_cfg)
-    if config_overrides:
-        cfg = dataclasses.replace(cfg, **config_overrides)
-    model = CausalLM(cfg)
+    if hf_cfg.get("model_type") in _ENCODER_FAMILIES:
+        from .encoder import EncoderLM
+
+        cfg = encoder_config_from_hf(hf_cfg)
+        if config_overrides:
+            cfg = dataclasses.replace(cfg, **config_overrides)
+        model = EncoderLM(cfg)
+    else:
+        cfg = config_from_hf(hf_cfg)
+        if config_overrides:
+            cfg = dataclasses.replace(cfg, **config_overrides)
+        model = CausalLM(cfg)
     return load_hf_checkpoint(path, model=model, sharding_plan=sharding_plan,
                               param_dtype=param_dtype,
                               model_type=hf_cfg.get("model_type"))
 
 
-def model_from_checkpoint(path: str, dtype=None) -> CausalLM:
-    """Build (only) the CausalLM described by a checkpoint dir's config.json."""
+def model_from_checkpoint(path: str, dtype=None):
+    """Build (only) the model described by a checkpoint dir's config.json
+    (CausalLM, or EncoderLM for the BERT family)."""
     cfg_file = os.path.join(path, "config.json")
     if not os.path.exists(cfg_file):
         raise ValueError(f"{path} has no config.json")
     with open(cfg_file) as f:
-        cfg = config_from_hf(json.load(f))
+        hf_cfg = json.load(f)
+    if hf_cfg.get("model_type") in _ENCODER_FAMILIES:
+        from .encoder import EncoderLM
+
+        cfg = encoder_config_from_hf(hf_cfg)
+        if dtype is not None:
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+        return EncoderLM(cfg)
+    cfg = config_from_hf(hf_cfg)
     if dtype is not None:
         cfg = dataclasses.replace(cfg, dtype=dtype)
     return CausalLM(cfg)

@@ -1,0 +1,248 @@
+"""TPU-native bidirectional encoder (BERT family).
+
+Counterpart of the reference's encoder serving surface — the BERT/
+DistilBERT/RoBERTa injection policies (``deepspeed/module_inject/containers/
+bert.py``, ``distil_bert.py``) and the fused inference module
+(``deepspeed/model_implementations/transformers/ds_bert.py:1``) whose job is
+a faster BertLayer forward. Here the whole encoder is one jitted functional
+program: XLA fuses the add+LayerNorm and bias+gelu chains the reference
+hand-fuses in CUDA, and the layer stack is a ``lax.scan`` over stacked
+params (O(1) compile in depth), sharded via the same logical-axis rules as
+the causal models.
+
+Architecture notes vs ``transformer.CausalLM``:
+- **post-LN** residual wiring (``h = LN(x + sub(x))``) — BERT predates the
+  pre-LN convention the decoder families use; the residual stream is
+  normalized AFTER each sublayer, so the block is not a config switch on
+  CausalLM but its own small scan body.
+- **bidirectional** attention with a key-padding mask (HF
+  ``attention_mask`` semantics: 1 = attend). Attention runs through the
+  pure-XLA reference path — at BERT sequence lengths (≤512) the fused
+  XLA softmax is within noise of the Pallas flash kernel, and the
+  padding mask (which the flash kernel's band predicate cannot express)
+  comes for free.
+- learned positions + token-type embeddings + embedding LayerNorm.
+- heads: tanh pooler over [CLS] (``BertPooler``) and the masked-LM
+  transform head (``cls.predictions``) with the decoder tied to the word
+  embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.sharding import spec
+from .transformer import _linear, _norm, attention_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    norm_eps: float = 1e-12
+    activation: str = "gelu_exact"       # HF BERT "gelu" is the erf form
+    with_pooler: bool = True
+    with_mlm_head: bool = False
+    # RoBERTa offsets positions by pad_token_id+1 (fairseq legacy): position
+    # ids start at padding_idx+1 instead of 0
+    position_offset: int = 0
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        h, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = 4 * h * h + 4 * h + 2 * h * m + m + h + 4 * h
+        emb = (v + self.max_seq_len + self.position_offset
+               + self.type_vocab_size) * h + 2 * h
+        pool = (h * h + h) if self.with_pooler else 0
+        mlm = (h * h + h + 2 * h + v) if self.with_mlm_head else 0
+        return self.num_layers * per_layer + emb + pool + mlm
+
+
+BERT_BASE = EncoderConfig()
+BERT_LARGE = EncoderConfig(hidden_size=1024, intermediate_size=4096,
+                           num_layers=24, num_heads=16)
+
+
+class EncoderLM:
+    """Functional bidirectional encoder. ``init(rng) -> params``;
+    ``apply(params, tokens, attention_mask, token_type_ids) ->
+    (hidden [B,T,H], pooled [B,H] | None)``; ``mlm_logits(params, hidden)
+    -> [B,T,V]`` when the MLM head is configured."""
+
+    def __init__(self, cfg: EncoderConfig):
+        self.cfg = cfg
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng) -> Dict[str, Any]:
+        cfg = self.cfg
+        h, m, v, L = (cfg.hidden_size, cfg.intermediate_size,
+                      cfg.vocab_size, cfg.num_layers)
+        keys = jax.random.split(rng, 12)
+        std = 0.02
+
+        def normal(key, shape, scale=std):
+            return (scale * jax.random.normal(key, shape)).astype(jnp.float32)
+
+        def layer_stack(key, shape, scale=std):
+            return (scale * jax.random.normal(key, (L,) + shape)
+                    ).astype(jnp.float32)
+
+        layers = {
+            "wq": layer_stack(keys[0], (h, h)),
+            "wk": layer_stack(keys[1], (h, h)),
+            "wv": layer_stack(keys[2], (h, h)),
+            "wo": layer_stack(keys[3], (h, h), scale=std / math.sqrt(2 * L)),
+            "w_in": layer_stack(keys[4], (h, m)),
+            "w_out": layer_stack(keys[5], (m, h),
+                                 scale=std / math.sqrt(2 * L)),
+            "attn_ln_w": jnp.ones((L, h), jnp.float32),
+            "attn_ln_b": jnp.zeros((L, h), jnp.float32),
+            "mlp_ln_w": jnp.ones((L, h), jnp.float32),
+            "mlp_ln_b": jnp.zeros((L, h), jnp.float32),
+        }
+        for name, dim in (("wq_b", h), ("wk_b", h), ("wv_b", h),
+                          ("wo_b", h), ("w_in_b", m), ("w_out_b", h)):
+            layers[name] = jnp.zeros((L, dim), jnp.float32)
+
+        params = {
+            "embed": {
+                "wte": normal(keys[6], (v, h)),
+                "wpe": normal(keys[7],
+                              (cfg.max_seq_len + cfg.position_offset, h)),
+                "tte": normal(keys[8], (cfg.type_vocab_size, h)),
+                "ln_w": jnp.ones((h,), jnp.float32),
+                "ln_b": jnp.zeros((h,), jnp.float32),
+            },
+            "layers": layers,
+        }
+        if cfg.with_pooler:
+            params["pooler"] = {"w": normal(keys[9], (h, h)),
+                                "b": jnp.zeros((h,), jnp.float32)}
+        if cfg.with_mlm_head:
+            params["mlm"] = {"w": normal(keys[10], (h, h)),
+                             "b": jnp.zeros((h,), jnp.float32),
+                             "ln_w": jnp.ones((h,), jnp.float32),
+                             "ln_b": jnp.zeros((h,), jnp.float32),
+                             "bias": jnp.zeros((v,), jnp.float32)}
+        return params
+
+    # -- sharding specs -----------------------------------------------------
+    def param_specs(self) -> Dict[str, Any]:
+        """Logical-axis spec tree mirroring ``init`` (same TP rules as the
+        causal family: column QKV/MLP-in, row proj/MLP-out)."""
+        cfg = self.cfg
+        layers = {
+            "wq": spec("layers", "embed", "heads"),
+            "wk": spec("layers", "embed", "heads"),
+            "wv": spec("layers", "embed", "heads"),
+            "wo": spec("layers", "heads", "embed"),
+            "w_in": spec("layers", "embed", "mlp"),
+            "w_out": spec("layers", "mlp", "embed"),
+            "attn_ln_w": spec("layers", "embed"),
+            "attn_ln_b": spec("layers", "embed"),
+            "mlp_ln_w": spec("layers", "embed"),
+            "mlp_ln_b": spec("layers", "embed"),
+            "wq_b": spec("layers", "heads"),
+            "wk_b": spec("layers", "heads"),
+            "wv_b": spec("layers", "heads"),
+            "wo_b": spec("layers", "embed"),
+            "w_in_b": spec("layers", "mlp"),
+            "w_out_b": spec("layers", "embed"),
+        }
+        specs = {
+            "embed": {"wte": spec("vocab", "embed"),
+                      "wpe": spec(None, "embed"),
+                      "tte": spec(None, "embed"),
+                      "ln_w": spec("embed"), "ln_b": spec("embed")},
+            "layers": layers,
+        }
+        if cfg.with_pooler:
+            specs["pooler"] = {"w": spec("embed", "embed"),
+                               "b": spec("embed")}
+        if cfg.with_mlm_head:
+            specs["mlm"] = {"w": spec("embed", "embed"), "b": spec("embed"),
+                            "ln_w": spec("embed"), "ln_b": spec("embed"),
+                            "bias": spec("vocab")}
+        return specs
+
+    # -- forward ------------------------------------------------------------
+    def _act(self, y):
+        if self.cfg.activation == "gelu_exact":
+            return jax.nn.gelu(y, approximate=False)
+        return jax.nn.gelu(y, approximate=True)
+
+    def apply(self, params, tokens, attention_mask=None, token_type_ids=None):
+        """tokens [B, T] int32; ``attention_mask`` [B, T] (1 = attend, HF
+        semantics; None = all live); ``token_type_ids`` [B, T] (None = 0).
+        Returns ``(hidden [B, T, H], pooled [B, H] or None)``."""
+        cfg = self.cfg
+        B, T = tokens.shape
+        dt = cfg.dtype
+        nh, hd = cfg.num_heads, cfg.head_dim
+
+        pos = jnp.arange(T) + cfg.position_offset
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros((B, T), jnp.int32))
+        x = (params["embed"]["wte"][tokens]
+             + params["embed"]["wpe"][pos][None]
+             + params["embed"]["tte"][tt]).astype(dt)
+        x = _norm(x, params["embed"]["ln_w"], params["embed"]["ln_b"],
+                  "layernorm", cfg.norm_eps)
+
+        # key-padding mask [B, 1, 1, T] — broadcast over (head, q)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask.astype(bool)[:, None, None, :]
+
+        def block(x, lp):
+            q = _linear(x, lp["wq"], lp["wq_b"], dt).reshape(B, T, nh, hd)
+            k = _linear(x, lp["wk"], lp["wk_b"], dt).reshape(B, T, nh, hd)
+            v = _linear(x, lp["wv"], lp["wv_b"], dt).reshape(B, T, nh, hd)
+            attn = attention_reference(q, k, v, causal=False, mask=mask)
+            attn = _linear(attn.reshape(B, T, nh * hd), lp["wo"],
+                           lp["wo_b"], dt)
+            h = _norm(x + attn, lp["attn_ln_w"], lp["attn_ln_b"],
+                      "layernorm", cfg.norm_eps)
+            y = self._act(_linear(h, lp["w_in"], lp["w_in_b"], dt))
+            y = _linear(y, lp["w_out"], lp["w_out_b"], dt)
+            return _norm(h + y, lp["mlp_ln_w"], lp["mlp_ln_b"],
+                         "layernorm", cfg.norm_eps), None
+
+        x, _ = lax.scan(block, x, params["layers"])
+
+        pooled = None
+        if cfg.with_pooler and "pooler" in params:
+            pooled = jnp.tanh(_linear(x[:, 0], params["pooler"]["w"],
+                                      params["pooler"]["b"], dt))
+        return x, pooled
+
+    def mlm_logits(self, params, hidden):
+        """Masked-LM head on encoder output (``cls.predictions``): dense →
+        gelu → LayerNorm → decoder tied to wte (+ output bias)."""
+        cfg = self.cfg
+        if "mlm" not in params:
+            raise ValueError("model built without with_mlm_head=True")
+        mp = params["mlm"]
+        h = self._act(_linear(hidden, mp["w"], mp["b"], cfg.dtype))
+        h = _norm(h, mp["ln_w"], mp["ln_b"], "layernorm", cfg.norm_eps)
+        return (h @ params["embed"]["wte"].T.astype(cfg.dtype)
+                + mp["bias"].astype(cfg.dtype))
+
+    # convenience
+    def num_params(self) -> int:
+        return self.cfg.num_params()
