@@ -1014,8 +1014,15 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
     # its max_position_embeddings already includes the offset
     offset = (hf_config.get("pad_token_id", 1) + 1) if mt == "roberta" else 0
     _, pooler, mlm = _encoder_prefix_and_heads(hf_config)
-    act = ("gelu_exact" if hf_config.get("hidden_act", "gelu") == "gelu"
-           else "gelu_new")
+    act = {"gelu": "gelu_exact", "gelu_new": "gelu_new",
+           "gelu_pytorch_tanh": "gelu_new", "relu": "relu",
+           "silu": "silu", "swish": "silu"}.get(
+        hf_config.get("hidden_act", "gelu"))
+    if act is None:
+        raise ValueError(
+            f"unsupported encoder hidden_act "
+            f"{hf_config.get('hidden_act')!r} — loading it as gelu would "
+            "silently diverge from HF")
     return EncoderConfig(
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
@@ -1026,6 +1033,7 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
         type_vocab_size=hf_config.get("type_vocab_size", 2),
         norm_eps=hf_config.get("layer_norm_eps", 1e-12),
         activation=act, with_pooler=pooler, with_mlm_head=mlm,
+        tie_mlm_decoder=hf_config.get("tie_word_embeddings", True),
         position_offset=offset, dtype=dtype)
 
 
@@ -1101,8 +1109,12 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                     "ln_w": "cls.predictions.transform.LayerNorm.weight",
                     "ln_b": "cls.predictions.transform.LayerNorm.bias",
                     "bias": "cls.predictions.bias"}
+        if not cfg.tie_mlm_decoder:
+            # untied decoder stores its own [V, H] weight (ours is [H, V])
+            head["decoder"] = ("lm_head.decoder.weight" if mt == "roberta"
+                               else "cls.predictions.decoder.weight")
         plans["mlm"] = {
-            k: LeafPlan(Src(v, transpose=(k == "w")),
+            k: LeafPlan(Src(v, transpose=(k in ("w", "decoder"))),
                         shapes["mlm"][k].shape)
             for k, v in head.items()}
     return plans

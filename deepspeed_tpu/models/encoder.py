@@ -51,9 +51,10 @@ class EncoderConfig:
     max_seq_len: int = 512
     type_vocab_size: int = 2
     norm_eps: float = 1e-12
-    activation: str = "gelu_exact"       # HF BERT "gelu" is the erf form
+    activation: str = "gelu_exact"  # gelu_exact | gelu_new | relu | silu
     with_pooler: bool = True
     with_mlm_head: bool = False
+    tie_mlm_decoder: bool = True         # False: distinct decoder weight
     # RoBERTa offsets positions by pad_token_id+1 (fairseq legacy): position
     # ids start at padding_idx+1 instead of 0
     position_offset: int = 0
@@ -70,6 +71,8 @@ class EncoderConfig:
                + self.type_vocab_size) * h + 2 * h
         pool = (h * h + h) if self.with_pooler else 0
         mlm = (h * h + h + 2 * h + v) if self.with_mlm_head else 0
+        if self.with_mlm_head and not self.tie_mlm_decoder:
+            mlm += h * v
         return self.num_layers * per_layer + emb + pool + mlm
 
 
@@ -139,6 +142,8 @@ class EncoderLM:
                              "ln_w": jnp.ones((h,), jnp.float32),
                              "ln_b": jnp.zeros((h,), jnp.float32),
                              "bias": jnp.zeros((v,), jnp.float32)}
+            if not cfg.tie_mlm_decoder:
+                params["mlm"]["decoder"] = normal(keys[11], (h, v))
         return params
 
     # -- sharding specs -----------------------------------------------------
@@ -178,13 +183,22 @@ class EncoderLM:
             specs["mlm"] = {"w": spec("embed", "embed"), "b": spec("embed"),
                             "ln_w": spec("embed"), "ln_b": spec("embed"),
                             "bias": spec("vocab")}
+            if not cfg.tie_mlm_decoder:
+                specs["mlm"]["decoder"] = spec("embed", "vocab")
         return specs
 
     # -- forward ------------------------------------------------------------
     def _act(self, y):
-        if self.cfg.activation == "gelu_exact":
+        act = self.cfg.activation
+        if act == "gelu_exact":
             return jax.nn.gelu(y, approximate=False)
-        return jax.nn.gelu(y, approximate=True)
+        if act == "gelu_new":
+            return jax.nn.gelu(y, approximate=True)
+        if act == "relu":
+            return jax.nn.relu(y)
+        if act == "silu":
+            return jax.nn.silu(y)
+        raise ValueError(f"unknown encoder activation {act!r}")
 
     def apply(self, params, tokens, attention_mask=None, token_type_ids=None):
         """tokens [B, T] int32; ``attention_mask`` [B, T] (1 = attend, HF
@@ -192,14 +206,29 @@ class EncoderLM:
         Returns ``(hidden [B, T, H], pooled [B, H] or None)``."""
         cfg = self.cfg
         B, T = tokens.shape
+        if T > cfg.max_seq_len:
+            raise ValueError(f"sequence length {T} > max_seq_len "
+                             f"{cfg.max_seq_len} (JAX would silently clamp "
+                             "the position gather)")
         dt = cfg.dtype
         nh, hd = cfg.num_heads, cfg.head_dim
 
-        pos = jnp.arange(T) + cfg.position_offset
+        if cfg.position_offset:
+            # RoBERTa (fairseq legacy): live token i gets position
+            # (number of live tokens up to i) + padding_idx, pads get
+            # padding_idx — HF create_position_ids_from_input_ids,
+            # computed here from the attention mask (equivalent for the
+            # standard pad-is-masked convention)
+            live = (attention_mask if attention_mask is not None
+                    else jnp.ones((B, T), jnp.int32)).astype(jnp.int32)
+            pad_idx = cfg.position_offset - 1
+            pos = jnp.cumsum(live, axis=1) * live + pad_idx      # [B, T]
+            pe = params["embed"]["wpe"][pos]
+        else:
+            pe = params["embed"]["wpe"][jnp.arange(T)][None]
         tt = (token_type_ids if token_type_ids is not None
               else jnp.zeros((B, T), jnp.int32))
-        x = (params["embed"]["wte"][tokens]
-             + params["embed"]["wpe"][pos][None]
+        x = (params["embed"]["wte"][tokens] + pe
              + params["embed"]["tte"][tt]).astype(dt)
         x = _norm(x, params["embed"]["ln_w"], params["embed"]["ln_b"],
                   "layernorm", cfg.norm_eps)
@@ -240,8 +269,9 @@ class EncoderLM:
         mp = params["mlm"]
         h = self._act(_linear(hidden, mp["w"], mp["b"], cfg.dtype))
         h = _norm(h, mp["ln_w"], mp["ln_b"], "layernorm", cfg.norm_eps)
-        return (h @ params["embed"]["wte"].T.astype(cfg.dtype)
-                + mp["bias"].astype(cfg.dtype))
+        dec = (params["embed"]["wte"].T if "decoder" not in mp
+               else mp["decoder"])
+        return h @ dec.astype(cfg.dtype) + mp["bias"].astype(cfg.dtype)
 
     # convenience
     def num_params(self) -> int:

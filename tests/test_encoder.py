@@ -231,3 +231,75 @@ def test_init_inference_encoder_from_checkpoint(tmp_path_factory):
     np.testing.assert_allclose(np.asarray(hidden),
                                out.last_hidden_state.numpy(),
                                atol=4e-4, rtol=4e-4)
+
+
+def test_bert_untied_mlm_decoder(tmp_path_factory):
+    """tie_word_embeddings=False: the distinct cls.predictions.decoder
+    weight is loaded (not silently replaced by wte^T)."""
+    from transformers import BertForMaskedLM
+
+    torch.manual_seed(7)
+    hf = BertForMaskedLM(_bert_cfg(tie_word_embeddings=False)).eval()
+    with torch.no_grad():   # untie for real
+        hf.cls.predictions.decoder.weight = torch.nn.Parameter(
+            torch.randn_like(hf.cls.predictions.decoder.weight) * 0.1)
+    path = _save(hf, tmp_path_factory, "bert_untied")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert not model.cfg.tie_mlm_decoder and "decoder" in params["mlm"]
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, 99, (2, 9))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens)).logits.numpy()
+    hidden, _ = model.apply(params, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(model.mlm_logits(params, hidden)),
+                               theirs, atol=4e-4, rtol=4e-4)
+
+
+def test_roberta_padded_positions(tmp_path_factory):
+    """RoBERTa position ids follow the pad-aware HF rule (cumsum of live
+    tokens + padding_idx), so right-padded batches match HF exactly."""
+    from transformers import RobertaConfig, RobertaForMaskedLM
+
+    cfg = RobertaConfig(vocab_size=120, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=50,
+                        type_vocab_size=1, pad_token_id=1,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    torch.manual_seed(8)
+    hf = RobertaForMaskedLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "roberta_pad")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(2, 120, (2, 10))
+    mask = np.ones((2, 10), np.int64)
+    mask[0, 7:] = 0
+    tokens[0, 7:] = 1                            # the pad id
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens),
+                    attention_mask=torch.tensor(mask)).logits.numpy()
+    hidden, _ = model.apply(params, jnp.asarray(tokens, jnp.int32),
+                            jnp.asarray(mask, jnp.int32))
+    ours = np.asarray(model.mlm_logits(params, hidden))
+    for b in range(2):
+        live = int(mask[b].sum())
+        np.testing.assert_allclose(ours[b, :live], theirs[b, :live],
+                                   atol=4e-4, rtol=4e-4)
+
+
+def test_encoder_rejects_overlong_and_unknown_act():
+    cfg = EncoderConfig(vocab_size=50, hidden_size=16,
+                        intermediate_size=32, num_layers=1, num_heads=2,
+                        max_seq_len=8)
+    model = EncoderLM(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        model.apply(params, jnp.zeros((1, 9), jnp.int32))
+    from deepspeed_tpu.models.convert import encoder_config_from_hf
+    with pytest.raises(ValueError, match="hidden_act"):
+        encoder_config_from_hf({"model_type": "bert", "vocab_size": 10,
+                                "hidden_size": 16,
+                                "intermediate_size": 32,
+                                "num_hidden_layers": 1,
+                                "num_attention_heads": 2,
+                                "hidden_act": "tanh"})
