@@ -148,11 +148,21 @@ DSModuleRegistry.register("flash_attention", "xla_reference",
 
 
 def _moe_dropless_supports(moe_dropless=False, expert_parallel=1, **_):
-    # ragged_dot has no expert mesh axis path yet — EP stays on capacity
-    return bool(moe_dropless) and expert_parallel <= 1
+    # r5: EP composes via the partial-manual expert-axis shard_map
+    # (moe/grouped.py dropless_moe_mlp_ep)
+    return bool(moe_dropless)
 
 
-def _moe_dropless_factory(**_):
+def _moe_dropless_factory(expert_parallel=1, mesh=None, **_):
+    if expert_parallel > 1:
+        from functools import partial
+
+        from ...moe.grouped import dropless_moe_mlp_ep
+        from ...parallel import topology as topo
+
+        if mesh is None:
+            mesh = topo.get_topology().mesh
+        return partial(dropless_moe_mlp_ep, mesh=mesh)
     from ...moe.grouped import dropless_moe_mlp
 
     return dropless_moe_mlp

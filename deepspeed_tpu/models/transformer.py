@@ -718,14 +718,27 @@ class CausalLM:
         if cfg.moe_dropless:
             from ..parallel import topology as topo
 
-            if (topo.has_topology()
-                    and topo.get_topology().get_expert_parallel_world_size() > 1):
-                raise ValueError(
-                    "moe_dropless (ragged_dot grouped GEMM) runs per-shard; "
-                    "use the capacity path for expert parallelism "
-                    "(moe/grouped.py docstring)")
             if cfg.moe_top_k != 1:
                 raise ValueError("moe_dropless supports top-1 routing")
+            ep = (topo.get_topology().get_expert_parallel_world_size()
+                  if topo.has_topology() else 1)
+            if ep > 1:
+                # expert-parallel dropless: partial-manual shard_map over
+                # the expert axis (gather → per-shard ragged_dot →
+                # psum_scatter; moe/grouped.py docstring)
+                if _pipe_parallel_size() > 1:
+                    raise NotImplementedError(
+                        "dropless MoE + expert parallelism does not "
+                        "compose with pipeline parallelism: the pipe loop "
+                        "already runs inside shard_map and cannot nest "
+                        "the expert-axis shard_map; use the capacity path")
+                from ..moe.grouped import dropless_moe_mlp_ep
+
+                y, l_aux = dropless_moe_mlp_ep(
+                    tokens, logits, lp["w_in"], lp["w_out"],
+                    lp.get("w_gate"), mesh=topo.get_topology().mesh,
+                    activation=cfg.activation, dtype=dt)
+                return y.reshape(B, T, M), l_aux
             from ..moe.grouped import dropless_moe_mlp
 
             y, l_aux = dropless_moe_mlp(

@@ -9,11 +9,27 @@ sorts tokens by their routed expert and runs ``jax.lax.ragged_dot``
 (TPU-native grouped matmul — the same op Pallas megablox kernels back)
 over the true group sizes: no padding FLOPs, no dropped tokens.
 
-Single-device (per-shard) formulation: with expert parallelism the
-capacity-einsum path remains the sharded implementation (its all-to-all is
-the EP collective); ``ragged_dot``'s group dimension cannot span an
-``expert`` mesh axis. That mirrors the reference, where the cutlass
-grouped GEMM also runs per-rank after dispatch.
+Two formulations:
+
+- ``dropless_moe_mlp`` — single-shard (no expert mesh axis): one sort +
+  three ``ragged_dot`` calls.
+- ``dropless_moe_mlp_ep`` — expert-parallel (round 5): a *partial-manual*
+  ``shard_map`` over just the ``expert`` axis (every other mesh axis stays
+  under GSPMD). Activations are replicated over the expert axis, so each
+  shard already holds every token row: it sorts the tokens routed to ITS
+  local experts to the front (everything else lands in a trailing dummy
+  group backed by zero weights), runs the per-shard ``ragged_dot``
+  grouped matmul, and a ``psum`` over the expert axis combines each
+  token's single live contribution — no capacity padding, no dropped
+  tokens, and the only collective is the combine. A ``ragged_all_to_all``
+  dispatch over expert-sharded activations would cut per-shard compute
+  from O(N) to O(N/ep) rows, but XLA:CPU cannot execute it yet, which
+  would leave the path untestable on the CI mesh.
+
+Reference counterpart: ``moe/sharded_moe.py:477`` (EP all-to-all around
+expert compute) + ``inference/v2/kernels/cutlass_ops/moe_gemm/moe_gemm.cu``
+(per-rank grouped GEMM). The reference cannot express the fused
+gather-sort-ragged-scatter program at all — its dispatch is fixed-capacity.
 """
 
 from __future__ import annotations
@@ -54,16 +70,89 @@ def dropless_moe_mlp(tokens: jax.Array, router_logits: jax.Array,
     sorted_tokens = tokens[order].astype(dtype)
     group_sizes = jnp.zeros((E,), jnp.int32).at[expert].add(1)
 
-    h = lax.ragged_dot(sorted_tokens, w_in.astype(dtype), group_sizes)
+    out_sorted = _ragged_expert_ffn(sorted_tokens, group_sizes, w_in,
+                                    w_out, w_gate, activation, dtype)
+
+    # unsort + gate scale
+    out = jnp.zeros_like(out_sorted).at[order].set(out_sorted)
+    return out * gate[:, None].astype(dtype), l_aux
+
+
+def _ragged_expert_ffn(st, gs, w_in, w_out, w_gate, activation, dtype):
+    """Grouped FFN over expert-sorted tokens ``st`` with group sizes
+    ``gs`` (one trailing dummy group allowed when the weights carry an
+    extra zero expert)."""
+    h = lax.ragged_dot(st, w_in.astype(dtype), gs)
     if w_gate is not None and activation == "silu":
-        g = lax.ragged_dot(sorted_tokens, w_gate.astype(dtype), group_sizes)
+        g = lax.ragged_dot(st, w_gate.astype(dtype), gs)
         h = jax.nn.silu(g) * h
     elif activation == "relu":
         h = jax.nn.relu(h)
     else:
         h = jax.nn.gelu(h, approximate=activation != "gelu_exact")
-    out_sorted = lax.ragged_dot(h, w_out.astype(dtype), group_sizes)
+    return lax.ragged_dot(h, w_out.astype(dtype), gs)
 
-    # unsort + gate scale
-    out = jnp.zeros_like(out_sorted).at[order].set(out_sorted)
+
+def dropless_moe_mlp_ep(tokens: jax.Array, router_logits: jax.Array,
+                        w_in: jax.Array, w_out: jax.Array,
+                        w_gate: Optional[jax.Array] = None,
+                        *, mesh, axis_name: str = "expert",
+                        activation: str = "gelu",
+                        dtype=None) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel top-1 dropless MoE FFN (module docstring).
+
+    tokens [N, H] and router_logits [N, E] are ordinary GSPMD arrays
+    (sharded over data axes); w_in/w_out/w_gate [E, ...] carry the
+    ``expert`` mesh axis on dim 0. Returns (out [N, H], aux_loss).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    dtype = dtype or tokens.dtype
+    E = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(router_logits, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # load-balance aux from the global routing stats (same formula as the
+    # single-shard path; computed under GSPMD, not inside the shard_map)
+    me_frac = jnp.mean(probs, axis=0)
+    ce_frac = jnp.mean(jax.nn.one_hot(expert, E, dtype=jnp.float32), axis=0)
+    l_aux = jnp.sum(me_frac * ce_frac) * E
+
+    def ep_core(tok, exp, w_in, w_out, w_gate):
+        # Activations are REPLICATED over the expert axis (the engine's
+        # batch sharding spans data/fsdp only), so every shard already
+        # holds all N token rows — no dispatch gather needed. Each shard
+        # sorts the tokens routed to ITS experts to the front, runs the
+        # grouped GEMM over N rows (non-local rows land in a zero-weight
+        # dummy group), and a psum over the expert axis combines each
+        # token's single live contribution. Per-shard compute is O(N)
+        # rows; the ideal O(N/ep) would need dynamic shapes (or a
+        # ragged_all_to_all dispatch with expert-sharded activations).
+        shard = lax.axis_index(axis_name)
+        el = w_in.shape[0]                       # local experts E // ep
+        e0 = shard * el
+        local = (exp >= e0) & (exp < e0 + el)
+        key = jnp.where(local, exp - e0, el)     # el = dummy group
+        order = jnp.argsort(key)                 # stable: keeps token order
+        st = tok[order].astype(dtype)
+        gs = jnp.zeros((el + 1,), jnp.int32).at[key].add(1)
+        # dummy expert el carries zero weights → exact zero output for
+        # tokens owned by other shards (gelu/silu·0/relu all fix 0)
+        pad = lambda w: (None if w is None else                # noqa: E731
+                         jnp.concatenate([w, jnp.zeros_like(w[:1])], 0))
+        o = _ragged_expert_ffn(st, gs, pad(w_in), pad(w_out), pad(w_gate),
+                               activation, dtype)
+        full = jnp.zeros_like(o).at[order].set(o)
+        # combine: sum over expert shards (exactly one is nonzero per
+        # token) — the EP combine collective; output stays replicated
+        return lax.psum(full, axis_name)
+
+    wspec = P(axis_name)
+    out = shard_map(ep_core, mesh=mesh, axis_names={axis_name},
+                    in_specs=(P(), P(), wspec, wspec,
+                              P() if w_gate is None else wspec),
+                    out_specs=P(), check_vma=False)(
+        tokens, expert, w_in, w_out, w_gate)
     return out * gate[:, None].astype(dtype), l_aux

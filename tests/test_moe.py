@@ -190,3 +190,114 @@ def test_dropless_causal_lm_trains(devices8):
               for _ in range(6)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_dropless_ep_matches_single_shard(devices8):
+    """Expert-parallel dropless (gather → per-shard ragged_dot →
+    psum_scatter under the partial-manual expert shard_map) reproduces the
+    single-shard dropless output and aux loss exactly."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.moe.grouped import dropless_moe_mlp, dropless_moe_mlp_ep
+
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("expert", "data"))
+    rng = np.random.default_rng(3)
+    N, H, M, E = 32, 8, 16, 4
+    tokens = jnp.asarray(rng.normal(size=(N, H)).astype(np.float32))
+    logits = jnp.asarray(rng.normal(size=(N, E)).astype(np.float32))
+    w_in = jnp.asarray(rng.normal(size=(E, H, M)).astype(np.float32)) * 0.2
+    w_out = jnp.asarray(rng.normal(size=(E, M, H)).astype(np.float32)) * 0.2
+    w_gate = jnp.asarray(rng.normal(size=(E, H, M)).astype(np.float32)) * 0.2
+
+    ref, aux_ref = dropless_moe_mlp(tokens, logits, w_in, w_out, w_gate,
+                                    activation="silu")
+    tok_s = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+    espec = NamedSharding(mesh, P("expert", None, None))
+    out, aux = jax.jit(
+        lambda t, lg, wi, wo, wg: dropless_moe_mlp_ep(
+            t, lg, wi, wo, wg, mesh=mesh, activation="silu"))(
+        tok_s, logits, jax.device_put(w_in, espec),
+        jax.device_put(w_out, espec), jax.device_put(w_gate, espec))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_dropless_ep_no_gate_and_imbalance(devices8):
+    """EP dropless without SwiGLU, all tokens on one expert shard: no
+    token dropped, other shard contributes exact zeros."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.moe.grouped import dropless_moe_mlp, dropless_moe_mlp_ep
+
+    mesh = Mesh(np.array(devices8).reshape(2, 4), ("expert", "data"))
+    rng = np.random.default_rng(4)
+    N, H, M, E = 16, 8, 16, 4
+    tokens = jnp.asarray(rng.normal(size=(N, H)).astype(np.float32))
+    logits = jnp.zeros((N, E)).at[:, 1].set(9.0)    # all → expert 1 (shard 0)
+    w_in = jnp.asarray(rng.normal(size=(E, H, M)).astype(np.float32))
+    w_out = jnp.asarray(rng.normal(size=(E, M, H)).astype(np.float32))
+    ref, _ = dropless_moe_mlp(tokens, logits, w_in, w_out, None,
+                              activation="gelu")
+    espec = NamedSharding(mesh, P("expert", None, None))
+    out, _ = jax.jit(
+        lambda t, lg, wi, wo: dropless_moe_mlp_ep(
+            t, lg, wi, wo, None, mesh=mesh, activation="gelu"))(
+        jax.device_put(tokens, NamedSharding(mesh, P("data", None))),
+        logits, jax.device_put(w_in, espec), jax.device_put(w_out, espec))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+    assert (np.abs(np.asarray(out)).sum(axis=-1) > 0).all()
+
+
+def test_dropless_ep_causal_lm_matches_capacity_loss(devices8):
+    """A dropless-EP CausalLM on an expert=2 mesh trains, and its loss
+    matches the capacity path at a capacity factor high enough that no
+    token drops (top-1: both paths then compute the same function)."""
+    import itertools
+
+    losses = {}
+    for dropless in (True, False):
+        model = CausalLM(dataclasses.replace(
+            TINY_TEST, num_kv_heads=4, moe_num_experts=4,
+            moe_dropless=dropless, moe_capacity_factor=4.0))
+        cfg = {
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0},
+            "mesh": {"data": -1, "expert": 2},
+            "steps_per_print": 10**9,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 256, size=(32, 33),
+                                           dtype=np.int64)}
+        losses[dropless] = [
+            float(engine.train_batch(itertools.repeat(batch)))
+            for _ in range(4)]
+    assert np.isfinite(losses[True]).all()
+    assert losses[True][-1] < losses[True][0]
+    # same function at non-dropping capacity → same training trajectory
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_registry_picks_dropless_under_ep():
+    """The v2 module registry routes moe_dropless + expert_parallel>1 to
+    the EP grouped-GEMM implementation (the r4 exclusion is gone)."""
+    from deepspeed_tpu.inference.v2.modules import DSModuleRegistry
+    from deepspeed_tpu.parallel import topology as topo
+
+    from functools import partial
+
+    from deepspeed_tpu.moe.grouped import dropless_moe_mlp_ep
+
+    t = topo.MeshTopology.build(expert=2, data=-1)
+    topo.set_topology(t)
+    try:
+        fn = DSModuleRegistry.instantiate(
+            "moe", moe_dropless=True, expert_parallel=2)
+        assert isinstance(fn, partial) and fn.func is dropless_moe_mlp_ep
+    finally:
+        topo.reset_topology()
