@@ -62,9 +62,6 @@ def initialize(args=None,
                 "path (runtime/zero_infinity.py)")
         if cfg_obj.zero_optimization.stage < 3:
             raise ValueError("offload_param requires zero_optimization.stage=3")
-        if (cfg_obj.gradient_accumulation_steps or 1) > 1:
-            raise ValueError("offload_param streaming does not support "
-                             "gradient_accumulation_steps > 1 yet")
         if isinstance(model, str):
             from .models import build_model
 

@@ -162,6 +162,13 @@ def _moe_dropless_factory(expert_parallel=1, mesh=None, **_):
 
         if mesh is None:
             mesh = topo.get_topology().mesh
+        got = int(dict(zip(mesh.axis_names, mesh.devices.shape)
+                       ).get("expert", 1))
+        if got != expert_parallel:
+            raise ValueError(
+                f"expert_parallel={expert_parallel} but the mesh's expert "
+                f"axis is {got} — set the topology (or pass mesh=) before "
+                "instantiating the EP dropless MoE")
         return partial(dropless_moe_mlp_ep, mesh=mesh)
     from ...moe.grouped import dropless_moe_mlp
 

@@ -724,8 +724,8 @@ class CausalLM:
                   if topo.has_topology() else 1)
             if ep > 1:
                 # expert-parallel dropless: partial-manual shard_map over
-                # the expert axis (gather → per-shard ragged_dot →
-                # psum_scatter; moe/grouped.py docstring)
+                # the expert axis (per-shard sort + ragged_dot, psum
+                # combine; moe/grouped.py docstring)
                 if _pipe_parallel_size() > 1:
                     raise NotImplementedError(
                         "dropless MoE + expert parallelism does not "

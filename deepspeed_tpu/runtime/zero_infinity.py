@@ -98,10 +98,14 @@ class _HostStore:
 class ZeroInfinityEngine:
     """Streaming trainer for a CausalLM whose params exceed device memory.
 
-    API subset of DeepSpeedTpuEngine: ``train_batch(batch) -> loss``,
-    ``get_lr``. Constraints: stage-3 + offload_param config, untied
-    embeddings, no dropout (deterministic groups), per-group grad
-    clipping only.
+    API subset of DeepSpeedTpuEngine: ``train_batch(batch) -> loss``
+    (``gradient_accumulation_steps`` micro batches per call — grads
+    accumulate in store-backed buffers, r5), ``get_lr``. Edge params
+    (embed / final_norm / lm_head) stream through the store per fsdp
+    shard like layer groups (r5). Constraints: stage-3 + offload_param
+    config, untied embeddings, no dropout (deterministic groups), no
+    lm_head bias / embedding LayerNorm, uniform sliding window,
+    per-group grad clipping only.
     """
 
     def __init__(self, model: CausalLM, config, rng=None,
@@ -116,6 +120,15 @@ class ZeroInfinityEngine:
                 "the group walk runs ONE compiled group_fwd program over "
                 "every layer group, so a mixed per-layer window schedule "
                 "cannot be baked in statically")
+        if model.cfg.embedding_layernorm:
+            raise ValueError(
+                "ZeRO-Infinity streaming does not apply embedding_layernorm "
+                "(BLOOM family); loading such a model would silently skip "
+                "the norm")
+        if not model.cfg.tie_embeddings and model.cfg.lm_head_bias:
+            raise ValueError(
+                "ZeRO-Infinity streaming's head program carries no lm_head "
+                "bias; rejecting rather than silently dropping it")
         self.module = model
         self.cfg = model.cfg
         self.config = config
@@ -164,17 +177,29 @@ class ZeroInfinityEngine:
                     self.store.put(f"opt_m.{key}", np.zeros_like(piece))
                     self.store.put(f"opt_v.{key}", np.zeros_like(piece))
                 self.param_bytes += arr.nbytes
-        self._edge_params = {}   # embed/final_norm/lm_head stay resident
+        # Edge params (embed / final_norm / lm_head) stream through the
+        # store like layer groups (r5 — the r4 design held them resident,
+        # replicated fp32, with a dense host-Adam pass every step; for a
+        # 70B that is ~1B params of permanent edge state per host. The
+        # reference swaps these too: partitioned_param_swapper.py:36
+        # swaps EVERY partitioned param, not just blocks.)
+        self._edge_keys: Dict[str, List[str]] = {}
+        self._edge_axis: Dict[tuple, Optional[int]] = {}
+        self._edge_bytes = 0
         for grp in ("embed", "final_norm", "lm_head"):
-            if grp in shapes:
-                self._edge_params[grp] = {
-                    k: self._replicate(self._init_leaf(f"{grp}.{k}",
-                                                       tuple(v.shape),
-                                                       seedseq))
-                    for k, v in shapes[grp].items()}
-        self._edge_m = jax.tree.map(np.zeros_like,
-                                    jax.tree.map(np.asarray, self._edge_params))
-        self._edge_v = jax.tree.map(np.zeros_like, self._edge_m)
+            if grp not in shapes:
+                continue
+            self._edge_keys[grp] = sorted(shapes[grp].keys())
+            for k in self._edge_keys[grp]:
+                shape = tuple(shapes[grp][k].shape)
+                self._edge_axis[(grp, k)] = self._pick_axis(shape, offset=0)
+                arr = self._init_leaf(f"{grp}.{k}", shape, seedseq)
+                for key, piece in self._edge_shards(grp, k, arr):
+                    self.store.put(key, piece)
+                    self.store.put(f"opt_m.{key}", np.zeros_like(piece))
+                    self.store.put(f"opt_v.{key}", np.zeros_like(piece))
+                self.param_bytes += arr.nbytes
+                self._edge_bytes += arr.nbytes
         self.opt_step = 0
         self.global_steps = 0
         self._prefetch = concurrent.futures.ThreadPoolExecutor(1)
@@ -187,18 +212,21 @@ class ZeroInfinityEngine:
                if mesh is not None else ""))
 
     # ------------------------------------------------------- mesh sharding
-    def _pick_shard_axis(self, rest_shape) -> Optional[int]:
-        """Absolute axis (>=1; 0 is the stacked-layer dim) along which a
-        layer leaf is split over fsdp — the largest dim divisible by F.
-        None → leaf replicated (small norm weights/biases)."""
+    def _pick_axis(self, shape, offset: int = 0) -> Optional[int]:
+        """Axis along which a leaf is split over fsdp — the largest dim
+        divisible by F, offset by ``offset`` (1 for stacked layer leaves:
+        axis 0 is the layer dim). None → leaf replicated (small norms)."""
         if self.fsdp <= 1:
             return None
         best = None
-        for d, extent in enumerate(rest_shape):
+        for d, extent in enumerate(shape):
             if extent % self.fsdp == 0 and extent >= self.fsdp:
-                if best is None or extent > rest_shape[best - 1]:
-                    best = d + 1
+                if best is None or extent > shape[best - offset]:
+                    best = d + offset
         return best
+
+    def _pick_shard_axis(self, rest_shape) -> Optional[int]:
+        return self._pick_axis(rest_shape, offset=1)
 
     def _shards(self, base_key: str, leaf_key: str, arr: np.ndarray):
         """Yield (store key, host piece) pairs — one per fsdp shard for
@@ -210,15 +238,33 @@ class ZeroInfinityEngine:
         for si, piece in enumerate(np.split(arr, self.fsdp, axis=ax)):
             yield f"{base_key}.s{si}", np.ascontiguousarray(piece)
 
-    def _leaf_sharding(self, leaf_key: str):
+    def _axis_sharding(self, ax: Optional[int]):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        ax = self._shard_axis[leaf_key]
         if ax is None:
             return NamedSharding(self.mesh, P())
         parts = [None] * (ax + 1)
         parts[ax] = "fsdp"
         return NamedSharding(self.mesh, P(*parts))
+
+    def _leaf_sharding(self, leaf_key: str):
+        return self._axis_sharding(self._shard_axis[leaf_key])
+
+    # ---- edge-leaf (embed / final_norm / lm_head) sharding plumbing
+    def _edge_key(self, grp: str, k: str, si) -> str:
+        base = f"edge.{grp}.{k}"
+        return base if si is None else f"{base}.s{si}"
+
+    def _edge_shards(self, grp: str, k: str, arr: np.ndarray):
+        ax = self._edge_axis[(grp, k)]
+        if ax is None:
+            yield self._edge_key(grp, k, None), arr
+            return
+        for si, piece in enumerate(np.split(arr, self.fsdp, axis=ax)):
+            yield self._edge_key(grp, k, si), np.ascontiguousarray(piece)
+
+    def _edge_sharding(self, grp: str, k: str):
+        return self._axis_sharding(self._edge_axis[(grp, k)])
 
     def _data_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -229,12 +275,6 @@ class ZeroInfinityEngine:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         return NamedSharding(self.mesh, P())
-
-    def _replicate(self, arr):
-        """Edge params live replicated on every mesh device."""
-        if self.mesh is None:
-            return jnp.asarray(arr)
-        return jax.device_put(arr, self._repl_sharding())
 
     def _init_leaf(self, name: str, shape, seedseq) -> np.ndarray:
         """Same init families as CausalLM.init (models/transformer.py:285):
@@ -295,28 +335,28 @@ class ZeroInfinityEngine:
             self._head_grad = jax.jit(head_grad)
             return
 
-        # Mesh mode: activations ride the data axis, param grads land
-        # reduce-scattered onto their fsdp shards, edge grads land
-        # replicated (GSPMD inserts the data-axis psum / reduce-scatter to
-        # satisfy the out_shardings — the ZeRO-3 grad flow).
+        # Mesh mode: activations ride the data axis, param grads (layer
+        # AND edge leaves) land reduce-scattered onto their fsdp shards
+        # (GSPMD inserts the data-axis psum / reduce-scatter to satisfy
+        # the out_shardings — the ZeRO-3 grad flow).
         data_s = self._data_sharding()
         repl_s = self._repl_sharding()
         gp_s = {k: self._leaf_sharding(k) for k in self._layer_keys}
+        embed_s = {k: self._edge_sharding("embed", k)
+                   for k in self._edge_keys["embed"]}
+        hp_s = {k: self._edge_sharding("final_norm", k)
+                for k in self._edge_keys["final_norm"]}
+        hp_s["lm_head_w"] = self._edge_sharding("lm_head", "w")
         self._group_fwd = jax.jit(group_fwd, out_shardings=data_s)
         self._group_bwd = jax.jit(group_bwd, out_shardings=(gp_s, data_s))
         self._embed_fwd = jax.jit(embed_fwd, out_shardings=data_s)
-        self._embed_bwd = jax.jit(embed_bwd, out_shardings=repl_s)
+        self._embed_bwd = jax.jit(embed_bwd, out_shardings=embed_s)
         self._head_grad = jax.jit(
             head_grad,
-            out_shardings=(repl_s, (repl_s, data_s)))
+            out_shardings=(repl_s, (hp_s, data_s)))
 
     # ------------------------------------------------------------- streaming
-    def _local_shards(self, leaf_key: str):
-        """Shard indices this process pages for a leaf: all of them in a
-        single-process mesh; only the fsdp coordinates of local devices in
-        a multi-process one (per-host paging of per-host shards)."""
-        if self.mesh is None or self._shard_axis[leaf_key] is None:
-            return [None]
+    def _fsdp_local_sis(self):
         if not hasattr(self, "_local_sis"):
             # invariant for the engine's lifetime — computed once
             fa = list(self.mesh.axis_names).index("fsdp")
@@ -324,6 +364,19 @@ class ZeroInfinityEngine:
                 {int(np.argwhere(self.mesh.devices == d)[0][fa])
                  for d in self.mesh.local_devices})
         return self._local_sis
+
+    def _local_shards(self, leaf_key: str):
+        """Shard indices this process pages for a leaf: all of them in a
+        single-process mesh; only the fsdp coordinates of local devices in
+        a multi-process one (per-host paging of per-host shards)."""
+        if self.mesh is None or self._shard_axis[leaf_key] is None:
+            return [None]
+        return self._fsdp_local_sis()
+
+    def _edge_local_shards(self, grp: str, k: str):
+        if self.mesh is None or self._edge_axis[(grp, k)] is None:
+            return [None]
+        return self._fsdp_local_sis()
 
     def _key(self, k: str, gi: int, si) -> str:
         base = f"layers.{k}.g{gi}"
@@ -335,38 +388,56 @@ class ZeroInfinityEngine:
                     for si in self._local_shards(k)}
                 for k in self._layer_keys}
 
-    def _group_to_device(self, host_group):
+    def _shards_to_device(self, shards, ax: Optional[int], sharding):
+        """{si: np} → device array (full single-device array, replicated,
+        or assembled per-shard via make_array_from_callback)."""
         if self.mesh is None:
-            # single-device: the inner dict is {None: full_leaf}
-            return {k: jnp.asarray(shards[None])
-                    for k, shards in host_group.items()}
-        out = {}
-        for k, shards in host_group.items():
-            ax = self._shard_axis[k]
-            if ax is None:
-                out[k] = jax.device_put(shards[None], self._repl_sharding())
-                continue
-            some = next(iter(shards.values()))
-            full = list(some.shape)
-            full[ax] *= self.fsdp
-            shard_len = some.shape[ax]
+            return jnp.asarray(shards[None])
+        if ax is None:
+            return jax.device_put(shards[None], self._repl_sharding())
+        some = next(iter(shards.values()))
+        full = list(some.shape)
+        full[ax] *= self.fsdp
+        shard_len = some.shape[ax]
 
-            def cb(idx, shards=shards, ax=ax, shard_len=shard_len):
-                si = (idx[ax].start or 0) // shard_len
-                return shards[si]
+        def cb(idx, shards=shards, ax=ax, shard_len=shard_len):
+            si = (idx[ax].start or 0) // shard_len
+            return shards[si]
 
-            out[k] = jax.make_array_from_callback(
-                tuple(full), self._leaf_sharding(k), cb)
-        return out
+        return jax.make_array_from_callback(tuple(full), sharding, cb)
 
-    def _grads_to_host(self, dgp) -> Dict[str, Dict]:
+    def _group_to_device(self, host_group):
+        return {k: self._shards_to_device(
+                    shards, None if self.mesh is None else self._shard_axis[k],
+                    None if self.mesh is None else self._leaf_sharding(k))
+                for k, shards in host_group.items()}
+
+    def _load_edges(self) -> Dict[str, Dict]:
+        """Page every edge leaf off the store — per fsdp shard."""
+        return {grp: {k: {si: self.store.get(self._edge_key(grp, k, si))
+                          for si in self._edge_local_shards(grp, k)}
+                      for k in ks}
+                for grp, ks in self._edge_keys.items()}
+
+    def _edges_to_device(self, host_edges) -> Dict[str, Dict]:
+        return {grp: {k: self._shards_to_device(
+                        shards,
+                        None if self.mesh is None
+                        else self._edge_axis[(grp, k)],
+                        None if self.mesh is None
+                        else self._edge_sharding(grp, k))
+                      for k, shards in d.items()}
+                for grp, d in host_edges.items()}
+
+    def _grads_by_axis(self, grads: Dict[str, Any],
+                       axis_of) -> Dict[str, Dict]:
         """Per-shard host grads: {leaf: {si: np}} — each process touches
         only its addressable shards (grads arrive fsdp-sharded and already
-        data-reduced, per the out_shardings)."""
+        data-reduced, per the out_shardings). ``axis_of(k)`` → shard axis
+        (None = replicated leaf)."""
         out = {}
-        for k in self._layer_keys:
-            g = dgp[k]
-            ax = self._shard_axis[k]
+        for k, g in grads.items():
+            ax = axis_of(k)
             if self.mesh is None or ax is None:
                 out[k] = {None: np.asarray(g, np.float32)}
                 continue
@@ -379,36 +450,111 @@ class ZeroInfinityEngine:
             out[k] = d
         return out
 
-    def _update_group(self, gi: int, host_group, dev_grads):
-        """C++ host optimizer on one group's master shards; page back out."""
+    def _grads_to_host(self, dgp) -> Dict[str, Dict]:
+        return self._grads_by_axis({k: dgp[k] for k in self._layer_keys},
+                                   lambda k: self._shard_axis[k])
+
+    def _edge_grads_to_host(self, grp: str, grads) -> Dict[str, Dict]:
+        return self._grads_by_axis(grads,
+                                   lambda k: self._edge_axis[(grp, k)])
+
+    def _acc_shard(self, key: str, g: np.ndarray, micro: int,
+                   last: bool) -> Optional[np.ndarray]:
+        """Gradient-accumulation plumbing for one shard: add to the
+        store-backed ``acc.{key}`` buffer on non-final micro steps (the
+        accumulator pages through the same NVMe/host store as the masters
+        — host RAM never holds a second full-model copy); return the
+        summed gradient on the final one."""
+        if micro > 0:
+            g = g + self.store.get(f"acc.{key}")
+        if not last:
+            self.store.put(f"acc.{key}", g)
+            return None
+        return g
+
+    def _opt_shard(self, key: str, master_arr: np.ndarray, g: np.ndarray):
+        """C++ host optimizer on one master shard; page back out."""
+        master = master_arr.reshape(-1)
+        m = self.store.get(f"opt_m.{key}").reshape(-1)
+        v = self.store.get(f"opt_v.{key}").reshape(-1)
+        # bias-correction counter synthesized from the engine step
+        # (one shared counter; every leaf advances once per step)
+        st = {"m": m, "v": v,
+              "step": np.asarray([self.opt_step - 1], np.float32)}
+        self.cpu_opt.step(master, np.ascontiguousarray(g.reshape(-1)), st,
+                          lr=self.lr)
+        self.store.put(key, master_arr)
+        self.store.put(f"opt_m.{key}", m.reshape(master_arr.shape))
+        self.store.put(f"opt_v.{key}", v.reshape(master_arr.shape))
+
+    def _update_group(self, gi: int, host_group, dev_grads, micro: int,
+                      gas: int):
+        """Accumulate or apply one group's gradients (final micro step →
+        mean over ``gas`` micro batches feeds the host optimizer)."""
+        last = micro == gas - 1
         for k in self._layer_keys:
             for si, master_arr in host_group[k].items():
                 key = self._key(k, gi, si)
-                g = np.ascontiguousarray(
-                    dev_grads[k][si].reshape(-1))
-                master = master_arr.reshape(-1)
-                m = self.store.get(f"opt_m.{key}").reshape(-1)
-                v = self.store.get(f"opt_v.{key}").reshape(-1)
-                # bias-correction counter synthesized from the engine step
-                # (one shared counter; every leaf advances once per step)
-                st = {"m": m, "v": v,
-                      "step": np.asarray([self.opt_step - 1], np.float32)}
-                self.cpu_opt.step(master, g, st, lr=self.lr)
-                self.store.put(key, master_arr)
-                self.store.put(f"opt_m.{key}", m.reshape(master_arr.shape))
-                self.store.put(f"opt_v.{key}", v.reshape(master_arr.shape))
+                g = self._acc_shard(key, dev_grads[k][si], micro, last)
+                if g is not None:
+                    self._opt_shard(key, master_arr, g / gas)
+
+    def _update_edges(self, host_edges, edge_grads, micro: int, gas: int):
+        last = micro == gas - 1
+        for grp, per_leaf in edge_grads.items():
+            for k, shards in per_leaf.items():
+                for si, g in shards.items():
+                    key = self._edge_key(grp, k, si)
+                    g = self._acc_shard(key, g, micro, last)
+                    if g is not None:
+                        self._opt_shard(key, host_edges[grp][k][si], g / gas)
 
     # ------------------------------------------------------------------ step
     def train_batch(self, batch) -> float:
-        if isinstance(batch, dict):
-            data = batch
-        elif hasattr(batch, "__next__"):
-            data = next(batch)
-        else:
+        """One effective batch: ``gradient_accumulation_steps`` micro
+        steps (each a full streamed fwd+bwd sweep, layer-group and edge
+        grads accumulating in store-backed ``acc.*`` buffers) + one host
+        optimizer update on the mean gradient. Returns the mean micro
+        loss."""
+        gas = int(getattr(self.config, "gradient_accumulation_steps", 1)
+                  or 1)
+        it = batch if hasattr(batch, "__next__") else None
+        if it is None and not isinstance(batch, dict):
             # a fresh iter() each call would silently replay element 0
             raise TypeError(
                 "train_batch expects a batch dict or an iterator; wrap "
                 "lists/datasets in iter(...) so consumption is stateful")
+        if gas > 1 and it is None:
+            raise TypeError(
+                f"gradient_accumulation_steps={gas} needs an iterator of "
+                "micro batches, not a single batch dict")
+        micro_batches = []
+        for _ in range(gas):
+            if it is None:
+                micro_batches.append(batch)
+                continue
+            try:
+                micro_batches.append(next(it))
+            except StopIteration:
+                # fail BEFORE mutating state — a bare StopIteration
+                # mid-batch would leave half-accumulated acc.* buffers
+                # (and PEP 479 would mangle it inside generators)
+                raise ValueError(
+                    f"micro-batch iterator exhausted after "
+                    f"{len(micro_batches)} of {gas} accumulation steps"
+                    ) from None
+        self.opt_step += 1
+        # edges are read once per effective batch (they only change at the
+        # final micro step's update)
+        host_edges = self._load_edges()
+        edges_dev = self._edges_to_device(host_edges)
+        losses = [self._micro_step(mb, host_edges, edges_dev, micro, gas)
+                  for micro, mb in enumerate(micro_batches)]
+        self.global_steps += 1
+        return float(np.mean(losses))
+
+    def _micro_step(self, data, host_edges, edges_dev, micro: int,
+                    gas: int) -> float:
         host_tokens = np.asarray(data["input_ids"])
         labels_np = host_tokens[:, 1:]
         tokens_np = host_tokens[:, :-1]
@@ -425,10 +571,9 @@ class ZeroInfinityEngine:
             labels = jax.device_put(labels_np.astype(np.int32), ds)
         positions = jnp.arange(T)
         cos, sin = self.module._pos_tables(T, None)
-        self.opt_step += 1
 
         # ---- forward sweep: double-buffered group streaming
-        x = self._embed_fwd(self._edge_params["embed"], tokens, positions)
+        x = self._embed_fwd(edges_dev["embed"], tokens, positions)
         boundary = [x]
         fut = self._prefetch.submit(self._load_group, 0)
         for gi in range(len(self.groups)):
@@ -441,8 +586,8 @@ class ZeroInfinityEngine:
             del gp
 
         # ---- head loss + backward seed
-        hp = dict(self._edge_params["final_norm"],
-                  lm_head_w=self._edge_params["lm_head"]["w"])
+        hp = dict(edges_dev["final_norm"],
+                  lm_head_w=edges_dev["lm_head"]["w"])
         (loss, (dhp, dx)) = self._head_grad(hp, boundary[-1], labels)
 
         # ---- backward sweep (recompute per group), host opt overlapped
@@ -458,38 +603,49 @@ class ZeroInfinityEngine:
             if pending_update is not None:
                 pending_update.result()
             pending_update = self._prefetch.submit(
-                self._update_group, gi, host_group, dgp_host)
+                self._update_group, gi, host_group, dgp_host, micro, gas)
             del gp, dgp
         if pending_update is not None:
             pending_update.result()
 
-        # ---- resident edge params update (embed + head) on host
-        d_embed = self._embed_bwd(self._edge_params["embed"], tokens,
-                                  positions, dx)
-        self._apply_edge("embed", d_embed)
-        self._apply_edge_head(dhp)
-        self.global_steps += 1
+        # ---- edge grads (embed + head): accumulate / host-update
+        d_embed = self._embed_bwd(edges_dev["embed"], tokens, positions, dx)
+        edge_grads = {
+            "embed": self._edge_grads_to_host("embed", d_embed),
+            "final_norm": self._edge_grads_to_host(
+                "final_norm",
+                {k: v for k, v in dhp.items() if k != "lm_head_w"}),
+            "lm_head": self._edge_grads_to_host(
+                "lm_head", {"w": dhp["lm_head_w"]}),
+        }
+        self._update_edges(host_edges, edge_grads, micro, gas)
         return float(loss)
-
-    def _apply_edge(self, grp: str, grads):
-        for k, g in grads.items():
-            p = np.asarray(self._edge_params[grp][k], np.float32).reshape(-1)
-            self.cpu_opt.step(p, np.ascontiguousarray(
-                np.asarray(g, np.float32).reshape(-1)),
-                {"m": self._edge_m[grp][k].reshape(-1),
-                 "v": self._edge_v[grp][k].reshape(-1),
-                 "step": np.asarray([self.opt_step - 1], np.float32)},
-                lr=self.lr)
-            self._edge_params[grp][k] = self._replicate(
-                p.reshape(self._edge_params[grp][k].shape))
-
-    def _apply_edge_head(self, dhp):
-        fn_grads = {k: v for k, v in dhp.items() if k != "lm_head_w"}
-        self._apply_edge("final_norm", fn_grads)
-        self._apply_edge("lm_head", {"w": dhp["lm_head_w"]})
 
     def get_lr(self):
         return [self.lr]
+
+    def gather_edges(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Assemble the full edge leaves from their store shards (tests /
+        checkpoint export; pages through the store like a step would).
+        Single-process only: each process's store holds only its local
+        fsdp shards, so a multi-host gather would silently return
+        undersized arrays."""
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "gather_edges is single-process: this host's store holds "
+                "only its local fsdp shards; export per-host and merge, "
+                "or use the universal checkpoint writer")
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for grp, ks in self._edge_keys.items():
+            out[grp] = {}
+            for k in ks:
+                ax = self._edge_axis[(grp, k)]
+                sis = self._edge_local_shards(grp, k)
+                pieces = [self.store.get(self._edge_key(grp, k, si))
+                          for si in sis]
+                out[grp][k] = (pieces[0] if ax is None
+                               else np.concatenate(pieces, axis=ax))
+        return out
 
     def streaming_report(self) -> Dict[str, Any]:
         """Quantify the streaming-vs-resident trade (r3 weak #3): paging
@@ -498,16 +654,25 @@ class ZeroInfinityEngine:
         activation-checkpointing 4/3-step-FLOPs factor, reference
         partitioned_param_coordinator prefetch trades the same way)."""
         steps = max(self.global_steps, 1)
+        gas = int(getattr(self.config, "gradient_accumulation_steps", 1)
+                  or 1)
+        layer_bytes = self.param_bytes - self._edge_bytes
+        # layer groups: params fwd+bwd per micro (2·gas), moments at the
+        # update (2), acc re-reads on micros > 0 (gas−1); edges: params
+        # once per batch (1), moments (2), acc re-reads (gas−1)
+        expected = (layer_bytes * (3 * gas + 1)
+                    + self._edge_bytes * (gas + 2))
         return {
             "param_bytes": self.param_bytes,
+            "edge_bytes": self._edge_bytes,
+            "gradient_accumulation_steps": gas,
             "groups": len(self.groups),
             "fsdp": self.fsdp,
             "data": self.dp,
             "store_device": self.store.device,
             "bytes_read_total": self.store.bytes_read,
             "bytes_read_per_step": self.store.bytes_read // steps,
-            # fwd params once + bwd params again + both moments ≈ 4x
-            "expected_bytes_per_step": 4 * self.param_bytes,
+            "expected_bytes_per_step": expected,
             "reads_per_step": self.store.reads // steps,
             # grouped-vjp backward recomputes each group's forward: step
             # FLOPs are ~8ND vs the resident engine's 6ND

@@ -345,17 +345,27 @@ def test_heuristics_pick_platform_appropriate_attention():
 
 
 def test_heuristics_moe_and_linear():
+    from functools import partial
+
     from deepspeed_tpu.inference.v2.modules import (instantiate_linear,
                                                     instantiate_moe)
-    from deepspeed_tpu.moe.grouped import dropless_moe_mlp
+    from deepspeed_tpu.moe.grouped import (dropless_moe_mlp,
+                                           dropless_moe_mlp_ep)
     from deepspeed_tpu.moe.sharded_moe import moe_dispatch_combine
+    from deepspeed_tpu.parallel import topology as topo
 
     dropless_cfg = dataclasses.replace(CFG, moe_num_experts=4,
                                        moe_dropless=True)
     assert instantiate_moe(dropless_cfg) is dropless_moe_mlp
-    # EP forces the capacity path (ragged_dot has no expert-axis path)
-    assert instantiate_moe(dropless_cfg,
-                           expert_parallel=2) is moe_dispatch_combine
+    # r5: EP routes dropless to the expert-axis shard_map path
+    t = topo.MeshTopology.build(expert=2, data=-1)
+    topo.set_topology(t)
+    try:
+        ep_fn = instantiate_moe(dropless_cfg, expert_parallel=2)
+        assert isinstance(ep_fn, partial) \
+            and ep_fn.func is dropless_moe_mlp_ep
+    finally:
+        topo.reset_topology()
     assert instantiate_moe(CFG) is moe_dispatch_combine
 
     dense = instantiate_linear(quant_bits=0)
