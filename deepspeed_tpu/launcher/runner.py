@@ -172,7 +172,15 @@ def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3) -> int:
     r3 'spawn and forget' gap). Returns the job's exit code."""
     import time
 
+    import signal
+
     alive = list(procs)
+    # SIGTERM → kill every rank tree, then exit. Children run in their own
+    # sessions, so terminating the launcher alone would ORPHAN them (the
+    # autotuner's experiment timeout, a scheduler's job kill, systemd stop
+    # — all deliver SIGTERM to this process only).
+    prev_term = signal.signal(
+        signal.SIGTERM, lambda *_: (_ for _ in ()).throw(SystemExit(143)))
     try:
         while alive:
             for p in list(alive):
@@ -195,6 +203,8 @@ def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3) -> int:
         for q in alive:
             terminate_process_tree(q)
         raise
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
 
 
 def supervise(spawn_fn, max_restarts: int = 0,
