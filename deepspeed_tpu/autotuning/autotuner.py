@@ -19,6 +19,17 @@ Results land in ``autotuning.results_dir`` as one JSON table
 (reference exps/results dirs), and ``tune()`` returns the best config
 merged into the base. Metric: tokens/sec (throughput, the reference's
 default) or step latency.
+
+Execution modes (round 5 — reference ``autotuning/scheduler.py``'s
+experiment resource manager): by default candidates run **in-process**
+(one engine build under single-process GSPMD — free teardown, fastest
+sweep). With ``autotuning.experiment_processes: N`` each candidate runs
+as a real ``--launcher local`` N-process job through the experiment
+worker (``experiment_worker.py``): ranks rendezvous via
+``jax.distributed``, so mesh-split candidates are timed under genuine
+multi-process collectives. Every record carries ``execution``
+("in_process" | "multiprocess") so the results table distinguishes the
+two timings.
 """
 
 from __future__ import annotations
@@ -91,7 +102,7 @@ class Autotuner:
         grads, activations, and the logits buffer."""
         info = self.model_info_profile_run()
         P = info["num_params"]
-        n = len(jax.devices())
+        n = self._device_count()
         fsdp = mesh.get("fsdp", 1)
         fsdp = n if fsdp == -1 else max(1, fsdp)
         data = mesh.get("data", 1)
@@ -109,9 +120,18 @@ class Autotuner:
         logits_b = micro * self.seq_len * vocab * 4
         return int(1.1 * (param_b + grad_b + opt_b + act_b + logits_b))
 
+    def _device_count(self) -> int:
+        """Device count candidates are sized for: multi-process
+        experiments see a different (global) device count than the tuner
+        process, so ``autotuning.experiment_device_count`` overrides the
+        local view — for mesh candidates, the memory model, AND the final
+        gas rescale alike."""
+        return (int(self.at_cfg.get("experiment_device_count", 0))
+                or len(jax.devices()))
+
     # ------------------------------------------------------------ candidates
     def _mesh_candidates(self) -> List[Dict[str, int]]:
-        n = len(jax.devices())
+        n = self._device_count()
         meshes = [{"data": -1, "fsdp": 1}]
         f = 2
         while f <= n:
@@ -136,13 +156,8 @@ class Autotuner:
         return [0, 1, 2, 3]
 
     # -------------------------------------------------------------- running
-    def _run_candidate(self, stage: int, micro: int,
-                       mesh: Dict[str, int]) -> Dict[str, Any]:
-        import deepspeed_tpu
-        from ..parallel import topology as topo
-
-        start = int(self.at_cfg.get("start_profile_step", 3))
-        end = int(self.at_cfg.get("end_profile_step", 5))
+    def _candidate_config(self, stage: int, micro: int,
+                          mesh: Dict[str, int]) -> Dict[str, Any]:
         cfg = dict(self.base)
         cfg["train_micro_batch_size_per_gpu"] = micro
         # The candidate redefines the batch split; the base's global batch /
@@ -150,11 +165,29 @@ class Autotuner:
         # resolve_batch_sizes spuriously). Candidates are compared at gas=1.
         cfg.pop("train_batch_size", None)
         cfg["gradient_accumulation_steps"] = 1
-        cfg["zero_optimization"] = dict(self.base.get("zero_optimization", {}),
-                                        stage=stage)
+        cfg["zero_optimization"] = dict(self.base.get("zero_optimization",
+                                                      {}), stage=stage)
         cfg["mesh"] = mesh
         cfg.setdefault("steps_per_print", 10**9)
-        record = {"zero_stage": stage, "micro_batch": micro, "mesh": mesh}
+        return cfg
+
+    def _run_candidate(self, stage: int, micro: int,
+                       mesh: Dict[str, int]) -> Dict[str, Any]:
+        procs = int(self.at_cfg.get("experiment_processes", 1))
+        if procs > 1:
+            return self._run_candidate_multiproc(stage, micro, mesh, procs)
+        return self._run_candidate_inproc(stage, micro, mesh)
+
+    def _run_candidate_inproc(self, stage: int, micro: int,
+                              mesh: Dict[str, int]) -> Dict[str, Any]:
+        import deepspeed_tpu
+        from ..parallel import topology as topo
+
+        start = int(self.at_cfg.get("start_profile_step", 3))
+        end = int(self.at_cfg.get("end_profile_step", 5))
+        cfg = self._candidate_config(stage, micro, mesh)
+        record = {"zero_stage": stage, "micro_batch": micro, "mesh": mesh,
+                  "execution": "in_process"}
         topo.reset_topology()
         try:
             engine, _, _, _ = deepspeed_tpu.initialize(model=self.model,
@@ -182,6 +215,112 @@ class Autotuner:
                           tokens_per_sec=0.0)
         finally:
             topo.reset_topology()
+        return record
+
+    def _model_spec(self) -> Dict[str, Any]:
+        import dataclasses as _dc
+
+        import numpy as _np
+
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is None or not _dc.is_dataclass(cfg):
+            raise ValueError(
+                "multi-process autotuning needs a config-described model "
+                "(CausalLM/TransformerConfig) so the experiment worker can "
+                "rebuild it in its own process")
+        d = _dc.asdict(cfg)
+        d["dtype"] = _np.dtype(cfg.dtype).name
+        return {"kind": "causal_lm", "config": d}
+
+    def _run_candidate_multiproc(self, stage: int, micro: int,
+                                 mesh: Dict[str, int],
+                                 procs: int) -> Dict[str, Any]:
+        """Time one candidate as a REAL ``--launcher local`` multi-process
+        job (reference autotuning/scheduler.py's launched experiments):
+        ranks rendezvous via jax.distributed, the engine builds over the
+        true multi-process mesh, and rank 0 reports the timing — so
+        mesh-split candidates pay genuine cross-process collectives."""
+        import socket
+        import subprocess
+        import sys
+        import tempfile
+
+        from . import experiment_worker
+
+        record = {"zero_stage": stage, "micro_batch": micro, "mesh": mesh,
+                  "execution": "multiprocess", "processes": procs}
+        spec = {
+            "env": dict(self.at_cfg.get("experiment_env", {})),
+            "model": self._model_spec(),
+            "config": self._candidate_config(stage, micro, mesh),
+            "seq_len": self.seq_len,
+            "start_profile_step": int(self.at_cfg.get("start_profile_step",
+                                                      3)),
+            "end_profile_step": int(self.at_cfg.get("end_profile_step", 5)),
+        }
+        timeout = float(self.at_cfg.get("experiment_timeout_s", 600))
+
+        def free_port() -> int:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        def run_once(port: int) -> Dict[str, Any]:
+            with tempfile.TemporaryDirectory() as td:
+                spec_path = os.path.join(td, "spec.json")
+                out_path = os.path.join(td, "result.json")
+                with open(spec_path, "w") as fh:
+                    json.dump(spec, fh)
+                cmd = [sys.executable, "-m",
+                       "deepspeed_tpu.launcher.runner",
+                       "--launcher", "local",
+                       "--num_local_procs", str(procs),
+                       "--master_port", str(port),
+                       experiment_worker.__file__,
+                       "--spec", spec_path, "--out", out_path]
+                # the worker runs as a file path — the package root must
+                # be importable in the spawned ranks
+                pkg_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                env = dict(os.environ)
+                env["PYTHONPATH"] = pkg_root + os.pathsep \
+                    + env.get("PYTHONPATH", "")
+                launcher = subprocess.Popen(
+                    cmd, env=env, text=True, stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE, start_new_session=True)
+                try:
+                    out, err = launcher.communicate(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    # SIGTERM first: the launcher's babysitter traps it
+                    # and kills every RANK tree (ranks run in their own
+                    # sessions — killing the launcher alone would orphan
+                    # them holding the chips); SIGKILL only as last resort
+                    from ..launcher.runner import terminate_process_tree
+
+                    terminate_process_tree(launcher, timeout=10.0)
+                    return {"status": "error", "tokens_per_sec": 0.0,
+                            "error": f"experiment timed out ({timeout}s)"}
+                if launcher.returncode != 0:
+                    return {"status": "error", "tokens_per_sec": 0.0,
+                            "error": (err or "")[-300:]}
+                if not os.path.exists(out_path):
+                    return {"status": "error", "tokens_per_sec": 0.0,
+                            "error": "worker wrote no result"}
+                with open(out_path) as fh:
+                    return json.load(fh)
+
+        result = run_once(free_port())
+        if result["status"] == "error" and any(
+                t in result.get("error", "")
+                for t in ("bind", "rendezvous", "UNAVAILABLE",
+                          "coordination")):
+            # port TOCTOU (another process claimed the rendezvous port in
+            # the pick-then-spawn gap) — retry once on a fresh port so a
+            # racing neighbor doesn't silently misprice the candidate
+            logger.warning("autotune: rendezvous failure, retrying "
+                           f"candidate on a fresh port: {result['error']}")
+            result = run_once(free_port())
+        record.update(result)
         return record
 
     # ----------------------------------------------------------------- tune
@@ -248,7 +387,7 @@ class Autotuner:
         merged["gradient_accumulation_steps"] = 1
         merged["train_micro_batch_size_per_gpu"] = best["micro_batch"]
         if isinstance(target_batch, int):
-            dp = len(jax.devices())
+            dp = self._device_count()
             if target_batch % (best["micro_batch"] * dp) == 0:
                 merged["train_batch_size"] = target_batch
                 merged["gradient_accumulation_steps"] = \
