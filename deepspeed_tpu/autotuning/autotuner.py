@@ -77,6 +77,10 @@ class Autotuner:
         gb = self.at_cfg.get("max_device_memory_gb")
         if gb:
             return int(float(gb) * 1e9)
+        if int(self.at_cfg.get("experiment_processes", 1)) > 1:
+            # no device probe in multi-process mode (see _device_count);
+            # without an explicit budget, pruning is off
+            return None
         dev = jax.devices()[0]
         try:
             stats = dev.memory_stats() or {}
@@ -125,9 +129,19 @@ class Autotuner:
         experiments see a different (global) device count than the tuner
         process, so ``autotuning.experiment_device_count`` overrides the
         local view — for mesh candidates, the memory model, AND the final
-        gas rescale alike."""
-        return (int(self.at_cfg.get("experiment_device_count", 0))
-                or len(jax.devices()))
+        gas rescale alike. With ``experiment_processes`` it is REQUIRED:
+        probing ``jax.devices()`` from the tuner would create a local
+        PJRT client that owns every chip, starving the spawned ranks."""
+        n = int(self.at_cfg.get("experiment_device_count", 0))
+        if n:
+            return n
+        if int(self.at_cfg.get("experiment_processes", 1)) > 1:
+            raise ValueError(
+                "autotuning.experiment_processes > 1 requires "
+                "autotuning.experiment_device_count: the tuner must not "
+                "initialize the local TPU backend (it would hold the "
+                "chips the experiment ranks need)")
+        return len(jax.devices())
 
     # ------------------------------------------------------------ candidates
     def _mesh_candidates(self) -> List[Dict[str, int]]:
@@ -285,6 +299,18 @@ class Autotuner:
                 env = dict(os.environ)
                 env["PYTHONPATH"] = pkg_root + os.pathsep \
                     + env.get("PYTHONPATH", "")
+                # strip the outer job's rank identity: under SLURM / a TPU
+                # pod the nested launcher would otherwise hit its
+                # managed-allocation detection (_env_rank_info) and exec
+                # the worker IN PLACE with the production job's
+                # rank/world/coordinator instead of spawning N local ranks
+                for var in ("SLURM_PROCID", "SLURM_NTASKS",
+                            "SLURM_JOB_NODELIST", "TPU_WORKER_ID",
+                            "TPU_WORKER_HOSTNAMES", "MEGASCALE_SLICE_ID",
+                            "RANK", "WORLD_SIZE", "PROCESS_ID",
+                            "NUM_PROCESSES", "COORDINATOR_ADDRESS",
+                            "MASTER_ADDR", "MASTER_PORT", "LOCAL_RANK"):
+                    env.pop(var, None)
                 launcher = subprocess.Popen(
                     cmd, env=env, text=True, stdout=subprocess.PIPE,
                     stderr=subprocess.PIPE, start_new_session=True)

@@ -72,7 +72,12 @@ def init_distributed(dist_backend: str = "xla",
 
     Env contract (mirrors torchrun's env:// + TPU pod conventions):
     ``COORDINATOR_ADDRESS`` (or ``MASTER_ADDR:MASTER_PORT``), ``RANK``/
-    ``PROCESS_ID``, ``WORLD_SIZE``/``NUM_PROCESSES``.
+    ``PROCESS_ID``, ``WORLD_SIZE``/``NUM_PROCESSES``. With
+    ``auto_mpi_discovery`` (reference deepspeed/comm/comm.py:673
+    ``mpi_discovery``), an ``mpirun``/``srun``-launched job fills
+    rank/world from the OpenMPI/PMI env when the torchrun-style vars are
+    absent — MPI as a *launch* vehicle works without the MPI-family
+    multinode runners (docs/DIVERGENCES.md).
     """
     global _initialized
     if _initialized:
@@ -83,9 +88,46 @@ def init_distributed(dist_backend: str = "xla",
     if coord is None and os.environ.get("MASTER_ADDR"):
         coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"
     if world_size < 0:
-        world_size = int(os.environ.get("WORLD_SIZE", os.environ.get("NUM_PROCESSES", "1")))
+        world_size = int(os.environ.get("WORLD_SIZE", os.environ.get("NUM_PROCESSES", "-1")))
     if rank < 0:
-        rank = int(os.environ.get("RANK", os.environ.get("PROCESS_ID", "0")))
+        rank = int(os.environ.get("RANK", os.environ.get("PROCESS_ID", "-1")))
+    mpi_launched = False
+    if auto_mpi_discovery and (rank < 0 or world_size < 0):
+        # mpirun (OpenMPI) / PMI (MPICH, srun) launch conventions
+        mpi_rank = os.environ.get("OMPI_COMM_WORLD_RANK",
+                                  os.environ.get("PMI_RANK"))
+        mpi_world = os.environ.get("OMPI_COMM_WORLD_SIZE",
+                                   os.environ.get("PMI_SIZE"))
+        mpi_launched = mpi_rank is not None
+        if rank < 0 and mpi_rank is not None:
+            rank = int(mpi_rank)
+        if world_size < 0 and mpi_world is not None:
+            world_size = int(mpi_world)
+    if world_size < 0:
+        world_size = 1
+    if rank < 0:
+        rank = 0
+    if world_size > 1 and coord is None and mpi_launched:
+        # mpirun sets no MASTER_ADDR; the reference's mpi_discovery
+        # broadcasts rank 0's address over MPI (comm.py:673). Do the same
+        # when mpi4py exists; otherwise fail loudly — the silent
+        # "externally initialized" fallback would leave every process
+        # seeing only its local devices (divergent training, no error).
+        try:
+            import socket
+
+            from mpi4py import MPI  # type: ignore
+
+            addr = MPI.COMM_WORLD.bcast(
+                socket.gethostbyname(socket.gethostname())
+                if rank == 0 else None, root=0)
+            coord = f"{addr}:{distributed_port}"
+        except ImportError:
+            raise ValueError(
+                f"MPI-launched job (rank {rank}/{world_size}) has no "
+                "COORDINATOR_ADDRESS/MASTER_ADDR and mpi4py is not "
+                "available to broadcast one — export MASTER_ADDR=<rank0 "
+                "host> in the mpirun command") from None
 
     if world_size > 1 and coord is not None:
         if verbose:

@@ -93,3 +93,41 @@ def test_multi_axis_world_size(devices8):
     set_topology(MeshTopology.build(data=2, fsdp=2, tensor=2))
     assert dist.get_world_size((DATA_AXIS, FSDP_AXIS)) == 4
     assert dist.get_world_size(TENSOR_AXIS) == 2
+
+
+def test_mpi_env_discovery(monkeypatch):
+    """auto_mpi_discovery (reference comm.py:673 mpi_discovery): an
+    mpirun/srun-launched single process derives rank/world from the
+    OpenMPI / PMI env when torchrun-style vars are absent. world=1 here,
+    so no rendezvous fires — the parse path is what's pinned."""
+    from deepspeed_tpu.comm import comm as C
+
+    monkeypatch.setattr(C, "_initialized", False)
+    for var in ("RANK", "WORLD_SIZE", "PROCESS_ID", "NUM_PROCESSES",
+                "COORDINATOR_ADDRESS", "MASTER_ADDR"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "0")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "1")
+    C.init_distributed()
+    assert C.is_initialized()
+    monkeypatch.setattr(C, "_initialized", False)
+
+
+def test_mpi_multiprocess_without_coordinator_fails_loudly(monkeypatch):
+    """An mpirun world>1 with no MASTER_ADDR and no mpi4py must raise —
+    the silent fallback would leave each process with only local devices
+    (divergent training, no error)."""
+    import sys
+
+    from deepspeed_tpu.comm import comm as C
+
+    monkeypatch.setattr(C, "_initialized", False)
+    for var in ("RANK", "WORLD_SIZE", "PROCESS_ID", "NUM_PROCESSES",
+                "COORDINATOR_ADDRESS", "MASTER_ADDR"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    monkeypatch.setitem(sys.modules, "mpi4py", None)   # force ImportError
+    with pytest.raises(ValueError, match="MASTER_ADDR"):
+        C.init_distributed()
+    monkeypatch.setattr(C, "_initialized", False)
