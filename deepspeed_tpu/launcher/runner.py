@@ -35,7 +35,7 @@ import os
 import shlex
 import subprocess
 import sys
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..utils.logging import logger
 
@@ -165,11 +165,16 @@ def terminate_process_tree(proc: subprocess.Popen, timeout: float = 5.0):
         proc.wait()
 
 
-def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3) -> int:
+def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3,
+            on_fail=None) -> int:
     """Monitor children until all exit; on the FIRST failure, kill every
     survivor's process tree so a dead rank can't leave the job hung at a
     collective (reference launcher/launch.py:132 monitoring loop — the
-    r3 'spawn and forget' gap). Returns the job's exit code."""
+    r3 'spawn and forget' gap). Returns the job's exit code.
+    ``on_fail(indices)`` receives EVERY child already exited nonzero when
+    the failure is detected — within one poll window a host crash and its
+    collective-error cascade are indistinguishable, so the callback gets
+    the full set and decides whether attribution is unambiguous."""
     import time
 
     import signal
@@ -192,6 +197,13 @@ def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3) -> int:
                     logger.error(
                         f"rank process {p.pid} exited rc={rc}; terminating "
                         f"{len(alive)} surviving rank(s)")
+                    if on_fail is not None:
+                        failed = [i for i, q in enumerate(procs)
+                                  if q.poll() not in (None, 0)]
+                        try:
+                            on_fail(failed)
+                        except Exception as e:
+                            logger.warning(f"on_fail callback failed: {e}")
                     for q in alive:
                         terminate_process_tree(q)
                     return rc
@@ -208,7 +220,7 @@ def babysit(procs: List[subprocess.Popen], poll_interval: float = 0.3) -> int:
 
 
 def supervise(spawn_fn, max_restarts: int = 0,
-              between_attempts=None) -> int:
+              between_attempts=None, on_fail=None) -> int:
     """Restart supervisor (reference elasticity/elastic_agent.py:28, TPU
     restart-based flavor): spawn + babysit; on failure relaunch the whole
     job up to ``max_restarts`` times. Training scripts are expected to
@@ -218,7 +230,7 @@ def supervise(spawn_fn, max_restarts: int = 0,
     cleanup for the ssh/pdsh paths)."""
     attempt = 0
     while True:
-        rc = babysit(spawn_fn())
+        rc = babysit(spawn_fn(), on_fail=on_fail)
         if rc == 0:
             return 0
         attempt += 1
@@ -249,6 +261,14 @@ def main(argv=None):
                         choices=["ssh", "pdsh", "local"])
     parser.add_argument("--num_local_procs", type=int, default=1,
                         help="rank count for --launcher local")
+    parser.add_argument("--elastic_min_world", type=int, default=0,
+                        help="scale-down floor: when a restart is caused "
+                        "by a failing host and the remaining hosts still "
+                        "number >= this, EXCLUDE the dead host and "
+                        "relaunch with a smaller world (restart-based "
+                        "scale-down; scripts re-derive the elastic batch "
+                        "from WORLD_SIZE and resume from checkpoint). "
+                        "0 disables exclusion")
     parser.add_argument("--max_restarts", type=int, default=0,
                         help="restart the whole job up to N times after a "
                              "failure (restart supervisor; scripts resume "
@@ -263,6 +283,10 @@ def main(argv=None):
     parser.add_argument("user_script", type=str, nargs="?")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+    if args.elastic_min_world and not args.max_restarts:
+        parser.error("--elastic_min_world needs --max_restarts > 0: "
+                     "exclusion happens between restart attempts, so "
+                     "without restarts the flag is a silent no-op")
 
     if args.autotune:
         # reference runner.py:360 run_autotuning entry. Tuning runs
@@ -351,11 +375,14 @@ def main(argv=None):
         return
 
     host_list = list(hosts)
-    coord_host = args.master_addr or host_list[0]
-    coord = f"{coord_host}:{args.master_port}"
-    world = len(host_list)
+    last_failed: List[Optional[str]] = [None]
 
     def spawn_remote():
+        # world/coordinator re-derive from the CURRENT host list — after
+        # an elastic exclusion the job relaunches smaller
+        world = len(host_list)
+        coord_host = args.master_addr or host_list[0]
+        coord = f"{coord_host}:{args.master_port}"
         procs = []
         for rank, host in enumerate(host_list):
             envs = (f"COORDINATOR_ADDRESS={shlex.quote(coord)} RANK={rank} "
@@ -373,20 +400,54 @@ def main(argv=None):
             procs.append(subprocess.Popen(cmd, start_new_session=True))
         return procs
 
+    def note_failed(indices: List[int]):
+        # exclusion must be UNAMBIGUOUS: a host crash whose collective
+        # error has already felled other ranks within the same poll window
+        # yields several failures — excluding any one of them risks
+        # evicting a healthy host, so fall back to a plain restart
+        last_failed[0] = host_list[indices[0]] if len(indices) == 1 else None
+
     def kill_remote_ranks():
         """Best-effort remote cleanup before a respawn: killing the local
         ssh/pdsh client does not reliably HUP the remote command (pdsh in
         particular), so ask each host to pkill the user script (reference
-        multinode runner's remote-kill; pattern-scoped to this script)."""
+        multinode runner's remote-kill; pattern-scoped to this script).
+        With ``--elastic_min_world``, the (sole) host whose rank died is
+        EXCLUDED and the relaunch proceeds with a smaller world — the
+        scale-down half of the reference's DSElasticAgent
+        (elasticity/elastic_agent.py:28), restart-based because
+        jax.distributed cannot re-rendezvous a changed world in-place."""
+        # exclude FIRST: a genuinely dead host would hang its pkill ssh,
+        # and the exclusion must not depend on the cleanup loop surviving
+        dead = last_failed[0]
+        last_failed[0] = None
+        if (args.elastic_min_world and dead is not None
+                and len(host_list) - 1 >= args.elastic_min_world):
+            host_list.remove(dead)
+            if args.master_addr == dead:
+                # the pinned coordinator died with the host; fall back to
+                # re-deriving it from the surviving host list
+                logger.warning(
+                    f"elastic scale-down: --master_addr {dead} is the "
+                    f"excluded host; coordinator falls back to "
+                    f"{host_list[0]}")
+                args.master_addr = None
+            logger.warning(
+                f"elastic scale-down: excluding failed host {dead}; "
+                f"relaunching with world={len(host_list)}")
         pattern = shlex.quote(args.user_script)
         for host in host_list:
             kill_cmd = (["pdsh", "-w", host] if args.launcher == "pdsh"
                         else ["ssh", "-p", str(args.ssh_port), host])
-            subprocess.run(kill_cmd + [f"pkill -f {pattern} || true"],
-                           timeout=30, capture_output=True)
+            try:
+                subprocess.run(kill_cmd + [f"pkill -f {pattern} || true"],
+                               timeout=30, capture_output=True)
+            except subprocess.TimeoutExpired:
+                logger.warning(f"remote cleanup on {host} timed out")
 
     sys.exit(supervise(spawn_remote, args.max_restarts,
-                       between_attempts=kill_remote_ranks))
+                       between_attempts=kill_remote_ranks,
+                       on_fail=note_failed))
 
 
 if __name__ == "__main__":

@@ -197,3 +197,73 @@ def test_sigterm_kills_rank_trees(tmp_path):
     finally:
         if launcher.poll() is None:
             launcher.kill()
+
+
+def test_elastic_scale_down_excludes_dead_host(tmp_path):
+    """--elastic_min_world: the host whose rank died first is excluded
+    between attempts and the job relaunches with a SMALLER world (the
+    scale-down half of the reference's DSElasticAgent, restart-based) —
+    ranks re-derive WORLD_SIZE and the second attempt succeeds on the
+    survivors."""
+    body = textwrap.dedent("""
+        import os, time
+        out = os.environ["OUT_DIR"]
+        world = os.environ["WORLD_SIZE"]
+        rank = os.environ["RANK"]
+        if world == "3":
+            if rank == "1":
+                raise SystemExit(7)       # "host1" dies
+            time.sleep(30)                # survivors outlive the failure
+        open(f"{out}/final_r{rank}_w{world}", "w").write("ok")
+    """)
+    proc, log = _run_launcher(tmp_path, body, world=3,
+                              extra_args=("--max_restarts", "1",
+                                          "--elastic_min_world", "2"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # second attempt ran with world=2 on the surviving hosts
+    assert (tmp_path / "final_r0_w2").exists()
+    assert (tmp_path / "final_r1_w2").exists()
+    calls = [c for c in log.read_text().splitlines()
+             if "pkill" not in c]
+    attempt2 = calls[3:]                   # first 3 = world-3 spawns
+    assert len(attempt2) == 2
+    assert not any(" host1 " in c for c in attempt2), attempt2
+    assert "elastic scale-down: excluding failed host host1" \
+        in proc.stdout + proc.stderr
+
+
+def test_elastic_no_exclusion_on_ambiguous_cascade(tmp_path):
+    """When SEVERAL ranks are already dead at detection (host crash +
+    collective-error cascade land in one poll window), attribution is
+    ambiguous: no host is excluded — plain restart at full world instead
+    of evicting a possibly-healthy machine."""
+    body = textwrap.dedent("""
+        import os, time
+        out = os.environ["OUT_DIR"]
+        rank = os.environ["RANK"]
+        marker = f"{out}/attempt_r{rank}"
+        n = int(open(marker).read()) if os.path.exists(marker) else 0
+        open(marker, "w").write(str(n + 1))
+        if n == 0:
+            if rank in ("1", "2"):
+                time.sleep(0.5)           # both dead within one poll pass
+                raise SystemExit(9)
+            time.sleep(30)
+        open(f"{out}/final_r{rank}_w{os.environ['WORLD_SIZE']}",
+             "w").write("ok")
+    """)
+    proc, log = _run_launcher(tmp_path, body, world=3,
+                              extra_args=("--max_restarts", "1",
+                                          "--elastic_min_world", "2"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # retried at FULL world — all three hosts again
+    for rank in range(3):
+        assert (tmp_path / f"final_r{rank}_w3").exists()
+    assert "excluding failed host" not in proc.stdout + proc.stderr
+
+
+def test_elastic_min_world_requires_max_restarts(tmp_path):
+    from deepspeed_tpu.launcher import runner
+
+    with pytest.raises(SystemExit):
+        runner.main(["--elastic_min_world", "2", "dummy.py"])
