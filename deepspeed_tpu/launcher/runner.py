@@ -287,6 +287,10 @@ def main(argv=None):
         parser.error("--elastic_min_world needs --max_restarts > 0: "
                      "exclusion happens between restart attempts, so "
                      "without restarts the flag is a silent no-op")
+    if args.elastic_min_world and args.launcher == "local":
+        parser.error("--elastic_min_world applies to multi-host "
+                     "(ssh/pdsh) jobs: there is no host to exclude in "
+                     "--launcher local")
 
     if args.autotune:
         # reference runner.py:360 run_autotuning entry. Tuning runs

@@ -986,6 +986,11 @@ def _encoder_prefix_and_heads(hf_config):
     (RoBERTa has no pooler at all in ForMaskedLM) the pooler."""
     mt = hf_config.get("model_type")
     arch = _encoder_arch(hf_config)
+    if mt == "distilbert":
+        # DistilBERT has no pooler in any architecture
+        if arch == "DistilBertModel":
+            return "", False, False
+        return mt + ".", False, "ForMaskedLM" in arch
     if arch in ("BertModel", "RobertaModel"):
         return "", True, False
     if "ForMaskedLM" in arch:
@@ -1014,15 +1019,33 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
     # its max_position_embeddings already includes the offset
     offset = (hf_config.get("pad_token_id", 1) + 1) if mt == "roberta" else 0
     _, pooler, mlm = _encoder_prefix_and_heads(hf_config)
+    raw_act = hf_config.get("hidden_act",
+                            hf_config.get("activation", "gelu"))
     act = {"gelu": "gelu_exact", "gelu_new": "gelu_new",
            "gelu_pytorch_tanh": "gelu_new", "relu": "relu",
-           "silu": "silu", "swish": "silu"}.get(
-        hf_config.get("hidden_act", "gelu"))
+           "silu": "silu", "swish": "silu"}.get(raw_act)
     if act is None:
         raise ValueError(
-            f"unsupported encoder hidden_act "
-            f"{hf_config.get('hidden_act')!r} — loading it as gelu would "
-            "silently diverge from HF")
+            f"unsupported encoder activation {raw_act!r} — loading it as "
+            "gelu would silently diverge from HF")
+    if mt == "distilbert":
+        # DistilBertConfig naming: dim/hidden_dim/n_layers/n_heads; no
+        # token types, no pooler; sinusoidal_pos_embds still stores a
+        # position nn.Embedding, so the load path is identical
+        return EncoderConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=hf_config["dim"],
+            intermediate_size=hf_config["hidden_dim"],
+            num_layers=hf_config["n_layers"],
+            num_heads=hf_config["n_heads"],
+            max_seq_len=hf_config.get("max_position_embeddings", 512),
+            type_vocab_size=0,
+            activation=act, with_pooler=False, with_mlm_head=mlm,
+            # modern transformers ties via tie_word_embeddings; legacy
+            # hub configs carry tie_weights_ (always true there)
+            tie_mlm_decoder=hf_config.get(
+                "tie_word_embeddings", hf_config.get("tie_weights_", True)),
+            dtype=dtype)
     return EncoderConfig(
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
@@ -1043,7 +1066,8 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
     model_implementations/transformers/ds_bert.py + containers/bert.py."""
     p, _, _ = _encoder_prefix_and_heads(hf_config)
     mt = hf_config.get("model_type")
-    L = p + "encoder.layer.{}."
+    distil = mt == "distilbert"
+    L = p + ("transformer.layer.{}." if distil else "encoder.layer.{}.")
 
     def lsrc(fmt: str, transpose=True):
         return lambda i: Src((L + fmt).format(i), transpose=transpose)
@@ -1051,29 +1075,28 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
     def stacked(name, make):
         return StackedLeafPlan(make, shapes["layers"][name].shape)
 
-    layers = {
-        "wq": stacked("wq", lsrc("attention.self.query.weight")),
-        "wq_b": stacked("wq_b", lsrc("attention.self.query.bias", False)),
-        "wk": stacked("wk", lsrc("attention.self.key.weight")),
-        "wk_b": stacked("wk_b", lsrc("attention.self.key.bias", False)),
-        "wv": stacked("wv", lsrc("attention.self.value.weight")),
-        "wv_b": stacked("wv_b", lsrc("attention.self.value.bias", False)),
-        "wo": stacked("wo", lsrc("attention.output.dense.weight")),
-        "wo_b": stacked("wo_b", lsrc("attention.output.dense.bias", False)),
-        "attn_ln_w": stacked("attn_ln_w",
-                             lsrc("attention.output.LayerNorm.weight",
-                                  False)),
-        "attn_ln_b": stacked("attn_ln_b",
-                             lsrc("attention.output.LayerNorm.bias", False)),
-        "w_in": stacked("w_in", lsrc("intermediate.dense.weight")),
-        "w_in_b": stacked("w_in_b", lsrc("intermediate.dense.bias", False)),
-        "w_out": stacked("w_out", lsrc("output.dense.weight")),
-        "w_out_b": stacked("w_out_b", lsrc("output.dense.bias", False)),
-        "mlp_ln_w": stacked("mlp_ln_w", lsrc("output.LayerNorm.weight",
-                                             False)),
-        "mlp_ln_b": stacked("mlp_ln_b", lsrc("output.LayerNorm.bias",
-                                             False)),
-    }
+    if distil:
+        names = {"wq": "attention.q_lin", "wk": "attention.k_lin",
+                 "wv": "attention.v_lin", "wo": "attention.out_lin",
+                 "attn_ln": "sa_layer_norm", "w_in": "ffn.lin1",
+                 "w_out": "ffn.lin2", "mlp_ln": "output_layer_norm"}
+    else:
+        names = {"wq": "attention.self.query", "wk": "attention.self.key",
+                 "wv": "attention.self.value",
+                 "wo": "attention.output.dense",
+                 "attn_ln": "attention.output.LayerNorm",
+                 "w_in": "intermediate.dense", "w_out": "output.dense",
+                 "mlp_ln": "output.LayerNorm"}
+    layers = {}
+    for k in ("wq", "wk", "wv", "wo", "w_in", "w_out"):
+        layers[k] = stacked(k, lsrc(names[k] + ".weight"))
+        layers[k + "_b"] = stacked(k + "_b",
+                                   lsrc(names[k] + ".bias", False))
+    for k in ("attn_ln", "mlp_ln"):
+        layers[k + "_w"] = stacked(k + "_w",
+                                   lsrc(names[k] + ".weight", False))
+        layers[k + "_b"] = stacked(k + "_b",
+                                   lsrc(names[k] + ".bias", False))
     E = p + "embeddings."
     plans = {
         "embed": {
@@ -1081,8 +1104,6 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                             shapes["embed"]["wte"].shape),
             "wpe": LeafPlan(Src(E + "position_embeddings.weight"),
                             shapes["embed"]["wpe"].shape),
-            "tte": LeafPlan(Src(E + "token_type_embeddings.weight"),
-                            shapes["embed"]["tte"].shape),
             "ln_w": LeafPlan(Src(E + "LayerNorm.weight"),
                              shapes["embed"]["ln_w"].shape),
             "ln_b": LeafPlan(Src(E + "LayerNorm.bias"),
@@ -1090,6 +1111,10 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
         },
         "layers": layers,
     }
+    if cfg.type_vocab_size > 0:
+        plans["embed"]["tte"] = LeafPlan(
+            Src(E + "token_type_embeddings.weight"),
+            shapes["embed"]["tte"].shape)
     if cfg.with_pooler:
         plans["pooler"] = {
             "w": LeafPlan(Src(p + "pooler.dense.weight", transpose=True),
@@ -1098,7 +1123,13 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                           shapes["pooler"]["b"].shape),
         }
     if cfg.with_mlm_head:
-        if mt == "roberta":
+        if distil:
+            head = {"w": "vocab_transform.weight",
+                    "b": "vocab_transform.bias",
+                    "ln_w": "vocab_layer_norm.weight",
+                    "ln_b": "vocab_layer_norm.bias",
+                    "bias": "vocab_projector.bias"}
+        elif mt == "roberta":
             head = {"w": "lm_head.dense.weight", "b": "lm_head.dense.bias",
                     "ln_w": "lm_head.layer_norm.weight",
                     "ln_b": "lm_head.layer_norm.bias",
@@ -1111,8 +1142,10 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                     "bias": "cls.predictions.bias"}
         if not cfg.tie_mlm_decoder:
             # untied decoder stores its own [V, H] weight (ours is [H, V])
-            head["decoder"] = ("lm_head.decoder.weight" if mt == "roberta"
-                               else "cls.predictions.decoder.weight")
+            head["decoder"] = {
+                "roberta": "lm_head.decoder.weight",
+                "distilbert": "vocab_projector.weight",
+            }.get(mt, "cls.predictions.decoder.weight")
         plans["mlm"] = {
             k: LeafPlan(Src(v, transpose=(k in ("w", "decoder"))),
                         shapes["mlm"][k].shape)
@@ -1120,7 +1153,8 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
     return plans
 
 
-_ENCODER_FAMILIES = {"bert": _encoder_plans, "roberta": _encoder_plans}
+_ENCODER_FAMILIES = {"bert": _encoder_plans, "roberta": _encoder_plans,
+                     "distilbert": _encoder_plans}
 
 
 # ------------------------------------------------------------------ top level

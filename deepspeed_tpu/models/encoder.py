@@ -49,7 +49,7 @@ class EncoderConfig:
     num_layers: int = 12
     num_heads: int = 12
     max_seq_len: int = 512
-    type_vocab_size: int = 2
+    type_vocab_size: int = 2             # 0 = no token types (DistilBERT)
     norm_eps: float = 1e-12
     activation: str = "gelu_exact"  # gelu_exact | gelu_new | relu | silu
     with_pooler: bool = True
@@ -127,12 +127,14 @@ class EncoderLM:
                 "wte": normal(keys[6], (v, h)),
                 "wpe": normal(keys[7],
                               (cfg.max_seq_len + cfg.position_offset, h)),
-                "tte": normal(keys[8], (cfg.type_vocab_size, h)),
                 "ln_w": jnp.ones((h,), jnp.float32),
                 "ln_b": jnp.zeros((h,), jnp.float32),
             },
             "layers": layers,
         }
+        if cfg.type_vocab_size > 0:
+            params["embed"]["tte"] = normal(keys[8],
+                                            (cfg.type_vocab_size, h))
         if cfg.with_pooler:
             params["pooler"] = {"w": normal(keys[9], (h, h)),
                                 "b": jnp.zeros((h,), jnp.float32)}
@@ -172,10 +174,11 @@ class EncoderLM:
         specs = {
             "embed": {"wte": spec("vocab", "embed"),
                       "wpe": spec(None, "embed"),
-                      "tte": spec(None, "embed"),
                       "ln_w": spec("embed"), "ln_b": spec("embed")},
             "layers": layers,
         }
+        if cfg.type_vocab_size > 0:
+            specs["embed"]["tte"] = spec(None, "embed")
         if cfg.with_pooler:
             specs["pooler"] = {"w": spec("embed", "embed"),
                                "b": spec("embed")}
@@ -226,10 +229,15 @@ class EncoderLM:
             pe = params["embed"]["wpe"][pos]
         else:
             pe = params["embed"]["wpe"][jnp.arange(T)][None]
-        tt = (token_type_ids if token_type_ids is not None
-              else jnp.zeros((B, T), jnp.int32))
-        x = (params["embed"]["wte"][tokens] + pe
-             + params["embed"]["tte"][tt]).astype(dt)
+        x = params["embed"]["wte"][tokens] + pe
+        if cfg.type_vocab_size > 0:
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros((B, T), jnp.int32))
+            x = x + params["embed"]["tte"][tt]
+        elif token_type_ids is not None:
+            raise ValueError("model has no token-type embeddings "
+                             "(type_vocab_size=0)")
+        x = x.astype(dt)
         x = _norm(x, params["embed"]["ln_w"], params["embed"]["ln_b"],
                   "layernorm", cfg.norm_eps)
 

@@ -296,10 +296,67 @@ def test_encoder_rejects_overlong_and_unknown_act():
     with pytest.raises(ValueError, match="max_seq_len"):
         model.apply(params, jnp.zeros((1, 9), jnp.int32))
     from deepspeed_tpu.models.convert import encoder_config_from_hf
-    with pytest.raises(ValueError, match="hidden_act"):
+    with pytest.raises(ValueError, match="activation"):
         encoder_config_from_hf({"model_type": "bert", "vocab_size": 10,
                                 "hidden_size": 16,
                                 "intermediate_size": 32,
                                 "num_hidden_layers": 1,
                                 "num_attention_heads": 2,
                                 "hidden_act": "tanh"})
+
+
+def test_distilbert_mlm_parity(tmp_path_factory):
+    """DistilBERT (no token types, no pooler, its own layer naming:
+    q_lin/k_lin/v_lin/out_lin, sa_layer_norm, ffn.lin1/2,
+    vocab_transform head — reference containers/distil_bert.py): MLM
+    logits match HF, incl. a padding mask."""
+    from transformers import DistilBertConfig, DistilBertForMaskedLM
+
+    cfg = DistilBertConfig(vocab_size=110, dim=32, hidden_dim=64,
+                           n_layers=2, n_heads=4,
+                           max_position_embeddings=48, dropout=0.0,
+                           attention_dropout=0.0)
+    torch.manual_seed(9)
+    hf = DistilBertForMaskedLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "distilbert_mlm")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert model.cfg.type_vocab_size == 0
+    assert not model.cfg.with_pooler and model.cfg.with_mlm_head
+    assert "tte" not in params["embed"]
+
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(0, 110, (2, 10))
+    mask = np.ones((2, 10), np.int64)
+    mask[1, 6:] = 0
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens),
+                    attention_mask=torch.tensor(mask)).logits.numpy()
+    hidden, pooled = model.apply(params, jnp.asarray(tokens, jnp.int32),
+                                 jnp.asarray(mask, jnp.int32))
+    assert pooled is None
+    ours = np.asarray(model.mlm_logits(params, hidden))
+    for b in range(2):
+        live = int(mask[b].sum())
+        np.testing.assert_allclose(ours[b, :live], theirs[b, :live],
+                                   atol=4e-4, rtol=4e-4)
+
+
+def test_distilbert_model_parity(tmp_path_factory):
+    """Bare DistilBertModel (unprefixed weights): hidden states match."""
+    from transformers import DistilBertConfig, DistilBertModel
+
+    cfg = DistilBertConfig(vocab_size=110, dim=32, hidden_dim=64,
+                           n_layers=2, n_heads=4,
+                           max_position_embeddings=48, dropout=0.0,
+                           attention_dropout=0.0)
+    torch.manual_seed(10)
+    hf = DistilBertModel(cfg).eval()
+    path = _save(hf, tmp_path_factory, "distilbert_model")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    rng = np.random.default_rng(10)
+    tokens = rng.integers(0, 110, (1, 9))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens)).last_hidden_state.numpy()
+    hidden, _ = model.apply(params, jnp.asarray(tokens, jnp.int32))
+    np.testing.assert_allclose(np.asarray(hidden), theirs,
+                               atol=4e-4, rtol=4e-4)

@@ -246,7 +246,14 @@ def test_elastic_no_exclusion_on_ambiguous_cascade(tmp_path):
         open(marker, "w").write(str(n + 1))
         if n == 0:
             if rank in ("1", "2"):
-                time.sleep(0.5)           # both dead within one poll pass
+                # barrier: both doomed ranks wait for each other, then
+                # exit aligned to the same wall-clock boundary so their
+                # failures land in ONE babysit poll window (not a race)
+                open(f"{out}/ready_r{rank}", "w").write("x")
+                while not all(os.path.exists(f"{out}/ready_r{r}")
+                              for r in ("1", "2")):
+                    time.sleep(0.01)
+                time.sleep(1.0 - (time.time() % 1.0))
                 raise SystemExit(9)
             time.sleep(30)
         open(f"{out}/final_r{rank}_w{os.environ['WORLD_SIZE']}",
@@ -267,3 +274,11 @@ def test_elastic_min_world_requires_max_restarts(tmp_path):
 
     with pytest.raises(SystemExit):
         runner.main(["--elastic_min_world", "2", "dummy.py"])
+
+
+def test_elastic_min_world_rejects_local_launcher(tmp_path):
+    from deepspeed_tpu.launcher import runner
+
+    with pytest.raises(SystemExit):
+        runner.main(["--launcher", "local", "--max_restarts", "1",
+                     "--elastic_min_world", "2", "dummy.py"])
