@@ -66,10 +66,11 @@ class PagedCausalLM:
     def _attend(self, q, kc, vc, block_tables, start_pos, n_tokens, slopes,
                 window=0):
         """Paged attention, shard_mapped over the tensor axis when TP>1."""
+        sm_scale = self.cfg.attn_scale
         if self.tp == 1:
             return self._attn_raw(q, kc, vc, block_tables, start_pos,
                                   n_tokens, alibi_slopes=slopes,
-                                  window=window)
+                                  window=window, sm_scale=sm_scale)
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
 
@@ -82,11 +83,12 @@ class PagedCausalLM:
 
         def local(q, kc, vc, tbl, sp, nt, sl):
             return attn(q, kc, vc, tbl, sp, nt, alibi_slopes=sl,
-                        window=window)
+                        window=window, sm_scale=sm_scale)
 
         if slopes is None:
             local_fn = lambda q, kc, vc, tbl, sp, nt: (  # noqa: E731
-                attn(q, kc, vc, tbl, sp, nt, window=window))
+                attn(q, kc, vc, tbl, sp, nt, window=window,
+                     sm_scale=sm_scale))
             return shard_map(
                 local_fn, mesh=self.mesh,
                 in_specs=(q_spec, kv_spec, kv_spec, rep, rep, rep),

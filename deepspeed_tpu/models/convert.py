@@ -25,10 +25,13 @@ Llama/Llama-2, Mistral (sliding-window attention applied past the window),
 GPT-J (shared-LN parallel blocks, interleaved partial rotary), Phi
 (shared-LN parallel blocks, biased projections, rotate_half partial rotary),
 StableLM (biased-LayerNorm SwiGLU, both residual layouts),
-GPT-2, Qwen2 (qkv-bias), OPT (learned positions, relu), GPT-NeoX
-(parallel residual, partial rotary, interleaved fused QKV), BLOOM (ALiBi,
-embedding LayerNorm), and Falcon 7B/40B (parallel attention, MQA/grouped
-QKV). Llama-family HF RoPE is the "rotate_half" non-interleaved layout,
+GPT-2, GPT-Neo (alternating global/local attention via the per-layer
+window tuple, unscaled softmax), Qwen2 (qkv-bias, mixed full/SWA layer
+schedules), InternLM / Llama-with-attention-bias, OPT (learned positions,
+relu), GPT-NeoX (parallel residual, partial rotary, interleaved fused
+QKV), BLOOM (ALiBi, embedding LayerNorm), and Falcon 7B/40B (parallel
+attention, MQA/grouped QKV). BERT/DistilBERT/RoBERTa load as EncoderLM
+(encoder.py). Llama-family HF RoPE is the "rotate_half" non-interleaved layout,
 matching ``models/transformer.py:apply_rope`` directly.
 """
 
@@ -304,6 +307,17 @@ def _llama_plans(cfg: TransformerConfig, shapes,
     if not cfg.shared_layernorm:   # StableLM parallel residual drops ln_2
         layers["mlp_norm_w"] = lsrc("post_attention_layernorm.weight",
                                     transpose=False)
+    if cfg.use_bias or cfg.qkv_bias:
+        # Qwen2 qkv_bias / Llama attention_bias / InternLM "bias"
+        layers["wq_b"] = lsrc("self_attn.q_proj.bias", transpose=False)
+        layers["wk_b"] = lsrc("self_attn.k_proj.bias", transpose=False)
+        layers["wv_b"] = lsrc("self_attn.v_proj.bias", transpose=False)
+    if cfg.resolved_o_bias:
+        layers["wo_b"] = lsrc("self_attn.o_proj.bias", transpose=False)
+    if cfg.mlp_bias:
+        layers["w_gate_b"] = lsrc("mlp.gate_proj.bias", transpose=False)
+        layers["w_in_b"] = lsrc("mlp.up_proj.bias", transpose=False)
+        layers["w_out_b"] = lsrc("mlp.down_proj.bias", transpose=False)
     plans = {
         "embed": {"wte": LeafPlan(Src("model.embed_tokens.weight"),
                                   shapes["embed"]["wte"].shape)},
@@ -387,18 +401,44 @@ def _gpt2_plans(cfg: TransformerConfig, shapes,
     }
 
 
-def _qwen2_plans(cfg: TransformerConfig, shapes,
-             hf_config=None) -> Dict[str, Any]:
-    """Qwen2 = Llama layout + biases on q/k/v only."""
-    plans = _llama_plans(cfg, shapes)
-    L = "model.layers.{}."
-    for leaf, fmt in (("wq_b", "self_attn.q_proj.bias"),
-                      ("wk_b", "self_attn.k_proj.bias"),
-                      ("wv_b", "self_attn.v_proj.bias")):
-        plans["layers"][leaf] = StackedLeafPlan(
-            (lambda f: lambda i: Src((L + f).format(i)))(fmt),
-            shapes["layers"][leaf].shape)
-    return plans
+def _gptneo_plans(cfg: TransformerConfig, shapes,
+                  hf_config=None) -> Dict[str, Any]:
+    """HF GPTNeoForCausalLM naming → CausalLM leaves (reference
+    module_inject/containers/gptneo.py HFGPTNEOLayerPolicy). GPT-2 layout
+    but with separate unbiased q/k/v ``nn.Linear``s ([out, in] →
+    transpose; the only attention bias is out_proj's)."""
+    L = "transformer.h.{}."
+
+    def lsrc(fmt, transpose=True):
+        return lambda i: Src((L + fmt).format(i), transpose=transpose)
+
+    layers = {
+        "attn_norm_w": lsrc("ln_1.weight", False),
+        "attn_norm_b": lsrc("ln_1.bias", False),
+        "wq": lsrc("attn.attention.q_proj.weight"),
+        "wk": lsrc("attn.attention.k_proj.weight"),
+        "wv": lsrc("attn.attention.v_proj.weight"),
+        "wo": lsrc("attn.attention.out_proj.weight"),
+        "wo_b": lsrc("attn.attention.out_proj.bias", False),
+        "mlp_norm_w": lsrc("ln_2.weight", False),
+        "mlp_norm_b": lsrc("ln_2.bias", False),
+        "w_in": lsrc("mlp.c_fc.weight"),
+        "w_in_b": lsrc("mlp.c_fc.bias", False),
+        "w_out": lsrc("mlp.c_proj.weight"),
+        "w_out_b": lsrc("mlp.c_proj.bias", False),
+    }
+    return {
+        "embed": {"wte": LeafPlan(Src("transformer.wte.weight"),
+                                  shapes["embed"]["wte"].shape),
+                  "wpe": LeafPlan(Src("transformer.wpe.weight"),
+                                  shapes["embed"]["wpe"].shape)},
+        "layers": {k: StackedLeafPlan(mk, shapes["layers"][k].shape)
+                   for k, mk in layers.items()},
+        "final_norm": {"w": LeafPlan(Src("transformer.ln_f.weight"),
+                                     shapes["final_norm"]["w"].shape),
+                       "b": LeafPlan(Src("transformer.ln_f.bias"),
+                                     shapes["final_norm"]["b"].shape)},
+    }
 
 
 def _opt_plans(cfg: TransformerConfig, shapes,
@@ -746,7 +786,9 @@ def _falcon_plans(cfg: TransformerConfig, shapes,
 
 
 _FAMILIES = {"llama": _llama_plans, "mistral": _llama_plans,
-             "gpt2": _gpt2_plans, "qwen2": _qwen2_plans, "opt": _opt_plans,
+             "internlm": _llama_plans,
+             "gpt2": _gpt2_plans, "gpt_neo": _gptneo_plans,
+             "qwen2": _llama_plans, "opt": _opt_plans,
              "gpt_neox": _neox_plans, "bloom": _bloom_plans,
              "falcon": _falcon_plans, "gptj": _gptj_plans,
              "phi": _phi_plans, "stablelm": _stablelm_plans}
@@ -784,7 +826,12 @@ def config_from_hf(hf_config: Dict[str, Any],
     """HF ``config.json`` dict → TransformerConfig (reference: the per-model
     policy classes, module_inject/policy.py)."""
     mt = hf_config.get("model_type", "")
-    if mt in ("llama", "mistral"):
+    if mt in ("llama", "mistral", "internlm"):
+        # InternLM (reference module_inject/containers/internlm.py) is the
+        # Llama layout + biased attention projections ("bias": true); HF
+        # Llama itself exposes the same via attention_bias
+        biased = bool(hf_config.get("attention_bias",
+                                    hf_config.get("bias", False)))
         return TransformerConfig(
             vocab_size=hf_config["vocab_size"],
             hidden_size=hf_config["hidden_size"],
@@ -799,7 +846,38 @@ def config_from_hf(hf_config: Dict[str, Any],
             norm="rmsnorm", activation="silu", position="rope",
             rope_theta=hf_config.get("rope_theta", 10000.0),
             tie_embeddings=hf_config.get("tie_word_embeddings", False),
+            use_bias=biased, o_bias=biased,
+            mlp_bias=bool(hf_config.get("mlp_bias", False)),
             norm_eps=hf_config.get("rms_norm_eps", 1e-5),
+            dtype=dtype)
+    if mt == "gpt_neo":
+        # Reference module_inject/containers/gptneo.py. Alternating
+        # global/local attention maps onto the per-layer window tuple
+        # (local = causal sliding window of window_size, exactly our
+        # band semantics); attention is UNSCALED (HF GPTNeoSelfAttention
+        # sets softmax_scale 1.0) → attn_scale=1.0.
+        h = hf_config["hidden_size"]
+        att = hf_config.get("attention_layers")
+        if att is None:
+            # expand attention_types [[["global","local"], 12]] form
+            att = []
+            for kinds, n in hf_config.get("attention_types",
+                                          [[["global"], 1]]):
+                att += list(kinds) * n
+        win = hf_config.get("window_size", 256)
+        windows = tuple(win if t == "local" else None for t in att)
+        return TransformerConfig(
+            vocab_size=hf_config["vocab_size"],
+            hidden_size=h,
+            intermediate_size=hf_config.get("intermediate_size") or 4 * h,
+            num_layers=hf_config["num_layers"],
+            num_heads=hf_config["num_heads"],
+            max_seq_len=hf_config.get("max_position_embeddings", 2048),
+            sliding_window=(None if not any(windows) else windows),
+            norm="layernorm", activation="gelu", position="learned",
+            tie_embeddings=True, use_bias=False, o_bias=True,
+            mlp_bias=True, attn_scale=1.0,
+            norm_eps=hf_config.get("layer_norm_epsilon", 1e-5),
             dtype=dtype)
     if mt == "gpt2":
         h = hf_config["n_embd"]

@@ -67,6 +67,11 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     use_bias: bool = False               # linear biases (GPT-2/OPT style)
     qkv_bias: bool = False               # biases on q/k/v only (Qwen2)
+    o_bias: Optional[bool] = None        # attn out-proj bias; None → use_bias
+    attn_scale: Optional[float] = None   # softmax scale; None → 1/√head_dim
+    #   (GPT-Neo trains UNSCALED attention — scale 1.0 — folding the
+    #   normalization into its init; HF GPTNeoSelfAttention matmuls q·kᵀ
+    #   raw, so parity requires the override)
     mlp_bias: Optional[bool] = None      # MLP biases; None → use_bias (GPT-J)
     lm_head_bias: bool = False           # bias on the LM head (GPT-J)
     parallel_residual: bool = False      # x + attn(ln1 x) + mlp(ln2 x) (NeoX/Falcon)
@@ -105,6 +110,11 @@ class TransformerConfig:
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
+
+    @property
+    def resolved_o_bias(self) -> bool:
+        """Attention out-proj bias (o_bias overrides; None → use_bias)."""
+        return self.use_bias if self.o_bias is None else self.o_bias
 
     @property
     def rot_dim(self) -> int:
@@ -301,7 +311,7 @@ def alibi_slopes(num_heads: int) -> jnp.ndarray:
 
 
 def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None,
-                        window: int = 0):
+                        window: int = 0, scale=None):
     """Pure-XLA attention: q [B,T,H,D], k/v [B,S,KH,D].
 
     GQA is expressed as an einsum over the [KH, group] head factorization —
@@ -313,7 +323,7 @@ def attention_reference(q, k, v, causal: bool = True, mask=None, bias=None,
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
     group = H // KH
-    scale = 1.0 / math.sqrt(D)
+    scale = 1.0 / math.sqrt(D) if scale is None else float(scale)
     qg = q.reshape(B, T, KH, group, D)
     logits = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
     if bias is not None:
@@ -383,6 +393,11 @@ def _local_attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
     if cfg.attention_impl == "sparse" and q.shape[1] == k.shape[1]:
         from ..ops.sparse_attention import sparse_attention as sparse_attn
 
+        if cfg.attn_scale is not None:
+            raise NotImplementedError(
+                "attn_scale does not compose with attention_impl='sparse' "
+                "(the block-sparse op bakes 1/sqrt(d))")
+
         if window:
             raise NotImplementedError(
                 "sliding_window does not compose with attention_impl="
@@ -403,10 +418,11 @@ def _local_attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
             return flash_attention(q, k, v, causal=causal,
                                    block_q=cfg.flash_block_q,
                                    block_kv=cfg.flash_block_kv,
-                                   window=window)
+                                   window=window, sm_scale=cfg.attn_scale)
         except Exception:
             pass
-    return attention_reference(q, k, v, causal=causal, window=window)
+    return attention_reference(q, k, v, causal=causal, window=window,
+                               scale=cfg.attn_scale)
 
 
 def _seq_parallel_size() -> int:
@@ -447,7 +463,8 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
                 "(the block-sparse op takes no logit bias)")
         S = k.shape[1]
         bias = alibi_slopes(cfg.num_heads)[:, None] * jnp.arange(S)[None, :]
-        return attention_reference(q, k, v, causal=causal, bias=bias)
+        return attention_reference(q, k, v, causal=causal, bias=bias,
+                                   scale=cfg.attn_scale)
 
     sp = _seq_parallel_size()
     if sp <= 1:
@@ -470,6 +487,10 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
 
     if cfg.attention_impl == "ring":
         from ..sequence.ring_attention import ring_attention
+
+        if cfg.attn_scale is not None:
+            raise NotImplementedError(
+                "attn_scale does not compose with ring attention yet")
 
         fn = shard_map(_partial(ring_attention, causal=causal,
                                 axis_name=topo.SEQUENCE_AXIS,
@@ -567,7 +588,7 @@ class CausalLM:
             layers["wq_b"] = jnp.zeros((L, nh * hd), jnp.float32)
             layers["wk_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
             layers["wv_b"] = jnp.zeros((L, kvh * hd), jnp.float32)
-        if cfg.use_bias:
+        if cfg.resolved_o_bias:
             layers["wo_b"] = jnp.zeros((L, h), jnp.float32)
         if mlp_bias:
             layers["w_in_b"] = jnp.zeros((L, m), jnp.float32)
@@ -628,7 +649,7 @@ class CausalLM:
             layers["wq_b"] = spec("layers", "heads")
             layers["wk_b"] = spec("layers", "kv_heads")
             layers["wv_b"] = spec("layers", "kv_heads")
-        if cfg.use_bias:
+        if cfg.resolved_o_bias:
             layers["wo_b"] = spec("layers", "embed")
         if mlp_bias:
             layers["w_in_b"] = spec("layers", "mlp")
@@ -1043,7 +1064,8 @@ class CausalLM:
                 from ..ops.paged_attention import paged_attention
 
                 attn = paged_attention(q, kc, vc, tables, pos, n_tok,
-                                       alibi_slopes=slopes, window=win)
+                                       alibi_slopes=slopes, window=win,
+                                       sm_scale=cfg.attn_scale)
                 attn = _linear(attn.reshape(B, 1, -1), lp["wo"],
                                lp.get("wo_b"), cfg.dtype)
                 return self._attn_mlp_merge(x, attn, lp, h1), (kc, vc)
@@ -1130,7 +1152,7 @@ class CausalLM:
             bias = alibi_slopes(cfg.num_heads)[:, None] \
                 * jnp.arange(S)[None, :]
         attn = attention_reference(q, kc, vc, causal=False, mask=mask,
-                                   bias=bias)
+                                   bias=bias, scale=cfg.attn_scale)
         attn = _linear(attn.reshape(B, 1, -1), lp["wo"], lp.get("wo_b"),
                        cfg.dtype)
         return self._attn_mlp_merge(x, attn, lp, h1), kc, vc

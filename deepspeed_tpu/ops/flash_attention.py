@@ -278,12 +278,13 @@ def _causal_kv_clamp(causal, bq, bkv, offs, window=0):
     return clamp
 
 
-def _fwd_pallas(q, k, v, causal, block_q, block_kv, window, *, interpret):
+def _fwd_pallas(q, k, v, causal, block_q, block_kv, window, sm_scale=None,
+                *, interpret):
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
     group = H // KH
     bq, bkv = _block_sizes(T, S, block_q, block_kv)
-    sm_scale = 1.0 / math.sqrt(D)
+    sm_scale = 1.0 / math.sqrt(D) if sm_scale is None else float(sm_scale)
     # head-major views: q [B,H,T,D], k/v [B,KH,S,D]
     qh = q.transpose(0, 2, 1, 3)
     kh = k.transpose(0, 2, 1, 3)
@@ -324,13 +325,13 @@ def _fwd_pallas(q, k, v, causal, block_q, block_kv, window, *, interpret):
     return o, lse        # o in head-major [B,H,T,D]; caller transposes
 
 
-def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, window, *,
-                interpret):
+def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, window,
+                sm_scale=None, *, interpret):
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
     group = H // KH
     bq, bkv = _block_sizes(T, S, block_q, block_kv)
-    sm_scale = 1.0 / math.sqrt(D)
+    sm_scale = 1.0 / math.sqrt(D) if sm_scale is None else float(sm_scale)
 
     qh = q.transpose(0, 2, 1, 3)         # [B,H,T,D]
     kh = k.transpose(0, 2, 1, 3)         # [B,KH,S,D]
@@ -415,14 +416,15 @@ def _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv, window, *,
 
 # ------------------------------------------------------------------- reference
 
-def _attention_xla(q, k, v, causal: bool, window: int = 0):
+def _attention_xla(q, k, v, causal: bool, window: int = 0, sm_scale=None):
     """Grouped-head XLA attention reference (no KV repeat: einsum over the
     [KH, group] factorization)."""
     B, T, H, D = q.shape
     S, KH = k.shape[1], k.shape[2]
     group = H // KH
+    scale = 1.0 / math.sqrt(D) if sm_scale is None else float(sm_scale)
     qg = q.reshape(B, T, KH, group, D)
-    s = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) / math.sqrt(D)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k).astype(jnp.float32) * scale
     qpos = jnp.arange(T)[:, None] + (S - T)
     kpos = jnp.arange(S)[None, :]
     if causal:
@@ -436,9 +438,9 @@ def _attention_xla(q, k, v, causal: bool, window: int = 0):
 
 # ------------------------------------------------------------------ public api
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
-                    block_kv: int = 512, window: int = 0):
+                    block_kv: int = 512, window: int = 0, sm_scale=None):
     """Blocked flash attention; Pallas on TPU, XLA elsewhere.
 
     q: [B, T, H, D]; k/v: [B, S, KH, D] with H % KH == 0 (GQA/MQA).
@@ -448,7 +450,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
     compute and HBM traffic (reference parity:
     inference/v2/model_implementations/mistral/model.py:202).
     """
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv, window)
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv, window,
+                        sm_scale)
     return out
 
 
@@ -460,24 +463,25 @@ def _pallas_enabled(q, k, block_q, block_kv):
     return _on_tpu() or _FORCE_INTERPRET
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_kv, window=0):
+def _flash_fwd(q, k, v, causal, block_q, block_kv, window=0, sm_scale=None):
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
     if _pallas_enabled(q, k, block_q, block_kv):
         o_hm, lse = _fwd_pallas(q, k, v, causal, block_q, block_kv, window,
-                                interpret=_use_interpret())
+                                sm_scale, interpret=_use_interpret())
         return o_hm.transpose(0, 2, 1, 3), (q, k, v, o_hm, lse)
-    o = _attention_xla(q, k, v, causal, window)
+    o = _attention_xla(q, k, v, causal, window, sm_scale)
     return o, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, block_q, block_kv, window, res, g):
+def _flash_bwd(causal, block_q, block_kv, window, sm_scale, res, g):
     q, k, v, o_hm, lse = res
     if o_hm is not None and _pallas_enabled(q, k, block_q, block_kv):
         return _bwd_pallas(q, k, v, o_hm, lse, g, causal, block_q, block_kv,
-                           window, interpret=_use_interpret())
-    _, vjp = jax.vjp(lambda q, k, v: _attention_xla(q, k, v, causal, window),
-                     q, k, v)
+                           window, sm_scale, interpret=_use_interpret())
+    _, vjp = jax.vjp(
+        lambda q, k, v: _attention_xla(q, k, v, causal, window, sm_scale),
+        q, k, v)
     return vjp(g)
 
 

@@ -147,12 +147,13 @@ def _clamp_tables(block_tables, ctx_len, block_size, start_pos=None,
 
 
 def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
-                  alibi_slopes=None, window: int = 0, interpret: bool):
+                  alibi_slopes=None, window: int = 0, sm_scale=None,
+                  interpret: bool):
     N, C, H, D = q.shape
     NB, KH, bs, _ = k_pool.shape
     G = H // KH
     MB = block_tables.shape[1]
-    sm_scale = 1.0 / math.sqrt(D)
+    sm_scale = 1.0 / math.sqrt(D) if sm_scale is None else float(sm_scale)
 
     # [N, C, H, D] -> [N, KH, G*C, D]: row r = g*C + ci
     qh = q.transpose(0, 2, 1, 3).reshape(N, KH, G * C, D)
@@ -207,14 +208,14 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
 # ----------------------------------------------------------- XLA reference
 
 def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
-                        alibi_slopes=None, window: int = 0):
+                        alibi_slopes=None, window: int = 0, sm_scale=None):
     """Dense-gather formulation (the pre-Pallas path): gather the table into
     [N, MB*bs, KH, D] and mask. Numerically the kernel's reference."""
     N, C, H, D = q.shape
     NB, KH, bs, _ = k_pool.shape
     G = H // KH
     MB = block_tables.shape[1]
-    sm_scale = 1.0 / math.sqrt(D)
+    sm_scale = 1.0 / math.sqrt(D) if sm_scale is None else float(sm_scale)
 
     ctx_positions = jnp.arange(MB * bs)
     tbl = jnp.maximum(block_tables, 0)
@@ -263,7 +264,7 @@ def _pallas_ok(q, k_pool) -> bool:
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
-                    alibi_slopes=None, window: int = 0):
+                    alibi_slopes=None, window: int = 0, sm_scale=None):
     """Block-table paged attention.
 
     q [N, C, H, D]; k/v pool [NB, KH, bs, D]; block_tables [N, MB]
@@ -280,7 +281,8 @@ def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
     if _pallas_ok(q, k_pool):
         return _paged_pallas(q, k_pool, v_pool, block_tables, start_pos,
                              n_tokens, alibi_slopes=alibi_slopes,
-                             window=window, interpret=_use_interpret())
+                             window=window, sm_scale=sm_scale,
+                             interpret=_use_interpret())
     return paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos,
                                n_tokens, alibi_slopes=alibi_slopes,
-                               window=window)
+                               window=window, sm_scale=sm_scale)

@@ -582,3 +582,76 @@ def test_qwen2_mixed_window_v2_serving(tmp_path_factory):
         nxt = int(np.argmax(ref))
         seq.append(nxt)
         logits = engine.put([1], [[nxt]])
+
+
+def test_gptneo_forward_parity(tmp_path_factory):
+    """GPT-Neo (reference module_inject/containers/gptneo.py): alternating
+    global/LOCAL attention — the local layers are causal sliding windows
+    riding the per-layer window tuple — plus UNSCALED attention
+    (attn_scale=1.0) and out-proj-only attention bias. Logits pinned vs
+    HF at seq > window_size."""
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+    cfg = GPTNeoConfig(vocab_size=130, hidden_size=32, num_layers=4,
+                       attention_types=[[["global", "local"], 2]],
+                       num_heads=4, intermediate_size=64,
+                       max_position_embeddings=64, window_size=8,
+                       embed_dropout=0.0, attention_dropout=0.0,
+                       resid_dropout=0.0)
+    torch.manual_seed(12)
+    hf = GPTNeoForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "gptneo")
+    # seq=20 > window=8: local layers must mask past-window keys
+    model = _parity(path, hf, 130, seq=20)
+    assert model.cfg.attn_scale == 1.0
+    assert model.cfg.layer_windows() == (0, 8, 0, 8)
+    assert model.cfg.o_bias and not model.cfg.use_bias
+
+
+def test_gptneo_generate_matches_hf(tmp_path_factory):
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.models import from_pretrained
+
+    cfg = GPTNeoConfig(vocab_size=130, hidden_size=32, num_layers=4,
+                       attention_types=[[["global", "local"], 2]],
+                       num_heads=4, intermediate_size=64,
+                       max_position_embeddings=64, window_size=8,
+                       embed_dropout=0.0, attention_dropout=0.0,
+                       resid_dropout=0.0)
+    torch.manual_seed(13)
+    hf = GPTNeoForCausalLM(cfg).eval()
+    path = _save(hf, tmp_path_factory, "gptneo_gen")
+    model, params = from_pretrained(path, dtype=jnp.float32,
+                                    attention_impl="reference")
+    engine = InferenceEngine(model, params=params)
+    prompt = np.random.default_rng(31).integers(0, 130, size=(2, 12))
+    ours = np.asarray(engine.generate(jnp.asarray(prompt, jnp.int32),
+                                      max_new_tokens=8))
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt), max_new_tokens=8,
+                             do_sample=False, eos_token_id=None).numpy()
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_llama_attention_bias_parity(tmp_path_factory):
+    """Llama with attention_bias=True (the InternLM layout — reference
+    module_inject/containers/internlm.py: Llama + biased q/k/v/o): biases
+    load and logits match HF."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=120, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64,
+                      attention_bias=True, tie_word_embeddings=False)
+    torch.manual_seed(14)
+    hf = LlamaForCausalLM(cfg).eval()
+    with torch.no_grad():   # nonzero biases so the path is exercised
+        for layer in hf.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.uniform_(-0.3, 0.3)
+    path = _save(hf, tmp_path_factory, "llama_bias")
+    model = _parity(path, hf, 120)
+    assert model.cfg.use_bias and model.cfg.mlp_bias is False
