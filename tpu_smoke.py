@@ -168,6 +168,24 @@ def _paged_case(rng, N, C, H, KH, D, bs, MB, NB, ctx_lens):
             jnp.asarray(start_pos, jnp.int32), jnp.asarray(n_tokens, jnp.int32))
 
 
+@check("flash_unscaled_attention")
+def _flash_unscaled():
+    """r5 attn_scale threading (GPT-Neo's scale-1.0 softmax): the Pallas
+    kernel with sm_scale=1.0 matches the XLA reference on hardware."""
+    from deepspeed_tpu.ops.flash_attention import (flash_attention,
+                                                   _attention_xla)
+    rng = np.random.default_rng(5)
+    B, T, H, D = 1, 1024, 8, 64
+    q = jnp.asarray(0.1 * rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(0.1 * rng.standard_normal((B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    out = flash_attention(q, k, v, causal=True, sm_scale=1.0)
+    ref = _attention_xla(q, k, v, True, 0, 1.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+    return {"sm_scale": 1.0}
+
+
 @check("paged_decode_blocktables_gqa")
 def _paged():
     from deepspeed_tpu.ops import paged_attention as pa
