@@ -127,6 +127,11 @@ class InferenceEngine:
             self._encode_jit = jax.jit(self.module.apply)
             self._mlm_jit = (jax.jit(self.module.mlm_logits)
                              if self.module.cfg.with_mlm_head else None)
+            # head-only jit: classify() reuses encode()'s compiled trunk
+            self._cls_jit = (
+                jax.jit(lambda params, pooled: self.module._classifier_head(
+                    params, pooled))
+                if getattr(self.module.cfg, "num_labels", 0) else None)
         self._gen_cache: Dict[tuple, Any] = {}
 
     # ------------------------------------------------------------------ API
@@ -158,6 +163,16 @@ class InferenceEngine:
                              "with_mlm_head=False)")
         hidden, _ = self.encode(input_ids, attention_mask, token_type_ids)
         return self._mlm_jit(self.params, hidden)
+
+    def classify(self, input_ids, attention_mask=None, token_type_ids=None):
+        """Sequence-classification logits [B, num_labels]
+        (BertForSequenceClassification serving surface). Reuses encode()'s
+        compiled trunk + a jitted head (the mlm() pattern)."""
+        if not self._is_encoder or self._cls_jit is None:
+            raise ValueError("model has no classification head (not an "
+                             "encoder, or num_labels=0)")
+        _, pooled = self.encode(input_ids, attention_mask, token_type_ids)
+        return self._cls_jit(self.params, pooled)
 
     @staticmethod
     def _sample(logits, rng, temperature, top_k: int):

@@ -55,6 +55,8 @@ class EncoderConfig:
     with_pooler: bool = True
     with_mlm_head: bool = False
     tie_mlm_decoder: bool = True         # False: distinct decoder weight
+    num_labels: int = 0                  # >0: classification head on the
+    #   pooled [CLS] (BertForSequenceClassification serving)
     # RoBERTa offsets positions by pad_token_id+1 (fairseq legacy): position
     # ids start at padding_idx+1 instead of 0
     position_offset: int = 0
@@ -73,7 +75,9 @@ class EncoderConfig:
         mlm = (h * h + h + 2 * h + v) if self.with_mlm_head else 0
         if self.with_mlm_head and not self.tie_mlm_decoder:
             mlm += h * v
-        return self.num_layers * per_layer + emb + pool + mlm
+        cls = (h * self.num_labels + self.num_labels) if self.num_labels \
+            else 0
+        return self.num_layers * per_layer + emb + pool + mlm + cls
 
 
 BERT_BASE = EncoderConfig()
@@ -95,7 +99,7 @@ class EncoderLM:
         cfg = self.cfg
         h, m, v, L = (cfg.hidden_size, cfg.intermediate_size,
                       cfg.vocab_size, cfg.num_layers)
-        keys = jax.random.split(rng, 12)
+        keys = jax.random.split(rng, 13)
         std = 0.02
 
         def normal(key, shape, scale=std):
@@ -146,6 +150,10 @@ class EncoderLM:
                              "bias": jnp.zeros((v,), jnp.float32)}
             if not cfg.tie_mlm_decoder:
                 params["mlm"]["decoder"] = normal(keys[11], (h, v))
+        if cfg.num_labels:
+            params["classifier"] = {
+                "w": normal(keys[12], (h, cfg.num_labels)),
+                "b": jnp.zeros((cfg.num_labels,), jnp.float32)}
         return params
 
     # -- sharding specs -----------------------------------------------------
@@ -188,6 +196,9 @@ class EncoderLM:
                             "bias": spec("vocab")}
             if not cfg.tie_mlm_decoder:
                 specs["mlm"]["decoder"] = spec("embed", "vocab")
+        if cfg.num_labels:
+            specs["classifier"] = {"w": spec("embed", None),
+                                   "b": spec(None)}
         return specs
 
     # -- forward ------------------------------------------------------------
@@ -280,6 +291,24 @@ class EncoderLM:
         dec = (params["embed"]["wte"].T if "decoder" not in mp
                else mp["decoder"])
         return h @ dec.astype(cfg.dtype) + mp["bias"].astype(cfg.dtype)
+
+    def _classifier_head(self, params, pooled):
+        """pooled [B, H] → logits [B, num_labels] (dropout is eval-off)."""
+        if pooled is None:
+            raise ValueError("classification head needs the pooler")
+        return _linear(pooled, params["classifier"]["w"],
+                       params["classifier"]["b"], self.cfg.dtype)
+
+    def classify(self, params, tokens, attention_mask=None,
+                 token_type_ids=None):
+        """Sequence-classification logits [B, num_labels]
+        (BertForSequenceClassification serving: pooled [CLS] → linear)."""
+        cfg = self.cfg
+        if not cfg.num_labels or "classifier" not in params:
+            raise ValueError("model built without num_labels")
+        _, pooled = self.apply(params, tokens, attention_mask,
+                               token_type_ids)
+        return self._classifier_head(params, pooled)
 
     # convenience
     def num_params(self) -> int:

@@ -360,3 +360,29 @@ def test_distilbert_model_parity(tmp_path_factory):
     hidden, _ = model.apply(params, jnp.asarray(tokens, jnp.int32))
     np.testing.assert_allclose(np.asarray(hidden), theirs,
                                atol=4e-4, rtol=4e-4)
+
+
+def test_bert_sequence_classification_parity(tmp_path_factory):
+    """BertForSequenceClassification checkpoints serve end to end: the
+    classifier head loads and engine.classify() matches HF logits (the
+    trunk-only load would leave task checkpoints unusable)."""
+    from transformers import BertForSequenceClassification
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = _bert_cfg(num_labels=3)
+    torch.manual_seed(11)
+    hf = BertForSequenceClassification(cfg).eval()
+    path = _save(hf, tmp_path_factory, "bert_cls")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert model.cfg.num_labels == 3 and model.cfg.with_pooler
+    engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(0, 99, (2, 9))
+    mask = np.ones((2, 9), np.int64)
+    mask[1, 5:] = 0
+    ours = np.asarray(engine.classify(tokens, mask))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens),
+                    attention_mask=torch.tensor(mask)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=4e-4, rtol=4e-4)
