@@ -129,8 +129,7 @@ class InferenceEngine:
                              if self.module.cfg.with_mlm_head else None)
             # head-only jit: classify() reuses encode()'s compiled trunk
             self._cls_jit = (
-                jax.jit(lambda params, pooled: self.module._classifier_head(
-                    params, pooled))
+                jax.jit(self.module._classifier_head)
                 if getattr(self.module.cfg, "num_labels", 0) else None)
         self._gen_cache: Dict[tuple, Any] = {}
 
@@ -171,8 +170,11 @@ class InferenceEngine:
         if not self._is_encoder or self._cls_jit is None:
             raise ValueError("model has no classification head (not an "
                              "encoder, or num_labels=0)")
-        _, pooled = self.encode(input_ids, attention_mask, token_type_ids)
-        return self._cls_jit(self.params, pooled)
+        hidden, pooled = self.encode(input_ids, attention_mask,
+                                     token_type_ids)
+        # pass only [CLS] — a full [B, T, H] hidden would retrace the
+        # head jit per sequence length
+        return self._cls_jit(self.params, hidden[:, :1], pooled)
 
     @staticmethod
     def _sample(logits, rng, temperature, top_k: int):

@@ -1124,10 +1124,12 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
             tie_mlm_decoder=hf_config.get(
                 "tie_word_embeddings", hf_config.get("tie_weights_", True)),
             dtype=dtype)
-    n_labels = 0
-    if _encoder_arch(hf_config) == "BertForSequenceClassification":
+    n_labels, rob_head = 0, False
+    if _encoder_arch(hf_config) in ("BertForSequenceClassification",
+                                    "RobertaForSequenceClassification"):
         n_labels = int(hf_config.get("num_labels")
                        or len(hf_config.get("id2label") or ()) or 2)
+        rob_head = mt == "roberta"
     return EncoderConfig(
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
@@ -1139,7 +1141,8 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
         norm_eps=hf_config.get("layer_norm_eps", 1e-12),
         activation=act, with_pooler=pooler, with_mlm_head=mlm,
         tie_mlm_decoder=hf_config.get("tie_word_embeddings", True),
-        num_labels=n_labels, position_offset=offset, dtype=dtype)
+        num_labels=n_labels, roberta_cls_head=rob_head,
+        position_offset=offset, dtype=dtype)
 
 
 def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
@@ -1233,11 +1236,24 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                         shapes["mlm"][k].shape)
             for k, v in head.items()}
     if cfg.num_labels:
-        plans["classifier"] = {
-            "w": LeafPlan(Src("classifier.weight", transpose=True),
-                          shapes["classifier"]["w"].shape),
-            "b": LeafPlan(Src("classifier.bias"),
-                          shapes["classifier"]["b"].shape)}
+        if cfg.roberta_cls_head:
+            plans["classifier"] = {
+                "w": LeafPlan(Src("classifier.out_proj.weight",
+                                  transpose=True),
+                              shapes["classifier"]["w"].shape),
+                "b": LeafPlan(Src("classifier.out_proj.bias"),
+                              shapes["classifier"]["b"].shape),
+                "dense_w": LeafPlan(Src("classifier.dense.weight",
+                                        transpose=True),
+                                    shapes["classifier"]["dense_w"].shape),
+                "dense_b": LeafPlan(Src("classifier.dense.bias"),
+                                    shapes["classifier"]["dense_b"].shape)}
+        else:
+            plans["classifier"] = {
+                "w": LeafPlan(Src("classifier.weight", transpose=True),
+                              shapes["classifier"]["w"].shape),
+                "b": LeafPlan(Src("classifier.bias"),
+                              shapes["classifier"]["b"].shape)}
     return plans
 
 

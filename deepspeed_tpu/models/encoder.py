@@ -57,6 +57,8 @@ class EncoderConfig:
     tie_mlm_decoder: bool = True         # False: distinct decoder weight
     num_labels: int = 0                  # >0: classification head on the
     #   pooled [CLS] (BertForSequenceClassification serving)
+    roberta_cls_head: bool = False       # RoBERTa-style head: dense+tanh+
+    #   out_proj on hidden[:, 0] (no pooler in RobertaFor* task models)
     # RoBERTa offsets positions by pad_token_id+1 (fairseq legacy): position
     # ids start at padding_idx+1 instead of 0
     position_offset: int = 0
@@ -77,6 +79,8 @@ class EncoderConfig:
             mlm += h * v
         cls = (h * self.num_labels + self.num_labels) if self.num_labels \
             else 0
+        if self.num_labels and self.roberta_cls_head:
+            cls += h * h + h                 # the extra dense layer
         return self.num_layers * per_layer + emb + pool + mlm + cls
 
 
@@ -99,7 +103,7 @@ class EncoderLM:
         cfg = self.cfg
         h, m, v, L = (cfg.hidden_size, cfg.intermediate_size,
                       cfg.vocab_size, cfg.num_layers)
-        keys = jax.random.split(rng, 13)
+        keys = jax.random.split(rng, 14)
         std = 0.02
 
         def normal(key, shape, scale=std):
@@ -154,6 +158,10 @@ class EncoderLM:
             params["classifier"] = {
                 "w": normal(keys[12], (h, cfg.num_labels)),
                 "b": jnp.zeros((cfg.num_labels,), jnp.float32)}
+            if cfg.roberta_cls_head:
+                params["classifier"]["dense_w"] = normal(keys[13], (h, h))
+                params["classifier"]["dense_b"] = jnp.zeros((h,),
+                                                            jnp.float32)
         return params
 
     # -- sharding specs -----------------------------------------------------
@@ -199,6 +207,9 @@ class EncoderLM:
         if cfg.num_labels:
             specs["classifier"] = {"w": spec("embed", None),
                                    "b": spec(None)}
+            if cfg.roberta_cls_head:
+                specs["classifier"]["dense_w"] = spec("embed", "embed")
+                specs["classifier"]["dense_b"] = spec("embed")
         return specs
 
     # -- forward ------------------------------------------------------------
@@ -292,23 +303,31 @@ class EncoderLM:
                else mp["decoder"])
         return h @ dec.astype(cfg.dtype) + mp["bias"].astype(cfg.dtype)
 
-    def _classifier_head(self, params, pooled):
-        """pooled [B, H] → logits [B, num_labels] (dropout is eval-off)."""
-        if pooled is None:
-            raise ValueError("classification head needs the pooler")
-        return _linear(pooled, params["classifier"]["w"],
-                       params["classifier"]["b"], self.cfg.dtype)
+    def _classifier_head(self, params, hidden, pooled):
+        """→ logits [B, num_labels] (dropout is eval-off). BERT: linear
+        on the (tanh) pooler output; RoBERTa: its own dense+tanh head on
+        hidden[:, 0] (RobertaClassificationHead — task models carry no
+        pooler)."""
+        cp = params["classifier"]
+        if self.cfg.roberta_cls_head:
+            x = jnp.tanh(_linear(hidden[:, 0], cp["dense_w"],
+                                 cp["dense_b"], self.cfg.dtype))
+        else:
+            if pooled is None:
+                raise ValueError("classification head needs the pooler")
+            x = pooled
+        return _linear(x, cp["w"], cp["b"], self.cfg.dtype)
 
     def classify(self, params, tokens, attention_mask=None,
                  token_type_ids=None):
         """Sequence-classification logits [B, num_labels]
-        (BertForSequenceClassification serving: pooled [CLS] → linear)."""
+        (Bert/RobertaForSequenceClassification serving)."""
         cfg = self.cfg
         if not cfg.num_labels or "classifier" not in params:
             raise ValueError("model built without num_labels")
-        _, pooled = self.apply(params, tokens, attention_mask,
-                               token_type_ids)
-        return self._classifier_head(params, pooled)
+        hidden, pooled = self.apply(params, tokens, attention_mask,
+                                    token_type_ids)
+        return self._classifier_head(params, hidden, pooled)
 
     # convenience
     def num_params(self) -> int:

@@ -386,3 +386,31 @@ def test_bert_sequence_classification_parity(tmp_path_factory):
         theirs = hf(torch.tensor(tokens),
                     attention_mask=torch.tensor(mask)).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=4e-4, rtol=4e-4)
+
+
+def test_roberta_sequence_classification_parity(tmp_path_factory):
+    """RobertaForSequenceClassification: its own dense+tanh+out_proj head
+    on hidden[:, 0] (no pooler) loads and engine.classify() matches HF."""
+    from transformers import RobertaConfig, RobertaForSequenceClassification
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = RobertaConfig(vocab_size=120, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, max_position_embeddings=50,
+                        type_vocab_size=1, pad_token_id=1, num_labels=4,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0,
+                        classifier_dropout=0.0)
+    torch.manual_seed(12)
+    hf = RobertaForSequenceClassification(cfg).eval()
+    path = _save(hf, tmp_path_factory, "roberta_cls")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert model.cfg.roberta_cls_head and not model.cfg.with_pooler
+    engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(2, 120, (2, 9))
+    ours = np.asarray(engine.classify(tokens))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=4e-4, rtol=4e-4)
