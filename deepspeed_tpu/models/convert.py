@@ -1106,6 +1106,13 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
         raise ValueError(
             f"unsupported encoder activation {raw_act!r} — loading it as "
             "gelu would silently diverge from HF")
+    n_labels, head_style = 0, "pooled"
+    if _encoder_arch(hf_config) in ("BertForSequenceClassification",
+                                    "RobertaForSequenceClassification",
+                                    "DistilBertForSequenceClassification"):
+        n_labels = int(hf_config.get("num_labels")
+                       or len(hf_config.get("id2label") or ()) or 2)
+        head_style = mt if mt in ("roberta", "distilbert") else "pooled"
     if mt == "distilbert":
         # DistilBertConfig naming: dim/hidden_dim/n_layers/n_heads; no
         # token types, no pooler; sinusoidal_pos_embds still stores a
@@ -1118,18 +1125,13 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
             num_heads=hf_config["n_heads"],
             max_seq_len=hf_config.get("max_position_embeddings", 512),
             type_vocab_size=0,
+            num_labels=n_labels, cls_head=head_style,
             activation=act, with_pooler=False, with_mlm_head=mlm,
             # modern transformers ties via tie_word_embeddings; legacy
             # hub configs carry tie_weights_ (always true there)
             tie_mlm_decoder=hf_config.get(
                 "tie_word_embeddings", hf_config.get("tie_weights_", True)),
             dtype=dtype)
-    n_labels, rob_head = 0, False
-    if _encoder_arch(hf_config) in ("BertForSequenceClassification",
-                                    "RobertaForSequenceClassification"):
-        n_labels = int(hf_config.get("num_labels")
-                       or len(hf_config.get("id2label") or ()) or 2)
-        rob_head = mt == "roberta"
     return EncoderConfig(
         vocab_size=hf_config["vocab_size"],
         hidden_size=hf_config["hidden_size"],
@@ -1141,7 +1143,7 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
         norm_eps=hf_config.get("layer_norm_eps", 1e-12),
         activation=act, with_pooler=pooler, with_mlm_head=mlm,
         tie_mlm_decoder=hf_config.get("tie_word_embeddings", True),
-        num_labels=n_labels, roberta_cls_head=rob_head,
+        num_labels=n_labels, cls_head=head_style,
         position_offset=offset, dtype=dtype)
 
 
@@ -1236,24 +1238,21 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                         shapes["mlm"][k].shape)
             for k, v in head.items()}
     if cfg.num_labels:
-        if cfg.roberta_cls_head:
-            plans["classifier"] = {
-                "w": LeafPlan(Src("classifier.out_proj.weight",
-                                  transpose=True),
-                              shapes["classifier"]["w"].shape),
-                "b": LeafPlan(Src("classifier.out_proj.bias"),
-                              shapes["classifier"]["b"].shape),
-                "dense_w": LeafPlan(Src("classifier.dense.weight",
-                                        transpose=True),
-                                    shapes["classifier"]["dense_w"].shape),
-                "dense_b": LeafPlan(Src("classifier.dense.bias"),
-                                    shapes["classifier"]["dense_b"].shape)}
-        else:
-            plans["classifier"] = {
-                "w": LeafPlan(Src("classifier.weight", transpose=True),
-                              shapes["classifier"]["w"].shape),
-                "b": LeafPlan(Src("classifier.bias"),
-                              shapes["classifier"]["b"].shape)}
+        heads = {
+            "pooled": {"w": "classifier.weight", "b": "classifier.bias"},
+            "roberta": {"w": "classifier.out_proj.weight",
+                        "b": "classifier.out_proj.bias",
+                        "dense_w": "classifier.dense.weight",
+                        "dense_b": "classifier.dense.bias"},
+            "distilbert": {"w": "classifier.weight",
+                           "b": "classifier.bias",
+                           "dense_w": "pre_classifier.weight",
+                           "dense_b": "pre_classifier.bias"},
+        }[cfg.cls_head]
+        plans["classifier"] = {
+            k: LeafPlan(Src(v, transpose=k.endswith("w")),
+                        shapes["classifier"][k].shape)
+            for k, v in heads.items()}
     return plans
 
 

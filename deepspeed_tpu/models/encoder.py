@@ -55,10 +55,12 @@ class EncoderConfig:
     with_pooler: bool = True
     with_mlm_head: bool = False
     tie_mlm_decoder: bool = True         # False: distinct decoder weight
-    num_labels: int = 0                  # >0: classification head on the
-    #   pooled [CLS] (BertForSequenceClassification serving)
-    roberta_cls_head: bool = False       # RoBERTa-style head: dense+tanh+
-    #   out_proj on hidden[:, 0] (no pooler in RobertaFor* task models)
+    num_labels: int = 0                  # >0: sequence-classification head
+    # head anatomy: "pooled" = linear on the tanh pooler output (BERT);
+    # "roberta" = dense+tanh+out_proj on hidden[:, 0] (no pooler);
+    # "distilbert" = pre_classifier+ReLU+classifier on hidden[:, 0]
+    cls_head: str = "pooled"
+
     # RoBERTa offsets positions by pad_token_id+1 (fairseq legacy): position
     # ids start at padding_idx+1 instead of 0
     position_offset: int = 0
@@ -79,7 +81,7 @@ class EncoderConfig:
             mlm += h * v
         cls = (h * self.num_labels + self.num_labels) if self.num_labels \
             else 0
-        if self.num_labels and self.roberta_cls_head:
+        if self.num_labels and self.cls_head in ("roberta", "distilbert"):
             cls += h * h + h                 # the extra dense layer
         return self.num_layers * per_layer + emb + pool + mlm + cls
 
@@ -158,7 +160,7 @@ class EncoderLM:
             params["classifier"] = {
                 "w": normal(keys[12], (h, cfg.num_labels)),
                 "b": jnp.zeros((cfg.num_labels,), jnp.float32)}
-            if cfg.roberta_cls_head:
+            if cfg.cls_head in ("roberta", "distilbert"):
                 params["classifier"]["dense_w"] = normal(keys[13], (h, h))
                 params["classifier"]["dense_b"] = jnp.zeros((h,),
                                                             jnp.float32)
@@ -207,7 +209,7 @@ class EncoderLM:
         if cfg.num_labels:
             specs["classifier"] = {"w": spec("embed", None),
                                    "b": spec(None)}
-            if cfg.roberta_cls_head:
+            if cfg.cls_head in ("roberta", "distilbert"):
                 specs["classifier"]["dense_w"] = spec("embed", "embed")
                 specs["classifier"]["dense_b"] = spec("embed")
         return specs
@@ -304,14 +306,18 @@ class EncoderLM:
         return h @ dec.astype(cfg.dtype) + mp["bias"].astype(cfg.dtype)
 
     def _classifier_head(self, params, hidden, pooled):
-        """→ logits [B, num_labels] (dropout is eval-off). BERT: linear
-        on the (tanh) pooler output; RoBERTa: its own dense+tanh head on
-        hidden[:, 0] (RobertaClassificationHead — task models carry no
-        pooler)."""
+        """→ logits [B, num_labels] (dropout is eval-off). "pooled":
+        linear on the tanh pooler output (BERT); "roberta": dense+tanh+
+        out_proj on hidden[:, 0] (RobertaClassificationHead);
+        "distilbert": pre_classifier+ReLU+classifier on hidden[:, 0]."""
         cp = params["classifier"]
-        if self.cfg.roberta_cls_head:
+        style = self.cfg.cls_head
+        if style == "roberta":
             x = jnp.tanh(_linear(hidden[:, 0], cp["dense_w"],
                                  cp["dense_b"], self.cfg.dtype))
+        elif style == "distilbert":
+            x = jax.nn.relu(_linear(hidden[:, 0], cp["dense_w"],
+                                    cp["dense_b"], self.cfg.dtype))
         else:
             if pooled is None:
                 raise ValueError("classification head needs the pooler")

@@ -406,10 +406,38 @@ def test_roberta_sequence_classification_parity(tmp_path_factory):
     hf = RobertaForSequenceClassification(cfg).eval()
     path = _save(hf, tmp_path_factory, "roberta_cls")
     model, params = from_pretrained(path, dtype=jnp.float32)
-    assert model.cfg.roberta_cls_head and not model.cfg.with_pooler
+    assert model.cfg.cls_head == "roberta" and not model.cfg.with_pooler
     engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
     rng = np.random.default_rng(12)
     tokens = rng.integers(2, 120, (2, 9))
+    ours = np.asarray(engine.classify(tokens))
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=4e-4, rtol=4e-4)
+
+
+def test_distilbert_sequence_classification_parity(tmp_path_factory):
+    """DistilBertForSequenceClassification: pre_classifier + ReLU +
+    classifier on hidden[:, 0] — the third head anatomy — loads and
+    engine.classify() matches HF."""
+    from transformers import (DistilBertConfig,
+                              DistilBertForSequenceClassification)
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = DistilBertConfig(vocab_size=110, dim=32, hidden_dim=64,
+                           n_layers=2, n_heads=4,
+                           max_position_embeddings=48, dropout=0.0,
+                           attention_dropout=0.0, seq_classif_dropout=0.0,
+                           num_labels=5)
+    torch.manual_seed(13)
+    hf = DistilBertForSequenceClassification(cfg).eval()
+    path = _save(hf, tmp_path_factory, "distilbert_cls")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert model.cfg.cls_head == "distilbert" and model.cfg.num_labels == 5
+    engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
+    rng = np.random.default_rng(13)
+    tokens = rng.integers(0, 110, (2, 8))
     ours = np.asarray(engine.classify(tokens))
     with torch.no_grad():
         theirs = hf(torch.tensor(tokens)).logits.numpy()
