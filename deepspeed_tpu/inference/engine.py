@@ -164,16 +164,22 @@ class InferenceEngine:
         return self._mlm_jit(self.params, hidden)
 
     def classify(self, input_ids, attention_mask=None, token_type_ids=None):
-        """Sequence-classification logits [B, num_labels]
-        (BertForSequenceClassification serving surface). Reuses encode()'s
+        """Classification logits (task-checkpoint serving surface).
+        Sequence heads (Bert/Roberta/DistilBertForSequenceClassification)
+        → [B, num_labels]; token heads (ForTokenClassification) →
+        [B, T, num_labels]; QA span heads (ForQuestionAnswering) →
+        [B, T, 2] (split dim -1 into start/end logits). Reuses encode()'s
         compiled trunk + a jitted head (the mlm() pattern)."""
         if not self._is_encoder or self._cls_jit is None:
             raise ValueError("model has no classification head (not an "
                              "encoder, or num_labels=0)")
         hidden, pooled = self.encode(input_ids, attention_mask,
                                      token_type_ids)
-        # pass only [CLS] — a full [B, T, H] hidden would retrace the
-        # head jit per sequence length
+        if self.module.cfg.cls_head in ("token", "qa"):
+            # per-token heads consume the full hidden states
+            return self._cls_jit(self.params, hidden, pooled)
+        # sequence heads: pass only [CLS] — a full [B, T, H] hidden would
+        # retrace the head jit per sequence length
         return self._cls_jit(self.params, hidden[:, :1], pooled)
 
     @staticmethod

@@ -1107,12 +1107,16 @@ def encoder_config_from_hf(hf_config: Dict[str, Any], dtype=jnp.float32):
             f"unsupported encoder activation {raw_act!r} — loading it as "
             "gelu would silently diverge from HF")
     n_labels, head_style = 0, "pooled"
-    if _encoder_arch(hf_config) in ("BertForSequenceClassification",
-                                    "RobertaForSequenceClassification",
-                                    "DistilBertForSequenceClassification"):
-        n_labels = int(hf_config.get("num_labels")
-                       or len(hf_config.get("id2label") or ()) or 2)
+    arch = _encoder_arch(hf_config)
+    cfg_labels = int(hf_config.get("num_labels")
+                     or len(hf_config.get("id2label") or ()) or 2)
+    if arch.endswith("ForSequenceClassification"):
+        n_labels = cfg_labels
         head_style = mt if mt in ("roberta", "distilbert") else "pooled"
+    elif arch.endswith("ForTokenClassification"):
+        n_labels, head_style = cfg_labels, "token"
+    elif arch.endswith("ForQuestionAnswering"):
+        n_labels, head_style = 2, "qa"
     if mt == "distilbert":
         # DistilBertConfig naming: dim/hidden_dim/n_layers/n_heads; no
         # token types, no pooler; sinusoidal_pos_embds still stores a
@@ -1248,6 +1252,8 @@ def _encoder_plans(cfg, shapes, hf_config) -> Dict[str, Any]:
                            "b": "classifier.bias",
                            "dense_w": "pre_classifier.weight",
                            "dense_b": "pre_classifier.bias"},
+            "token": {"w": "classifier.weight", "b": "classifier.bias"},
+            "qa": {"w": "qa_outputs.weight", "b": "qa_outputs.bias"},
         }[cfg.cls_head]
         plans["classifier"] = {
             k: LeafPlan(Src(v, transpose=k.endswith("w")),

@@ -55,10 +55,12 @@ class EncoderConfig:
     with_pooler: bool = True
     with_mlm_head: bool = False
     tie_mlm_decoder: bool = True         # False: distinct decoder weight
-    num_labels: int = 0                  # >0: sequence-classification head
+    num_labels: int = 0                  # >0: classification head
     # head anatomy: "pooled" = linear on the tanh pooler output (BERT);
     # "roberta" = dense+tanh+out_proj on hidden[:, 0] (no pooler);
-    # "distilbert" = pre_classifier+ReLU+classifier on hidden[:, 0]
+    # "distilbert" = pre_classifier+ReLU+classifier on hidden[:, 0];
+    # "token" = per-token linear (ForTokenClassification, [B, T, L]);
+    # "qa" = per-token span linear (ForQuestionAnswering, L=2 start/end)
     cls_head: str = "pooled"
 
     # RoBERTa offsets positions by pad_token_id+1 (fairseq legacy): position
@@ -306,12 +308,14 @@ class EncoderLM:
         return h @ dec.astype(cfg.dtype) + mp["bias"].astype(cfg.dtype)
 
     def _classifier_head(self, params, hidden, pooled):
-        """→ logits [B, num_labels] (dropout is eval-off). "pooled":
-        linear on the tanh pooler output (BERT); "roberta": dense+tanh+
-        out_proj on hidden[:, 0] (RobertaClassificationHead);
-        "distilbert": pre_classifier+ReLU+classifier on hidden[:, 0]."""
+        """→ logits (dropout is eval-off). Sequence styles ("pooled"/
+        "roberta"/"distilbert") → [B, num_labels]; per-token styles
+        ("token"/"qa") → [B, T, num_labels] (qa: L=2, start/end span
+        logits à la ForQuestionAnswering)."""
         cp = params["classifier"]
         style = self.cfg.cls_head
+        if style in ("token", "qa"):
+            return _linear(hidden, cp["w"], cp["b"], self.cfg.dtype)
         if style == "roberta":
             x = jnp.tanh(_linear(hidden[:, 0], cp["dense_w"],
                                  cp["dense_b"], self.cfg.dtype))

@@ -442,3 +442,56 @@ def test_distilbert_sequence_classification_parity(tmp_path_factory):
     with torch.no_grad():
         theirs = hf(torch.tensor(tokens)).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=4e-4, rtol=4e-4)
+
+
+def test_bert_token_classification_parity(tmp_path_factory):
+    """BertForTokenClassification: per-token classifier loads; classify()
+    returns [B, T, num_labels] matching HF at live positions."""
+    from transformers import BertForTokenClassification
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    cfg = _bert_cfg(num_labels=7)
+    torch.manual_seed(14)
+    hf = BertForTokenClassification(cfg).eval()
+    path = _save(hf, tmp_path_factory, "bert_tokcls")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert model.cfg.cls_head == "token" and model.cfg.num_labels == 7
+    engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
+    rng = np.random.default_rng(14)
+    tokens = rng.integers(0, 99, (2, 9))
+    mask = np.ones((2, 9), np.int64)
+    mask[0, 6:] = 0
+    ours = np.asarray(engine.classify(tokens, mask))
+    assert ours.shape == (2, 9, 7)
+    with torch.no_grad():
+        theirs = hf(torch.tensor(tokens),
+                    attention_mask=torch.tensor(mask)).logits.numpy()
+    for b in range(2):
+        live = int(mask[b].sum())
+        np.testing.assert_allclose(ours[b, :live], theirs[b, :live],
+                                   atol=4e-4, rtol=4e-4)
+
+
+def test_bert_question_answering_parity(tmp_path_factory):
+    """BertForQuestionAnswering: qa_outputs span head loads; classify()
+    returns [B, T, 2] whose split matches HF start/end logits."""
+    from transformers import BertForQuestionAnswering
+
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    torch.manual_seed(15)
+    hf = BertForQuestionAnswering(_bert_cfg()).eval()
+    path = _save(hf, tmp_path_factory, "bert_qa_head")
+    model, params = from_pretrained(path, dtype=jnp.float32)
+    assert model.cfg.cls_head == "qa" and model.cfg.num_labels == 2
+    engine = InferenceEngine(model, params=params, config={"dtype": "fp32"})
+    rng = np.random.default_rng(15)
+    tokens = rng.integers(0, 99, (2, 10))
+    ours = np.asarray(engine.classify(tokens))
+    with torch.no_grad():
+        out = hf(torch.tensor(tokens))
+    np.testing.assert_allclose(ours[..., 0], out.start_logits.numpy(),
+                               atol=4e-4, rtol=4e-4)
+    np.testing.assert_allclose(ours[..., 1], out.end_logits.numpy(),
+                               atol=4e-4, rtol=4e-4)
