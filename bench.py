@@ -19,6 +19,7 @@ import time
 # whole-run watchdog converts that hang into a clean rc=1 JSON line so the
 # driver's bench step can't stall the round. BENCH_TIMEOUT_S=0 disables.
 _TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "1800"))
+_T_START = time.time()
 _bench_done = threading.Event()
 # Seconds spent sleeping in backend-init retries; the watchdog extends its
 # budget by this so a late tunnel recovery isn't killed mid-bench.
@@ -295,11 +296,12 @@ def main():
     jax.block_until_ready(engine.state.params)
     dt = (time.perf_counter() - t0) / steps
 
-    try:
-        serving = bench_serving(on_tpu)
-    except Exception as e:  # serving bench must never sink the train metric
-        serving = {"error": str(e)[:200]}
-
+    # Materialize EVERYTHING the train metric needs before the serving
+    # phase touches the runtime again: if serving wedges the client, any
+    # later device access would hang main and let the watchdog erase the
+    # train number.
+    final_loss = float(loss)
+    platform = jax.devices()[0].platform
     n_params = model.num_params()
     tokens = global_batch * seq
     # model FLOPs from the flops profiler's analytic counting (6/8ND plus
@@ -311,6 +313,36 @@ def main():
     mfu = flops_per_step / dt / (detect_peak() * n_dev)
     tokens_per_sec_chip = tokens / dt / n_dev
 
+    # The serving bench must never sink the train metric — neither by
+    # raising NOR by hanging. Run it on a daemon thread with its own
+    # deadline, capped to the whole-run watchdog's remaining budget
+    # (minus margin) so the watchdog can't fire mid-join.
+    serving_box = {}
+
+    def _serving_worker():
+        try:
+            serving_box["result"] = bench_serving(on_tpu)
+        except Exception as e:
+            serving_box["result"] = {"error": str(e)[:200]}
+
+    try:
+        deadline = float(os.environ.get("BENCH_SERVING_TIMEOUT_S", "700"))
+    except ValueError:
+        deadline = 700.0
+    if deadline <= 0:                      # 0 disables, like BENCH_TIMEOUT_S
+        deadline = None
+    if _TIMEOUT_S > 0:
+        remaining = (_TIMEOUT_S + _retry_extra_s[0]
+                     - (time.time() - _T_START) - 60)
+        deadline = remaining if deadline is None else min(deadline,
+                                                          remaining)
+        deadline = max(deadline, 1.0)
+    sthread = threading.Thread(target=_serving_worker, daemon=True)
+    sthread.start()
+    sthread.join(timeout=deadline)
+    serving = serving_box.get(
+        "result", {"error": "serving bench timed out; train metric kept"})
+
     print(json.dumps({
         "metric": "train_mfu",
         "value": round(mfu, 4),
@@ -321,12 +353,16 @@ def main():
             "step_time_s": round(dt, 4),
             "n_params": n_params,
             "n_devices": n_dev,
-            "platform": jax.devices()[0].platform,
-            "final_loss": float(loss),
+            "platform": platform,
+            "final_loss": final_loss,
             "mfu_6nd": round(flops_6nd / dt / (detect_peak() * n_dev), 4),
             "serving": serving,
         },
-    }))
+    }), flush=True)
+    if sthread.is_alive():
+        # belt and braces: leave no window for anything (runtime atexit
+        # hooks included) to stall after the one JSON line is out
+        os._exit(0)
 
 
 if __name__ == "__main__":
