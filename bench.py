@@ -215,11 +215,53 @@ def bench_serving(on_tpu: bool):
                                      n_arrivals)).astype(int),
                    len_lo, len_hi).tolist()
 
+    def run_frontend_phase():
+        """The serving subsystem under an over-capacity burst: every
+        request goes through ServingFrontend (admission queue → router →
+        replica worker → streaming), so p50/p95 TTFT and shed-rate come
+        from the serving metrics registry, not ad-hoc timing. The queue
+        is sized below the burst so load shedding is exercised."""
+        from deepspeed_tpu.serving import (Rejected, ServingConfig,
+                                           ServingFrontend)
+
+        if on_tpu:
+            n_burst, max_new, qdepth = 48, 32, 16
+        else:
+            n_burst, max_new, qdepth = 16, 4, 6
+        fe = ServingFrontend([engine], ServingConfig(max_queue_depth=qdepth))
+        handles = []
+        for i in range(n_burst):
+            plen = int(lens[i % len(lens)])
+            prompt = rng.integers(0, cfg.vocab_size, size=plen).tolist()
+            try:
+                handles.append(fe.submit(prompt, max_new_tokens=max_new,
+                                         priority=i % 3,
+                                         deadline_ms=600_000.0))
+            except Rejected:
+                pass                     # counted by the registry
+        completed = fe.wait_all(handles, timeout=600)
+        snap = fe.metrics_snapshot()
+        fe.shutdown(drain=False, timeout=5)
+        ttft = snap["ttft_s"]
+        return {
+            "p50_ttft_ms": round(ttft["p50"] * 1e3, 2),
+            "p95_ttft_ms": round(ttft["p95"] * 1e3, 2),
+            "shed_rate": round(snap["shed_rate"], 4),
+            "submitted": int(snap["requests_submitted"]),
+            "completed": int(snap["requests_completed"]),
+            "shed": int(snap["requests_shed"]),
+            "expired": int(snap["requests_expired"]),
+            "tokens_generated": int(snap["tokens_generated"]),
+            "all_admitted_finished": bool(completed),
+            "queue_depth_bound": qdepth,
+        }
+
     run_phase(10_000)                   # warmup: compile all shape buckets
     ttfts, decode_tps = run_phase(20_000)
     run_ragged_phase(30_000, lens, target_active, decode_budget)  # warmup
     rag_ttfts, rag_tps = run_ragged_phase(50_000, lens, target_active,
                                           decode_budget)
+    frontend = run_frontend_phase()
     return {
         "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
@@ -236,6 +278,8 @@ def bench_serving(on_tpu: bool):
             "decode_budget": decode_budget,
             "prompt_lens": sorted(lens),
         },
+        # serving/ subsystem numbers (metrics registry, docs/SERVING.md)
+        "frontend": frontend,
     }
 
 

@@ -40,6 +40,41 @@ comms_logger = CommsLogger()
 _initialized = False
 
 
+def _routable_ip() -> str:
+    """This host's routable IP for coordinator rendezvous.
+
+    ``gethostbyname(gethostname())`` commonly resolves to 127.0.0.1 via
+    /etc/hosts — other ranks would then rendezvous with their own
+    loopback. Mirror the reference ``mpi_discovery`` (comm.py:673):
+    ``hostname -I`` first entry, then the UDP-connect trick; the resolver
+    result is the last resort (single-host setups where loopback is fine).
+    """
+    import socket
+    import subprocess
+
+    try:
+        out = subprocess.run(["hostname", "-I"], capture_output=True,
+                             text=True, timeout=5)
+        for ip in out.stdout.split():
+            if not ip.startswith("127.") and ":" not in ip:
+                return ip
+    except (OSError, subprocess.SubprocessError):
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            # no packet is sent; the kernel just picks the egress interface
+            s.connect(("8.8.8.8", 80))
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return socket.gethostbyname(socket.gethostname())
+
+
 class ReduceOp(Enum):
     SUM = 0
     PRODUCT = 1
@@ -114,13 +149,10 @@ def init_distributed(dist_backend: str = "xla",
         # "externally initialized" fallback would leave every process
         # seeing only its local devices (divergent training, no error).
         try:
-            import socket
-
             from mpi4py import MPI  # type: ignore
 
             addr = MPI.COMM_WORLD.bcast(
-                socket.gethostbyname(socket.gethostname())
-                if rank == 0 else None, root=0)
+                _routable_ip() if rank == 0 else None, root=0)
             coord = f"{addr}:{distributed_port}"
         except ImportError:
             raise ValueError(

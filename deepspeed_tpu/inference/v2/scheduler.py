@@ -28,11 +28,16 @@ class Request:
     prompt_tokens: List[int]
     max_new_tokens: int = 64
     eos_token_id: Optional[int] = None
+    # serving hooks (serving/replica.py): per-token delivery and a terminal
+    # notification with a finish reason ("eos" | "length" | "cancelled")
+    on_token: Optional[Callable[[int, int], None]] = None
+    on_finish: Optional[Callable[["Request", str], None]] = None
     # state
     prompt_fed: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     last_logits: Optional[np.ndarray] = None
     done: bool = False
+    finish_reason: Optional[str] = None
 
     @property
     def prompt_remaining(self) -> int:
@@ -52,9 +57,33 @@ class ContinuousBatchingScheduler:
         self._chunk = engine.config.max_chunk_tokens
 
     def submit(self, uid: int, prompt_tokens: List[int],
-               max_new_tokens: int = 64, eos_token_id: Optional[int] = None):
+               max_new_tokens: int = 64, eos_token_id: Optional[int] = None,
+               on_token: Optional[Callable[[int, int], None]] = None,
+               on_finish: Optional[Callable[[Request, str], None]] = None):
         self.pending.append(Request(uid, list(prompt_tokens), max_new_tokens,
-                                    eos_token_id))
+                                    eos_token_id, on_token, on_finish))
+
+    def cancel(self, uid: int) -> bool:
+        """Abort a request wherever it is; frees its KV blocks immediately
+        (serving's cancel path — the blocks go back to the pool this step,
+        not when the sequence would have finished). Returns False for
+        unknown/already-finished uids."""
+        req = self.running.pop(uid, None)
+        if req is None:
+            for r in self.pending:
+                if r.uid == uid:
+                    req = r
+                    self.pending.remove(r)
+                    break
+        if req is None or req.done:
+            return False
+        self.engine.flush(uid)
+        req.done = True
+        req.finish_reason = "cancelled"
+        self.finished[uid] = req
+        if req.on_finish is not None:
+            req.on_finish(req, "cancelled")
+        return True
 
     @property
     def has_work(self) -> bool:
@@ -120,6 +149,8 @@ class ContinuousBatchingScheduler:
             req.last_logits = logits[i]
             if is_decode:
                 req.generated.append(chunk[0])
+                if req.on_token is not None:
+                    req.on_token(req.uid, chunk[0])
             else:
                 req.prompt_fed += len(chunk)
                 self.running[req.uid] = req
@@ -129,10 +160,13 @@ class ContinuousBatchingScheduler:
                      and req.generated[-1] == req.eos_token_id)
             if len(req.generated) >= req.max_new_tokens or ended:
                 req.done = True
+                req.finish_reason = "eos" if ended else "length"
                 self.finished[req.uid] = req
                 self.running.pop(req.uid, None)
                 self.engine.flush(req.uid)
                 done_now.append(req.uid)
+                if req.on_finish is not None:
+                    req.on_finish(req, req.finish_reason)
         return done_now
 
     def run_to_completion(self, max_steps: int = 10000) -> Dict[int, Request]:

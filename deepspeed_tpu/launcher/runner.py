@@ -65,6 +65,30 @@ def chip_assignment(chips: int, world: int, rank: int):
     return ",".join(str(i) for i in range(rank * per, (rank + 1) * per))
 
 
+# libtpu's default inter-process coordination port; per-rank ports count up
+# from here so N local processes never collide.
+TPU_PROCESS_BASE_PORT = 8476
+
+
+def tpu_process_env(world: int, rank: int,
+                    base_port: int = TPU_PROCESS_BASE_PORT):
+    """Per-rank libtpu multi-process env for ``--launcher local``.
+
+    ``TPU_VISIBLE_CHIPS`` alone is not enough on real hardware: each PJRT
+    client in a single-host multi-process job also needs a distinct
+    coordination endpoint (``TPU_PROCESS_PORT``), the full endpoint list
+    (``TPU_PROCESS_ADDRESSES``), and its task index (``CLOUD_TPU_TASK_ID``)
+    — otherwise the runtimes race on the default port 8476. Values follow
+    the Cloud TPU multi-process conventions (jax.distributed on TPU VMs).
+    """
+    addrs = ",".join(f"127.0.0.1:{base_port + r}" for r in range(world))
+    return {
+        "TPU_PROCESS_PORT": str(base_port + rank),
+        "TPU_PROCESS_ADDRESSES": addrs,
+        "CLOUD_TPU_TASK_ID": str(rank),
+    }
+
+
 def fetch_hostfile(path: str) -> Dict[str, int]:
     """Parse ``host slots=N`` lines (reference launcher/runner.py:201)."""
     if not os.path.isfile(path):
@@ -356,10 +380,18 @@ def main(argv=None):
                 # each rank an even slice of the local chips so N clients
                 # don't contend for the same hardware. The user's env
                 # (or the script itself) overrides.
+                vis = None
                 if "TPU_VISIBLE_CHIPS" not in os.environ:
                     vis = chip_assignment(chips, world, rank)
                     if vis is not None:
                         env["TPU_VISIBLE_CHIPS"] = vis
+                # chip slicing alone (ours OR user-pinned) still collides
+                # on libtpu's default coordination port — per-rank process
+                # env rides along either way, per-variable overridable
+                if vis is not None or "TPU_VISIBLE_CHIPS" in os.environ:
+                    for k, v in tpu_process_env(world, rank).items():
+                        if k not in os.environ:
+                            env[k] = v
                 logger.info(f"launching local rank {rank}")
                 procs.append(subprocess.Popen(
                     build_cmd(args, rank, world, coord), env=env,

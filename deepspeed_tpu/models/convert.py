@@ -1064,6 +1064,14 @@ def _encoder_prefix_and_heads(hf_config):
     (RoBERTa has no pooler at all in ForMaskedLM) the pooler."""
     mt = hf_config.get("model_type")
     arch = _encoder_arch(hf_config)
+    if mt is None:
+        # explicit model + model_type but no config.json: build_leaf_plans
+        # injects the passed model_type, so reaching here means neither was
+        # available — say so instead of crashing on None + '.'
+        raise ValueError(
+            "encoder checkpoint config has no 'model_type' (missing or "
+            "minimal config.json); pass model_type= to load_hf_checkpoint "
+            f"(supported encoders: {sorted(_ENCODER_FAMILIES)})")
     if mt == "distilbert":
         # DistilBERT has no pooler in any architecture
         if arch == "DistilBertModel":
@@ -1271,6 +1279,10 @@ _ENCODER_FAMILIES = {"bert": _encoder_plans, "roberta": _encoder_plans,
 def build_leaf_plans(model, model_type: str,
                      hf_config=None) -> Dict[str, Any]:
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    # an explicit model_type wins over an absent/minimal config.json, so
+    # the family builders (which read hf_config["model_type"]) see it
+    if model_type is not None and not (hf_config or {}).get("model_type"):
+        hf_config = dict(hf_config or {}, model_type=model_type)
     if model_type in _ENCODER_FAMILIES:
         return _ENCODER_FAMILIES[model_type](model.cfg, shapes, hf_config)
     if model_type not in _FAMILIES:

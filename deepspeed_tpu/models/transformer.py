@@ -534,6 +534,9 @@ class CausalLM:
         # non-stacked leaves (embeddings, final norm, lm head).
         self.layer_transform = None
         self.global_transform = None
+        # layer-scan compile strategy for mixed window schedules; tests
+        # force "segments"/"switch" to check equivalence (_scan_layers)
+        self._scan_mode = "auto"
         if cfg.attention_impl == "sparse":
             from ..utils.logging import logger
 
@@ -888,14 +891,39 @@ class CausalLM:
         return logits
 
     def _scan_layers(self, body_for_window, carry, xs):
-        """``lax.scan`` over the stacked layer dim, split into the config's
-        contiguous constant-window segments (``window_segments``).
-        ``body_for_window(w)`` returns a scan body with the static window
-        ``w`` baked in — the Pallas kernels prune their KV grids from it.
-        Uniform windows take the single-scan fast path unchanged."""
+        """``lax.scan`` over the stacked layer dim, split by the config's
+        window schedule. ``body_for_window(w)`` returns a scan body with
+        the static window ``w`` baked in — the Pallas kernels prune their
+        KV grids from it. Three compile shapes:
+
+        - uniform window → ONE scan (fast path, unchanged);
+        - few contiguous runs (Qwen2's full-then-SWA, R=2) → one scan per
+          run, compile cost O(R);
+        - alternating schedules (GPT-Neo's global/local, R≈L) → ONE scan
+          whose body ``lax.switch``-es between the D *distinct* window
+          bodies on a per-layer index, compile cost O(D) instead of O(L).
+
+        ``_scan_mode`` ("auto" | "segments" | "switch") pins a path for
+        regression tests; "auto" picks switch only when it compiles fewer
+        bodies than the per-segment split."""
         segs = self.cfg.window_segments()
         if len(segs) == 1:
             return lax.scan(body_for_window(segs[0][2]), carry, xs)
+        distinct = sorted({w for _, _, w in segs})
+        mode = self._scan_mode
+        if mode == "auto":
+            mode = "switch" if len(distinct) < len(segs) else "segments"
+        if mode == "switch":
+            windows = self.cfg.layer_windows()
+            widx = jnp.asarray([distinct.index(w) for w in windows],
+                               dtype=jnp.int32)
+            bodies = [body_for_window(w) for w in distinct]
+
+            def body(carry, idx_and_xs):
+                idx, layer_xs = idx_and_xs
+                return lax.switch(idx, bodies, carry, layer_xs)
+
+            return lax.scan(body, carry, (widx, xs))
         ys = []
         for (start, n, win) in segs:
             seg_xs = jax.tree.map(lambda a: a[start:start + n], xs)

@@ -1,1 +1,2 @@
-from .monitor import MonitorMaster  # noqa: F401
+from .monitor import (CSVMonitor, Monitor, MonitorMaster,  # noqa: F401
+                      TensorBoardMonitor, WandbMonitor)

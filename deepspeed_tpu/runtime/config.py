@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
+from ..serving.config import ServingConfig
 from ..utils.logging import logger
 
 # ----------------------------------------------------------------- defaults
@@ -337,6 +338,8 @@ class DeepSpeedTpuConfig(DSConfigModel):
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = Field(default_factory=AutotuningConfig)
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
+    # request-serving layer (deepspeed_tpu/serving/, docs/SERVING.md)
+    serving: ServingConfig = Field(default_factory=ServingConfig)
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 1234

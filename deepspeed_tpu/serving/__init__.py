@@ -1,0 +1,43 @@
+"""TPU-native request-serving layer over ``inference/v2`` (FastGen-class).
+
+The reference DeepSpeed keeps this layer in MII; here it is in-tree (see
+docs/SERVING.md): typed submit/stream/cancel frontend, bounded SLO
+admission queue with load shedding, a least-outstanding-tokens replica
+router with health/drain states, streaming token delivery with prompt KV
+reclamation on cancel, and a serving metrics registry fanning out through
+the ``monitor/`` backends.
+
+Light names import eagerly; ``ServingFrontend``/``Replica``/
+``ReplicaRouter`` load lazily because they pull in the JAX engine stack.
+"""
+
+from .config import ServingConfig  # noqa: F401
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, serving_metrics)
+from .queue import AdmissionQueue  # noqa: F401
+from .request import (DoneEvent, FinishReason, Priority,  # noqa: F401
+                      Rejected, RequestHandle, RequestState, ServingRequest,
+                      TokenEvent)
+
+_LAZY = {
+    "ServingFrontend": ("deepspeed_tpu.serving.frontend", "ServingFrontend"),
+    "Replica": ("deepspeed_tpu.serving.replica", "Replica"),
+    "ReplicaState": ("deepspeed_tpu.serving.replica", "ReplicaState"),
+    "ReplicaRouter": ("deepspeed_tpu.serving.router", "ReplicaRouter"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["ServingConfig", "MetricsRegistry", "serving_metrics", "Counter",
+           "Gauge", "Histogram", "AdmissionQueue", "Priority", "Rejected",
+           "RequestHandle", "RequestState", "ServingRequest", "TokenEvent",
+           "DoneEvent", "FinishReason", "ServingFrontend", "Replica",
+           "ReplicaState", "ReplicaRouter"]

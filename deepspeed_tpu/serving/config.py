@@ -1,0 +1,37 @@
+"""`serving: {...}` sub-config (see docs/CONFIG.md and docs/SERVING.md).
+
+Lives here (not runtime/config.py) so the serving layer can be configured
+standalone, but it derives from the same :class:`DSConfigModel` base and
+is mounted on :class:`DeepSpeedTpuConfig` as the ``serving`` block.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DSConfigModel
+
+
+class ServingConfig(DSConfigModel):
+    """Queue bounds, SLO defaults, replica fleet shape, shed policy."""
+
+    enabled: bool = False
+    # admission
+    max_queue_depth: int = 256          # beyond this, submit() sheds
+    shed_policy: str = "reject"         # "reject" | "block" (block = legacy
+    #                                     unbounded-latency behavior; submit
+    #                                     waits for room instead of shedding)
+    default_priority: int = 1           # Priority.NORMAL
+    default_deadline_ms: Optional[float] = None   # None = no SLO deadline
+    default_max_new_tokens: int = 64
+    # replicas
+    num_replicas: int = 1               # fleet size (from_engine_factory)
+    # a busy replica with no completed iteration for this long is DEAD.
+    # Must exceed the worst-case XLA compile (new shape buckets recompile
+    # mid-service, not just at warm-up) — see docs/SERVING.md.
+    wedge_timeout_s: float = 300.0
+    drain_timeout_s: float = 30.0       # shutdown(drain=True) budget
+    # metrics
+    ttft_buckets_s: List[float] = Field(default_factory=list)  # [] = default

@@ -1,0 +1,159 @@
+"""ServingFrontend — the production request surface over InferenceEngineV2.
+
+Composes the whole serving stack::
+
+    submit()/stream()/cancel()
+        └─ AdmissionQueue   (bounded; sheds with Rejected("overloaded"))
+             └─ ReplicaRouter (least-outstanding-tokens, health/drain)
+                  └─ Replica × N (thread-per-replica Dynamic SplitFuse
+                       loops over InferenceEngineV2; streaming delivery,
+                       cancel → immediate KV free)
+
+All telemetry lands in one :class:`MetricsRegistry` (TTFT/TPOT/queue
+histograms, shed/cancel/complete counters) that fans out through the
+``monitor/`` backends via :meth:`publish_metrics` and feeds ``bench.py``'s
+serving phase.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+from .config import ServingConfig
+from .metrics import MetricsRegistry, serving_metrics
+from .queue import AdmissionQueue
+from .replica import Replica
+from .request import (FinishReason, Rejected, RequestHandle,
+                      RequestState, ServingRequest)
+from .router import ReplicaRouter
+
+
+class ServingFrontend:
+    def __init__(self, engines: Sequence, config: Optional[ServingConfig] = None,
+                 sample_fn: Optional[Callable] = None,
+                 metrics: Optional[MetricsRegistry] = None):
+        """``engines``: one InferenceEngineV2 per replica (the caller owns
+        model/param placement; replicas never share an engine — each owns
+        its KV pool and scheduler)."""
+        if not engines:
+            raise ValueError("ServingFrontend needs at least one engine")
+        self.config = config or ServingConfig()
+        self.metrics = metrics or serving_metrics()
+        if self.config.ttft_buckets_s:
+            self.metrics.histogram("ttft_s", self.config.ttft_buckets_s,
+                                   reset=True)
+        self.admission = AdmissionQueue(self.config.max_queue_depth,
+                                        self.metrics)
+        replicas = [Replica(i, eng, self.metrics, sample_fn,
+                            wedge_timeout_s=self.config.wedge_timeout_s)
+                    for i, eng in enumerate(engines)]
+        self.router = ReplicaRouter(replicas, self.admission, self.metrics)
+        self._closed = False
+        self.router.start()
+
+    @classmethod
+    def from_engine_factory(cls, engine_factory: Callable[[int], object],
+                            config: Optional[ServingConfig] = None,
+                            **kwargs) -> "ServingFrontend":
+        """Build the replica fleet from the config:
+        ``engine_factory(replica_id)`` is called ``config.num_replicas``
+        times (the config-driven path for the ``serving: {...}`` block)."""
+        config = config or ServingConfig()
+        engines = [engine_factory(i)
+                   for i in range(max(1, config.num_replicas))]
+        return cls(engines, config, **kwargs)
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, prompt_tokens: List[int],
+               max_new_tokens: Optional[int] = None,
+               priority: Optional[int] = None,
+               deadline_ms: Optional[float] = None,
+               eos_token_id: Optional[int] = None) -> RequestHandle:
+        """Admit a request. Raises :class:`Rejected` when shed (full queue,
+        draining frontend, or a prompt no replica could ever schedule).
+        ``priority``/``deadline_ms``/``max_new_tokens`` default from the
+        config (``default_priority`` etc.)."""
+        self.metrics.counter("requests_submitted").inc()
+        if self._closed:
+            self.metrics.counter("requests_shed").inc()
+            raise Rejected("draining", "frontend is shut down")
+        cfg = self.config
+        if priority is None:
+            priority = cfg.default_priority
+        if deadline_ms is None:
+            deadline_ms = cfg.default_deadline_ms
+        req = ServingRequest(
+            prompt_tokens,
+            max_new_tokens if max_new_tokens is not None
+            else cfg.default_max_new_tokens,
+            priority, deadline_ms / 1e3 if deadline_ms is not None else None,
+            eos_token_id)
+        max_len = min(r.engine.model.cfg.max_seq_len
+                      for r in self.router.replicas)
+        if len(req.prompt_tokens) + req.max_new_tokens > max_len:
+            self.metrics.counter("requests_shed").inc()
+            req.finish(RequestState.REJECTED, "too_long")
+            raise Rejected("too_long",
+                           f"{len(req.prompt_tokens)}+{req.max_new_tokens} "
+                           f"tokens > max_seq_len {max_len}")
+        self.admission.offer(req, block=cfg.shed_policy == "block")
+        return RequestHandle(req, self)
+
+    # ---------------------------------------------------------- lifecycle
+    def stream(self, handle: RequestHandle, timeout: Optional[float] = None):
+        return handle.stream(timeout=timeout)
+
+    def cancel(self, handle: RequestHandle) -> None:
+        """Request cancellation. A still-queued request is removed from
+        the admission queue immediately (freeing its depth slot for new
+        traffic); a dispatched one is cancelled by its replica between
+        scheduler steps, which frees its KV blocks promptly."""
+        req = handle._req
+        req.cancel_requested.set()
+        if self.admission.remove(req):
+            req.finish(RequestState.CANCELLED, FinishReason.CANCELLED)
+            self.metrics.counter("requests_cancelled").inc()
+
+    def wait_all(self, handles: Sequence[RequestHandle],
+                 timeout: Optional[float] = None) -> bool:
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        for h in handles:
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            if not h._req.wait(left):
+                return False
+        return True
+
+    # ------------------------------------------------------------- metrics
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        submitted = snap.get("requests_submitted", 0.0) or 0.0
+        snap["shed_rate"] = (snap.get("requests_shed", 0.0) / submitted
+                             if submitted else 0.0)
+        return snap
+
+    def publish_metrics(self, monitor, step: int = 0) -> None:
+        """Fan the registry out through a monitor/ backend (MonitorMaster,
+        CSVMonitor, ...)."""
+        self.metrics.publish(monitor, step)
+
+    # ------------------------------------------------------------ shutdown
+    def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """drain=True: stop admitting, let the queue flow through the
+        replicas and in-flight work finish (within ``timeout``); whatever
+        remains is failed with "draining". drain=False: fail everything
+        still queued and stop."""
+        if self._closed:
+            return
+        self._closed = True
+        timeout = timeout if timeout is not None else self.config.drain_timeout_s
+        deadline = time.monotonic() + timeout
+        if drain:
+            while len(self.admission) and time.monotonic() < deadline:
+                time.sleep(0.01)
+        for req in self.admission.close():
+            req.finish(RequestState.REJECTED, "draining")
+            self.metrics.counter("requests_shed").inc()
+        self.router.stop(drain=drain,
+                         timeout=max(1.0, deadline - time.monotonic()))

@@ -1,0 +1,211 @@
+"""Serving metrics: counters, gauges, fixed-bucket histograms.
+
+The serving layer's telemetry lives in one thread-safe registry so the
+router/replica/queue code records blindly and every consumer — the
+``monitor/`` backends (TensorBoard / W&B / CSV), ``bench.py``'s serving
+phase, tests — reads the same numbers. Histograms use fixed upper-bound
+buckets (Prometheus-style) so percentile estimates are mergeable and
+allocation-free on the hot path; ``percentile`` interpolates linearly
+within the winning bucket.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Event = Tuple[str, float, int]
+
+# Default latency buckets (seconds): 1 ms .. ~2 min, roughly ×2 per step.
+DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                           0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+# Queue-depth style buckets (counts).
+DEFAULT_DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                         256.0, 512.0, 1024.0)
+
+
+class Counter:
+    """Monotonic counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative counts per upper bound + +Inf)."""
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.bounds) + 1)   # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        while i < len(self.bounds) and v > self.bounds[i]:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def mean(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile (q in [0, 100]) from the buckets.
+        Linear interpolation inside the winning bucket; the overflow
+        bucket reports its lower bound (the estimate is then a floor)."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        if total == 0:
+            return 0.0
+        rank = max(1.0, math.ceil(q / 100.0 * total))
+        seen = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                if i >= len(self.bounds):       # overflow bucket
+                    return self.bounds[-1] if self.bounds else 0.0
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i]
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * frac
+            seen += c
+        return self.bounds[-1] if self.bounds else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"count": float(self._count), "sum": self._sum,
+                "mean": self.mean, "p50": self.percentile(50),
+                "p95": self.percentile(95), "p99": self.percentile(99)}
+
+
+class MetricsRegistry:
+    """Named metric store with monitor/ fan-out.
+
+    ``events(step)`` flattens everything into the ``(tag, value, step)``
+    tuples the :class:`deepspeed_tpu.monitor.Monitor` backends consume;
+    ``publish(monitor, step)`` writes them through any object with the
+    ``write_events`` API (e.g. ``MonitorMaster``)."""
+
+    def __init__(self, prefix: str = "serving"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name not in self._counters:
+                self._counters[name] = Counter()
+            return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name not in self._gauges:
+                self._gauges[name] = Gauge()
+            return self._gauges[name]
+
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None,
+                  reset: bool = False) -> Histogram:
+        """``reset=True`` replaces an existing histogram (fresh counts)
+        with the given buckets — buckets cannot change under recorded
+        observations, so re-declaring with different buckets without
+        ``reset`` keeps the original."""
+        with self._lock:
+            if reset or name not in self._histograms:
+                self._histograms[name] = Histogram(
+                    buckets or DEFAULT_LATENCY_BUCKETS)
+            return self._histograms[name]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        out: Dict[str, object] = {}
+        for name, c in counters.items():
+            out[name] = c.value
+        for name, g in gauges.items():
+            out[name] = g.value
+        for name, h in hists.items():
+            out[name] = h.snapshot()
+        return out
+
+    def events(self, step: int) -> List[Event]:
+        evs: List[Event] = []
+        p = self.prefix + "/" if self.prefix else ""
+        for name, value in self.snapshot().items():
+            if isinstance(value, dict):
+                for stat, v in value.items():
+                    evs.append((f"{p}{name}/{stat}", float(v), step))
+            else:
+                evs.append((f"{p}{name}", float(value), step))
+        return evs
+
+    def publish(self, monitor, step: int = 0) -> None:
+        monitor.write_events(self.events(step))
+
+
+def serving_metrics() -> MetricsRegistry:
+    """Registry pre-declaring the serving layer's metric names, so
+    dashboards and ``bench.py`` see zeros (not absences) before traffic."""
+    reg = MetricsRegistry("serving")
+    for c in ("requests_submitted", "requests_admitted", "requests_shed",
+              "requests_expired", "requests_completed", "requests_cancelled",
+              "requests_failed", "tokens_generated"):
+        reg.counter(c)
+    for g in ("queue_depth", "replicas_healthy", "outstanding_tokens"):
+        reg.gauge(g)
+    for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s"):
+        reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
+    reg.histogram("queue_depth_hist", DEFAULT_DEPTH_BUCKETS)
+    return reg

@@ -1,0 +1,137 @@
+"""SLO-aware admission: bounded priority/deadline queue with load shedding.
+
+The queue is the backpressure point of the serving layer: it admits at most
+``max_depth`` requests, orders them by (priority, earliest deadline, FIFO),
+and *sheds* instead of growing — a full queue raises
+:class:`~deepspeed_tpu.serving.request.Rejected` at submit time so callers
+see an immediate, typed "overloaded" rather than an unbounded TTFT tail.
+Requests whose deadline passes while still queued are dropped at pop time
+(no replica cycles are spent on work that already missed its SLO) and
+finished with reason "deadline" so their streams terminate.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import List, Optional
+
+from .metrics import MetricsRegistry
+from .request import Rejected, RequestState, ServingRequest, FinishReason
+
+
+class AdmissionQueue:
+    def __init__(self, max_depth: int, metrics: Optional[MetricsRegistry] = None):
+        self.max_depth = int(max_depth)
+        self.metrics = metrics
+        self._lock = threading.Condition()
+        self._heap: List[tuple] = []      # (order_key, ServingRequest)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._heap)
+
+    def _note_depth(self) -> None:
+        if self.metrics is not None:
+            depth = len(self._heap)
+            self.metrics.gauge("queue_depth").set(depth)
+            self.metrics.histogram("queue_depth_hist").observe(depth)
+
+    def offer(self, req: ServingRequest, block: bool = False,
+              timeout: Optional[float] = None) -> None:
+        """Admit or shed. Raises Rejected("overloaded") when full,
+        Rejected("draining") after close(). ``block=True`` (the
+        ``shed_policy: "block"`` path) waits for room instead of shedding
+        — the request is only finished once, on a genuine rejection."""
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        with self._lock:
+            while True:
+                if self._closed:
+                    self._shed(req, "draining")
+                if len(self._heap) < self.max_depth:
+                    break
+                if not block:
+                    self._shed(req, "overloaded")
+                wait = (None if deadline is None
+                        else deadline - time.monotonic())
+                if wait is not None and wait <= 0:
+                    self._shed(req, "overloaded")
+                self._lock.wait(wait if wait is not None else 0.05)
+            heapq.heappush(self._heap, (req.order_key, req))
+            self._note_depth()
+            self._lock.notify()
+        if self.metrics is not None:
+            self.metrics.counter("requests_admitted").inc()
+
+    def _shed(self, req: ServingRequest, reason: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter("requests_shed").inc()
+        req.finish(RequestState.REJECTED, reason)
+        raise Rejected(reason, f"queue depth {len(self._heap)}"
+                               f"/{self.max_depth}")
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[ServingRequest]:
+        """Highest-urgency admitted request, skipping (and expiring) any
+        whose deadline already passed. None on timeout / closed-and-empty."""
+        deadline = (time.monotonic() + timeout) if timeout is not None else None
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                while self._heap:
+                    _, req = heapq.heappop(self._heap)
+                    self._lock.notify_all()   # room freed: wake blocked offers
+                    if req.cancel_requested.is_set():
+                        self._note_depth()
+                        req.finish(RequestState.CANCELLED,
+                                   FinishReason.CANCELLED)
+                        if self.metrics is not None:
+                            self.metrics.counter("requests_cancelled").inc()
+                        continue
+                    if req.expired(now):
+                        self._note_depth()
+                        req.finish(RequestState.EXPIRED,
+                                   FinishReason.DEADLINE)
+                        if self.metrics is not None:
+                            self.metrics.counter("requests_expired").inc()
+                        continue
+                    self._note_depth()
+                    req.admitted_t = now
+                    if self.metrics is not None:
+                        self.metrics.histogram("queue_wait_s").observe(
+                            now - req.arrival_t)
+                    return req
+                if self._closed:
+                    return None
+                wait = None if deadline is None else deadline - now
+                if wait is not None and wait <= 0:
+                    return None
+                if not self._lock.wait(wait):
+                    return None
+
+    def remove(self, req: ServingRequest) -> bool:
+        """Take a specific request back out (eager cancel while queued):
+        frees its depth slot immediately instead of waiting for it to
+        reach the heap top. False if it already left the queue."""
+        with self._lock:
+            for i, (_, r) in enumerate(self._heap):
+                if r is req:
+                    self._heap[i] = self._heap[-1]
+                    self._heap.pop()
+                    heapq.heapify(self._heap)
+                    self._note_depth()
+                    self._lock.notify_all()
+                    return True
+        return False
+
+    def close(self) -> List[ServingRequest]:
+        """Stop admitting; returns (and removes) everything still queued so
+        the caller can fail or drain it."""
+        with self._lock:
+            self._closed = True
+            out = [req for _, req in self._heap]
+            self._heap.clear()
+            self._note_depth()
+            self._lock.notify_all()
+        return out

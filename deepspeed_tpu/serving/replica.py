@@ -1,0 +1,284 @@
+"""One serving replica: a worker thread driving an InferenceEngineV2.
+
+Thread-per-replica mirrors how ``bench.py``'s serving phase drives the
+engine: each replica owns a :class:`ContinuousBatchingScheduler` (Dynamic
+SplitFuse) over its engine and a lock-free inbox the router assigns into.
+The loop per iteration: drain the inbox into the scheduler, enforce
+cancellations and deadlines (both free KV blocks *immediately* via
+``scheduler.cancel`` → ``engine.flush``), then run one scheduler step,
+streaming every sampled token to its request.
+
+Health is a state machine the router consults before assigning:
+``HEALTHY`` → ``DRAINING`` (finishes what it has, accepts nothing new) →
+``STOPPED``; an engine exception or a step that exceeds
+``wedge_timeout_s`` moves the replica to ``DEAD`` and fails its in-flight
+requests, so one wedged replica degrades capacity instead of the service.
+"""
+
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..inference.v2.scheduler import ContinuousBatchingScheduler
+from ..utils.logging import logger
+from .metrics import MetricsRegistry
+from .request import FinishReason, RequestState, ServingRequest
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"
+    DRAINING = "draining"
+    DEAD = "dead"
+    STOPPED = "stopped"
+
+
+class Replica:
+    def __init__(self, replica_id: int, engine,
+                 metrics: Optional[MetricsRegistry] = None,
+                 sample_fn: Optional[Callable] = None,
+                 wedge_timeout_s: float = 300.0,
+                 idle_wait_s: float = 0.005):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.metrics = metrics
+        self.scheduler = ContinuousBatchingScheduler(engine, sample_fn)
+        self.wedge_timeout_s = wedge_timeout_s
+        self.idle_wait_s = idle_wait_s
+        self.state = ReplicaState.HEALTHY
+        self._inbox: "queue.Queue[ServingRequest]" = queue.Queue()
+        self._active: Dict[int, ServingRequest] = {}
+        self._lock = threading.Lock()
+        self._outstanding = 0             # token-weighted load estimate
+        self._stop = threading.Event()
+        # monotonic time of the last completed loop iteration; a worker
+        # stuck inside engine.put stops updating it — that's the wedge
+        # signal check_health() reads (a blocked thread can't self-report)
+        self.last_progress_t = time.monotonic()
+        self._busy_since: Optional[float] = None
+        self._steps_done = 0
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name=f"serving-replica-{replica_id}")
+
+    def start(self) -> None:
+        self.thread.start()
+
+    # ------------------------------------------------------------- routing
+    @property
+    def outstanding_tokens(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    @property
+    def accepting(self) -> bool:
+        return self.state == ReplicaState.HEALTHY
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active) + self._inbox.qsize()
+
+    @property
+    def has_capacity(self) -> bool:
+        """Concurrency slots left (engine's max ragged sequence count).
+        The router only assigns into free slots — backlog beyond them
+        stays in the admission queue where priority/deadline order rules,
+        instead of FIFO-ing through an unbounded inbox."""
+        return self.active_count < self.engine.config.max_ragged_sequence_count
+
+    def assign(self, req: ServingRequest) -> bool:
+        """Router hand-off; False if the replica can no longer take work."""
+        if not self.accepting:
+            return False
+        with self._lock:
+            self._outstanding += req.outstanding_tokens
+        req.replica_id = self.replica_id
+        self._inbox.put(req)
+        return True
+
+    def drain(self) -> None:
+        """Stop accepting; in-flight requests run to completion."""
+        if self.state == ReplicaState.HEALTHY:
+            self.state = ReplicaState.DRAINING
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self._stop.set()
+        if self.thread.is_alive():
+            self.thread.join(timeout)
+        if self.state != ReplicaState.DEAD:
+            self.state = ReplicaState.STOPPED
+        if self.thread.is_alive():
+            # the worker is stuck in a device call and will never run its
+            # own exit cleanup — fail its requests from here so no stream
+            # outlives the shutdown (detaching makes the stuck thread's
+            # late callbacks no-op)
+            for req in list(self._active.values()):
+                self._fail_request(req, FinishReason.ERROR,
+                                   RequestState.FAILED)
+            self._reject_inbox()
+
+    def check_health(self, now: Optional[float] = None) -> ReplicaState:
+        """Router-side wedge detection: a replica that has had work for
+        longer than wedge_timeout_s without completing an iteration is
+        marked DEAD (its thread may be stuck in a device call forever —
+        routing around it is the graceful degradation). The FIRST step is
+        exempt: a cold engine legitimately spends minutes inside XLA
+        compilation, which is indistinguishable from a wedge from out
+        here — killing the fleet during warm-up would brick the service.
+        Later steps can ALSO recompile (a prompt hitting a new shape
+        bucket), so ``wedge_timeout_s`` must be sized above the
+        worst-case single compile, not above a decode step — hence the
+        conservative 300s default (docs/SERVING.md)."""
+        if self.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+            return self.state
+        now = now if now is not None else time.monotonic()
+        busy = self._busy_since
+        if (busy is not None and self._steps_done > 0
+                and now - max(busy, self.last_progress_t) > self.wedge_timeout_s):
+            logger.warning(f"serving replica {self.replica_id} wedged "
+                           f"(>{self.wedge_timeout_s}s without progress); "
+                           "marking DEAD")
+            self.state = ReplicaState.DEAD
+            # the worker thread is stuck inside a device call and cannot
+            # fail its own requests — do it from here so no stream hangs.
+            # Detached entries make the thread's late callbacks no-op if
+            # the call ever returns.
+            for req in list(self._active.values()):
+                self._fail_request(req, FinishReason.ERROR,
+                                   RequestState.FAILED)
+            self._reject_inbox()
+        return self.state
+
+    # ---------------------------------------------------------- worker loop
+    def _fail_request(self, req: ServingRequest, reason: str,
+                      state: RequestState) -> None:
+        with self._lock:
+            self._outstanding = max(0, self._outstanding
+                                    - req.outstanding_tokens)
+        self._active.pop(req.uid, None)
+        req.finish(state, reason)
+        if self.metrics is not None:
+            key = {FinishReason.DEADLINE: "requests_expired",
+                   FinishReason.CANCELLED: "requests_cancelled"}.get(
+                       reason, "requests_failed")
+            self.metrics.counter(key).inc()
+
+    def _admit_inbox(self) -> None:
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancel_requested.is_set():
+                self._fail_request(req, FinishReason.CANCELLED,
+                                   RequestState.CANCELLED)
+                continue
+            if req.expired():
+                self._fail_request(req, FinishReason.DEADLINE,
+                                   RequestState.EXPIRED)
+                continue
+            req.state = RequestState.RUNNING
+            self._active[req.uid] = req
+            self.scheduler.submit(
+                req.uid, req.prompt_tokens, req.max_new_tokens,
+                req.eos_token_id,
+                on_token=self._on_token, on_finish=self._on_finish)
+
+    def _on_token(self, uid: int, token: int) -> None:
+        req = self._active.get(uid)
+        if req is None:
+            return
+        prev_t = req.last_token_t
+        req.push_token(token)
+        with self._lock:
+            self._outstanding = max(0, self._outstanding - 1)
+        if self.metrics is not None:
+            self.metrics.counter("tokens_generated").inc()
+            if prev_t is None:      # first token of this request
+                self.metrics.histogram("ttft_s").observe(
+                    req.first_token_t - req.arrival_t)
+            else:
+                self.metrics.histogram("tpot_s").observe(
+                    req.last_token_t - prev_t)
+
+    def _on_finish(self, sreq, reason: str) -> None:
+        req = self._active.pop(sreq.uid, None)
+        if req is None:
+            return
+        with self._lock:
+            self._outstanding = max(0, self._outstanding
+                                    - req.outstanding_tokens)
+        if reason == FinishReason.CANCELLED:
+            req.finish(RequestState.CANCELLED, reason)
+            if self.metrics is not None:
+                self.metrics.counter("requests_cancelled").inc()
+            return
+        req.finish(RequestState.FINISHED, reason)
+        if self.metrics is not None:
+            self.metrics.counter("requests_completed").inc()
+            self.metrics.histogram("e2e_latency_s").observe(
+                time.monotonic() - req.arrival_t)
+
+    def _enforce_slo(self) -> None:
+        """Cancel/expire active requests; scheduler.cancel frees their KV
+        blocks in the same iteration (no decode steps are wasted on them).
+        The request is detached from ``_active`` first so the scheduler's
+        on_finish("cancelled") no-ops and the terminal state carries the
+        real cause (deadline vs explicit cancel)."""
+        now = time.monotonic()
+        for uid, req in list(self._active.items()):
+            cancelled = req.cancel_requested.is_set()
+            if not cancelled and not req.expired(now):
+                continue
+            del self._active[uid]
+            self.scheduler.cancel(uid)
+            if cancelled:
+                self._fail_request(req, FinishReason.CANCELLED,
+                                   RequestState.CANCELLED)
+            else:
+                self._fail_request(req, FinishReason.DEADLINE,
+                                   RequestState.EXPIRED)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set() and self.state != ReplicaState.DEAD:
+            try:
+                self._admit_inbox()
+                self._enforce_slo()
+                if self.scheduler.has_work:
+                    self._busy_since = self._busy_since or time.monotonic()
+                    self.scheduler.step()
+                    self._steps_done += 1
+                else:
+                    self._busy_since = None
+                    if self.state == ReplicaState.DRAINING:
+                        break
+                    self._stop.wait(self.idle_wait_s)
+                self.last_progress_t = time.monotonic()
+            except Exception as e:  # engine/scheduler fault → DEAD replica
+                logger.error(f"serving replica {self.replica_id} died: {e!r}")
+                self.state = ReplicaState.DEAD
+                for req in list(self._active.values()):
+                    self._fail_request(req, FinishReason.ERROR,
+                                       RequestState.FAILED)
+                self._reject_inbox()
+                return
+        if self.state != ReplicaState.DEAD:
+            self.state = ReplicaState.STOPPED
+        # a forced stop (stop() without drain, or drain timeout) exits with
+        # work still active — those requests must terminate too
+        for req in list(self._active.values()):
+            self._fail_request(req, FinishReason.ERROR, RequestState.FAILED)
+        self._reject_inbox()
+
+    def _reject_inbox(self) -> None:
+        """Fail anything that raced into the inbox after the loop decided
+        to exit — a terminal state for every assigned request is part of
+        the streaming contract (no stream may hang forever)."""
+        while True:
+            try:
+                req = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            self._fail_request(req, FinishReason.ERROR, RequestState.FAILED)

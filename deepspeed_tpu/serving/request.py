@@ -1,0 +1,203 @@
+"""Typed request surface of the serving frontend.
+
+A submitted request carries its QoS contract — priority, deadline,
+max_new_tokens — and returns a :class:`RequestHandle` whose stream side is
+a thread-safe iterator of :class:`TokenEvent` terminated by one
+:class:`DoneEvent`. Overload is an *explicit* outcome: a frontend that
+cannot take the request raises :class:`Rejected` with a machine-readable
+reason instead of queueing unboundedly (the SLO contract — bounded latency
+or a fast no).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional, Union
+
+
+class Priority(enum.IntEnum):
+    """Lower value = served first (heap order)."""
+    HIGH = 0
+    NORMAL = 1
+    LOW = 2
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"     # shed at admission (overloaded/draining)
+    EXPIRED = "expired"       # deadline passed before completion
+    FAILED = "failed"         # replica died / engine error
+
+
+class FinishReason:
+    EOS = "eos"
+    LENGTH = "length"
+    CANCELLED = "cancelled"
+    DEADLINE = "deadline"
+    ERROR = "error"            # engine fault / replica died mid-request
+    NO_REPLICAS = "no_replicas"   # nothing healthy to dispatch to
+
+
+class Rejected(Exception):
+    """Load-shed signal: the request was NOT admitted. ``reason`` is one of
+    "overloaded" (queue full), "draining" (frontend shutting down),
+    "too_long" (prompt cannot ever fit)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"request rejected: {reason}"
+                         + (f" ({detail})" if detail else ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenEvent:
+    uid: int
+    token: int
+    index: int            # 0-based position in the generated sequence
+    t: float              # monotonic emission time
+
+
+@dataclasses.dataclass(frozen=True)
+class DoneEvent:
+    uid: int
+    reason: str           # a FinishReason value
+    t: float
+
+
+StreamEvent = Union[TokenEvent, DoneEvent]
+
+
+class ServingRequest:
+    """Internal per-request record; user code holds the RequestHandle."""
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(self, prompt_tokens: List[int], max_new_tokens: int,
+                 priority: int, deadline_s: Optional[float],
+                 eos_token_id: Optional[int]):
+        with ServingRequest._seq_lock:
+            ServingRequest._seq += 1
+            self.uid = ServingRequest._seq
+        self.prompt_tokens = list(prompt_tokens)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.eos_token_id = eos_token_id
+        self.arrival_t = time.monotonic()
+        # absolute monotonic deadline; None = no SLO
+        self.deadline_t = (self.arrival_t + deadline_s
+                           if deadline_s is not None else None)
+        self.admitted_t: Optional[float] = None   # popped from the queue
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self.state = RequestState.QUEUED
+        self.finish_reason: Optional[str] = None
+        self.cancel_requested = threading.Event()
+        self.replica_id: Optional[int] = None
+        self.n_generated = 0
+        self._events: "queue.Queue[StreamEvent]" = queue.Queue()
+        self._done = threading.Event()
+
+    # ------------------------------------------------------------- ordering
+    @property
+    def order_key(self):
+        """Admission order: priority class first, then earliest deadline
+        (requests without a deadline sort after all deadlined peers of the
+        same priority), then FIFO by uid."""
+        dl = self.deadline_t if self.deadline_t is not None else float("inf")
+        return (self.priority, dl, self.uid)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline_t is None:
+            return False
+        return (now if now is not None else time.monotonic()) > self.deadline_t
+
+    @property
+    def outstanding_tokens(self) -> int:
+        """Work remaining: unprocessed prompt + undelivered generation
+        budget (the router's least-outstanding-tokens load signal)."""
+        return max(0, len(self.prompt_tokens) + self.max_new_tokens
+                   - self.n_generated)
+
+    # ------------------------------------------------------------ streaming
+    def push_token(self, token: int) -> None:
+        now = time.monotonic()
+        if self.first_token_t is None:
+            self.first_token_t = now
+        self.last_token_t = now
+        self._events.put(TokenEvent(self.uid, int(token),
+                                    self.n_generated, now))
+        self.n_generated += 1
+
+    def finish(self, state: RequestState, reason: str) -> None:
+        if self._done.is_set():
+            return
+        self.state = state
+        self.finish_reason = reason
+        self.finished_t = time.monotonic()
+        self._events.put(DoneEvent(self.uid, reason, self.finished_t))
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class RequestHandle:
+    """User-facing view: stream tokens, wait for the result, cancel."""
+
+    def __init__(self, req: ServingRequest, frontend):
+        self._req = req
+        self._frontend = frontend
+
+    @property
+    def uid(self) -> int:
+        return self._req.uid
+
+    @property
+    def state(self) -> RequestState:
+        return self._req.state
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return self._req.finish_reason
+
+    def cancel(self) -> None:
+        self._frontend.cancel(self)
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[TokenEvent]:
+        """Yield TokenEvents as they arrive; returns on the DoneEvent.
+        ``timeout`` bounds the wait for EACH event (raises queue.Empty)."""
+        while True:
+            ev = self._req._events.get(timeout=timeout)
+            if isinstance(ev, DoneEvent):
+                return
+            yield ev
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until terminal; returns the generated tokens."""
+        if not self._req.wait(timeout):
+            raise TimeoutError(f"request {self.uid} not finished "
+                               f"within {timeout}s")
+        return [ev.token for ev in self.drain()]
+
+    def drain(self) -> List[TokenEvent]:
+        """Non-blocking: all TokenEvents buffered so far."""
+        out = []
+        while True:
+            try:
+                ev = self._req._events.get_nowait()
+            except queue.Empty:
+                return out
+            if isinstance(ev, DoneEvent):
+                # keep terminal visible to later drains/streams
+                self._req._events.put(ev)
+                return out
+            out.append(ev)

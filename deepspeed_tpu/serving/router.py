@@ -1,0 +1,140 @@
+"""Replica router: admission queue → least-loaded healthy replica.
+
+A dispatcher thread pops the highest-urgency request from the
+:class:`AdmissionQueue` and assigns it to the *accepting* replica with the
+fewest outstanding tokens (prompt backlog + remaining generation budget) —
+the load signal that tracks actual engine work, unlike request counts,
+under mixed prompt lengths. Each dispatch also runs the wedge check: a
+replica that stopped making progress is marked DEAD and simply drops out
+of the candidate set, so the service degrades to the surviving capacity
+instead of queueing behind a stuck device call. With *no* healthy replica
+the router fails requests fast with reason "no_replicas" rather than
+letting streams hang.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..utils.logging import logger
+from .metrics import MetricsRegistry
+from .queue import AdmissionQueue
+from .replica import Replica, ReplicaState
+from .request import FinishReason, RequestState, ServingRequest
+
+
+class ReplicaRouter:
+    def __init__(self, replicas: List[Replica], admission: AdmissionQueue,
+                 metrics: Optional[MetricsRegistry] = None,
+                 poll_interval_s: float = 0.05):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        self.replicas = list(replicas)
+        self.admission = admission
+        self.metrics = metrics
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name="serving-router")
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+        self.thread.start()
+
+    # ------------------------------------------------------------ selection
+    def healthy_replicas(self) -> List[Replica]:
+        out = []
+        for r in self.replicas:
+            if r.check_health() == ReplicaState.HEALTHY:
+                out.append(r)
+        if self.metrics is not None:
+            self.metrics.gauge("replicas_healthy").set(len(out))
+            self.metrics.gauge("outstanding_tokens").set(
+                sum(r.outstanding_tokens for r in self.replicas
+                    if r.state not in (ReplicaState.DEAD,
+                                       ReplicaState.STOPPED)))
+        return out
+
+    def pick(self) -> Optional[Replica]:
+        """Least-outstanding-tokens over accepting replicas with a free
+        concurrency slot."""
+        candidates = [r for r in self.healthy_replicas()
+                      if r.accepting and r.has_capacity]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (r.outstanding_tokens,
+                                              r.replica_id))
+
+    def _any_accepting(self) -> bool:
+        return any(r.accepting for r in self.replicas)
+
+    def drain_replica(self, replica_id: int) -> None:
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                r.drain()
+                return
+        raise KeyError(f"no replica {replica_id}")
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, req: ServingRequest) -> None:
+        while not self._stop.is_set():
+            if not self._any_accepting():
+                logger.warning(f"serving request {req.uid}: no healthy "
+                               "replica; failing fast")
+                if self.metrics is not None:
+                    self.metrics.counter("requests_failed").inc()
+                req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
+                return
+            if req.expired():
+                if self.metrics is not None:
+                    self.metrics.counter("requests_expired").inc()
+                req.finish(RequestState.EXPIRED, FinishReason.DEADLINE)
+                return
+            replica = self.pick()
+            if replica is not None and replica.assign(req):
+                return
+            # healthy fleet but every slot busy (or lost a drain race):
+            # capacity frees as sequences finish — wait, don't fail
+            self._stop.wait(self.poll_interval_s)
+        # stopped while holding an unassigned request: it is no longer in
+        # the admission queue, so it MUST be finished here or its stream
+        # would hang past shutdown
+        if self.metrics is not None:
+            self.metrics.counter("requests_shed").inc()
+        req.finish(RequestState.REJECTED, "draining")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self.pick() is None:
+                # no free slot anywhere: leave the backlog in the
+                # admission queue (priority/deadline order) rather than
+                # FIFO-ing it into replica inboxes
+                self.healthy_replicas()   # keep health/gauges fresh
+                self._stop.wait(self.poll_interval_s)
+                continue
+            req = self.admission.pop(timeout=self.poll_interval_s)
+            if req is None:
+                self.healthy_replicas()   # keep health/gauges fresh when idle
+                continue
+            self._dispatch(req)
+
+    def stop(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop dispatching; optionally let replicas finish in-flight work.
+        The drain path must NOT set the replica stop flag first — the
+        worker exits on its own once DRAINING and idle; stop() afterwards
+        is the backstop for replicas that didn't finish in time."""
+        self._stop.set()
+        if self.thread.is_alive():
+            self.thread.join(timeout)
+        if drain:
+            deadline = time.monotonic() + timeout
+            for r in self.replicas:
+                r.drain()
+            for r in self.replicas:
+                if r.thread.is_alive():
+                    r.thread.join(max(0.0, deadline - time.monotonic()))
+        for r in self.replicas:
+            r.stop(1.0)

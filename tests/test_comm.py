@@ -131,3 +131,38 @@ def test_mpi_multiprocess_without_coordinator_fails_loudly(monkeypatch):
     with pytest.raises(ValueError, match="MASTER_ADDR"):
         C.init_distributed()
     monkeypatch.setattr(C, "_initialized", False)
+
+
+def test_routable_ip_prefers_hostname_i(monkeypatch):
+    """MPI coordinator discovery must broadcast a routable address:
+    gethostbyname(gethostname()) commonly resolves to 127.0.0.1 via
+    /etc/hosts, which every other rank would treat as ITS OWN loopback
+    (reference mpi_discovery uses `hostname -I` for exactly this)."""
+    import subprocess
+    import types
+
+    from deepspeed_tpu.comm import comm as C
+
+    def fake_run(cmd, **kw):
+        assert cmd[:2] == ["hostname", "-I"]
+        return types.SimpleNamespace(stdout="10.1.2.3 127.0.0.1 fe80::1\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert C._routable_ip() == "10.1.2.3"
+
+
+def test_routable_ip_falls_back_past_loopback(monkeypatch):
+    """With `hostname -I` unavailable/loopback-only, the UDP-connect trick
+    (or, last, the resolver) must still return an address — and never an
+    IPv6/whitespace artifact."""
+    import subprocess
+
+    from deepspeed_tpu.comm import comm as C
+
+    def fake_run(cmd, **kw):
+        raise OSError("no hostname binary")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    ip = C._routable_ip()
+    assert isinstance(ip, str) and ip
+    assert " " not in ip and ":" not in ip

@@ -161,3 +161,24 @@ def test_v2_engine_checkpoint_path(llama_ckpt):
     logits = np.asarray(engine.put([7], [prompt]))
     theirs = _hf_logits(hf_model, np.asarray([prompt]))[0, -1]
     np.testing.assert_allclose(logits[0], theirs, atol=3e-4, rtol=3e-4)
+
+
+def test_encoder_explicit_model_type_without_config_json():
+    """load_hf_checkpoint(model=..., model_type=...) with no config.json
+    must not crash with TypeError(None + '.'): build_leaf_plans injects
+    the explicit model_type, and a missing model_type raises a
+    descriptive ValueError (ISSUE 1 satellite, ADVICE.md)."""
+    from deepspeed_tpu.models.convert import (_encoder_prefix_and_heads,
+                                              build_leaf_plans)
+    from deepspeed_tpu.models.encoder import EncoderConfig, EncoderLM
+
+    with pytest.raises(ValueError, match="model_type"):
+        _encoder_prefix_and_heads({})
+
+    model = EncoderLM(EncoderConfig(vocab_size=32, hidden_size=16,
+                                    intermediate_size=32, num_layers=1,
+                                    num_heads=2, max_seq_len=16))
+    # explicit model_type + empty hf config: plans build, task-model
+    # prefix assumed (no architectures info to say otherwise)
+    plans = build_leaf_plans(model, "bert", {})
+    assert "embed" in plans and "layers" in plans

@@ -77,3 +77,24 @@ def test_chip_assignment_defaults():
     assert chip_assignment(4, 3, 2) == "2"
     assert chip_assignment(0, 2, 0) is None     # no chips detected
     assert chip_assignment(2, 4, 0) is None     # more ranks than chips
+
+
+def test_tpu_process_env_per_rank():
+    """TPU_VISIBLE_CHIPS alone still collides on real single-host
+    multi-process TPU: each rank also needs a distinct libtpu
+    coordination endpoint and task id (ISSUE 1 satellite)."""
+    from deepspeed_tpu.launcher.runner import (TPU_PROCESS_BASE_PORT,
+                                               tpu_process_env)
+
+    e0 = tpu_process_env(2, 0)
+    e1 = tpu_process_env(2, 1)
+    # distinct per-rank ports, shared full endpoint list, rank as task id
+    assert e0["TPU_PROCESS_PORT"] != e1["TPU_PROCESS_PORT"]
+    assert e0["TPU_PROCESS_ADDRESSES"] == e1["TPU_PROCESS_ADDRESSES"]
+    assert e0["TPU_PROCESS_ADDRESSES"] == (
+        f"127.0.0.1:{TPU_PROCESS_BASE_PORT},"
+        f"127.0.0.1:{TPU_PROCESS_BASE_PORT + 1}")
+    assert e0["CLOUD_TPU_TASK_ID"] == "0"
+    assert e1["CLOUD_TPU_TASK_ID"] == "1"
+    # custom base port counts up from there
+    assert tpu_process_env(4, 3, base_port=9000)["TPU_PROCESS_PORT"] == "9003"

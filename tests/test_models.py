@@ -220,3 +220,73 @@ def test_mixed_window_forward_matches_manual_mask():
         params, tokens)
     assert not np.allclose(np.asarray(out), np.asarray(full))
     assert not np.allclose(np.asarray(out), np.asarray(swa))
+
+
+def _count_scans(jaxpr):
+    """Scan primitives anywhere in a jaxpr — each is one compiled body."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            n += 1
+        for v in eqn.params.values():
+            closed = v if isinstance(v, (list, tuple)) else [v]
+            for c in closed:
+                if hasattr(c, "jaxpr"):
+                    n += _count_scans(c.jaxpr)
+    return n
+
+
+def test_alternating_window_schedule_compiles_one_scan():
+    """GPT-Neo-style alternating global/local windows (ISSUE 1 satellite,
+    ADVICE.md): one scan switching between the D=2 distinct window bodies
+    instead of one scan body per layer — compile cost O(distinct), not
+    O(layers). Qwen2-style contiguous runs keep the per-segment split."""
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_layers=6, num_heads=2,
+                            max_seq_len=64, sliding_window=(0, 8, 0, 8, 0, 8),
+                            attention_impl="reference")
+    m = CausalLM(cfg)
+    params = m.init(jax.random.PRNGKey(0))
+    toks = np.zeros((1, 8), np.int32)
+    assert _count_scans(jax.make_jaxpr(m.apply)(params, toks).jaxpr) == 1
+
+    m._scan_mode = "segments"        # the old path: one scan per segment
+    assert _count_scans(jax.make_jaxpr(m.apply)(params, toks).jaxpr) == 6
+
+    # contiguous two-run schedule (Qwen2 full-then-SWA): segments win (2
+    # scans, no switch overhead) — auto must NOT route it through switch
+    cfg2 = TransformerConfig(vocab_size=64, hidden_size=32,
+                             intermediate_size=64, num_layers=4, num_heads=2,
+                             max_seq_len=64, sliding_window=(0, 0, 8, 8),
+                             attention_impl="reference")
+    m2 = CausalLM(cfg2)
+    params2 = m2.init(jax.random.PRNGKey(0))
+    assert _count_scans(jax.make_jaxpr(m2.apply)(params2, toks).jaxpr) == 2
+
+
+def test_alternating_window_switch_path_matches_segments():
+    """The switch path must be numerically identical to the per-segment
+    path, for both the training forward and the prefill KV path."""
+    cfg = TransformerConfig(vocab_size=97, hidden_size=48,
+                            intermediate_size=96, num_layers=4, num_heads=4,
+                            max_seq_len=64, sliding_window=(0, 8, 0, 8),
+                            attention_impl="reference")
+    m = CausalLM(cfg)
+    params = m.init(jax.random.PRNGKey(1))
+    toks = np.random.default_rng(0).integers(0, 97, size=(2, 24))
+
+    m._scan_mode = "segments"
+    ref = np.asarray(m.apply(params, toks))
+    cache_ref = m.init_cache(2, 32)
+    pref_ref, cache_ref = m.prefill(params, jnp.asarray(toks), cache_ref)
+
+    m._scan_mode = "switch"
+    np.testing.assert_allclose(np.asarray(m.apply(params, toks)), ref,
+                               atol=1e-5, rtol=1e-5)
+    cache_sw = m.init_cache(2, 32)
+    pref_sw, cache_sw = m.prefill(params, jnp.asarray(toks), cache_sw)
+    np.testing.assert_allclose(np.asarray(pref_sw), np.asarray(pref_ref),
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(cache_sw["k"]),
+                               np.asarray(cache_ref["k"]),
+                               atol=1e-5, rtol=1e-5)
