@@ -256,12 +256,86 @@ def bench_serving(on_tpu: bool):
             "queue_depth_bound": qdepth,
         }
 
+    def run_prefix_phase():
+        """Shared-prefix serving (docs/SERVING.md "Prefix caching"): N
+        requests over K distinct system prompts, cache on vs off. Each
+        run does a sequential correctness pass (compiles buckets, records
+        greedy tokens, warms the cache) then a concurrent measured pass;
+        hit-rate/tokens-saved come from the engine's prefix counters over
+        the measured pass, and the greedy generations must be identical
+        with the cache on and off."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+
+        if on_tpu:
+            n_req, k_prompts, sys_len, tail_len, max_new = 24, 4, 512, 64, 16
+        else:
+            n_req, k_prompts, sys_len, tail_len, max_new = 12, 3, 64, 8, 4
+        sys_prompts = [rng.integers(0, cfg.vocab_size, size=sys_len).tolist()
+                       for _ in range(k_prompts)]
+        reqs = [sys_prompts[i % k_prompts]
+                + rng.integers(0, cfg.vocab_size, size=tail_len).tolist()
+                for i in range(n_req)]
+        prompt_tokens_total = n_req * (sys_len + tail_len)
+
+        def run(enabled, uid_base):
+            pcfg = type(vcfg)(**vars(vcfg))   # fresh copy of the phase config
+            pcfg.enable_prefix_cache = enabled
+            eng = InferenceEngineV2(engine.model, params=engine.params,
+                                    config=pcfg)
+            sched = ContinuousBatchingScheduler(eng)
+            # pass 1 — sequential: greedy tokens for the parity check
+            gens = []
+            for i, p in enumerate(reqs):
+                sched.submit(uid_base + i, p, max_new_tokens=max_new)
+                sched.run_to_completion()
+                gens.append(sched.finished[uid_base + i].generated)
+            # pass 2 — concurrent burst against the (now warm) cache
+            stats0 = eng.prefix_stats()
+            t0, first = {}, {}
+
+            def on_token(uid, tok):
+                if uid not in first:
+                    first[uid] = time.perf_counter() - t0[uid]
+
+            for i, p in enumerate(reqs):
+                uid = uid_base + 1000 + i
+                t0[uid] = time.perf_counter()
+                sched.submit(uid, p, max_new_tokens=max_new,
+                             on_token=on_token)
+            sched.run_to_completion()
+            stats = {k: v - stats0[k] for k, v in eng.prefix_stats().items()}
+            ttfts = sorted(first.values())
+            return gens, ttfts, stats
+
+        gens_on, ttft_on, stats_on = run(True, 60_000)
+        gens_off, ttft_off, stats_off = run(False, 70_000)
+        pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 2)  # noqa: E731
+        return {
+            "n_requests": n_req,
+            "k_prompts": k_prompts,
+            "prompt_len": sys_len + tail_len,
+            "prefix_hit_rate": round(stats_on["tokens_saved"]
+                                     / prompt_tokens_total, 4),
+            "prefill_tokens_saved": int(stats_on["tokens_saved"]),
+            "block_hits": int(stats_on["hits"]),
+            "block_misses": int(stats_on["misses"]),
+            "evictions": int(stats_on["evictions"]),
+            "cache_on": {"p50_ttft_ms": pct(ttft_on, 50),
+                         "p95_ttft_ms": pct(ttft_on, 95)},
+            "cache_off": {"p50_ttft_ms": pct(ttft_off, 50),
+                          "p95_ttft_ms": pct(ttft_off, 95)},
+            "tokens_match": gens_on == gens_off,
+        }
+
     run_phase(10_000)                   # warmup: compile all shape buckets
     ttfts, decode_tps = run_phase(20_000)
     run_ragged_phase(30_000, lens, target_active, decode_budget)  # warmup
     rag_ttfts, rag_tps = run_ragged_phase(50_000, lens, target_active,
                                           decode_budget)
     frontend = run_frontend_phase()
+    prefix = run_prefix_phase()
     return {
         "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
@@ -280,6 +354,8 @@ def bench_serving(on_tpu: bool):
         },
         # serving/ subsystem numbers (metrics registry, docs/SERVING.md)
         "frontend": frontend,
+        # shared-prefix KV reuse phase (docs/SERVING.md "Prefix caching")
+        "prefix": prefix,
     }
 
 

@@ -443,7 +443,8 @@ def _eager_collective(mesh, op_name: str, axis: str, n_extra_args: int, static):
             raise ValueError(op_name)
         return out[None]
 
-    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+    from ..compat import shard_map
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
 
 
 def _run_eager(op_name: str, stacked, group: Group, static=0):

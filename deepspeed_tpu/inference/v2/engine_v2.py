@@ -27,13 +27,19 @@ class RaggedInferenceEngineConfig:
                  max_ragged_sequence_count: int = 32,
                  max_chunk_tokens: int = 256,
                  kv_blocks: int = 512, kv_block_size: int = 16,
-                 max_tracked_sequences: int = 256):
+                 max_tracked_sequences: int = 256,
+                 enable_prefix_cache: bool = False,
+                 prefix_cache_max_blocks: Optional[int] = None):
         self.max_ragged_batch_size = max_ragged_batch_size
         self.max_ragged_sequence_count = max_ragged_sequence_count
         self.max_chunk_tokens = max_chunk_tokens
         self.kv_blocks = kv_blocks
         self.kv_block_size = kv_block_size
         self.max_tracked_sequences = max_tracked_sequences
+        # prefix cache (docs/SERVING.md "Prefix caching"): share full KV
+        # blocks between sequences with identical leading tokens
+        self.enable_prefix_cache = enable_prefix_cache
+        self.prefix_cache_max_blocks = prefix_cache_max_blocks
 
 
 class InferenceEngineV2:
@@ -85,7 +91,9 @@ class InferenceEngineV2:
         max_blocks_per_seq = -(-cfg.max_seq_len // self.config.kv_block_size)
         self.state_manager = DSStateManager(
             cfg, self.config.max_tracked_sequences, self.config.kv_blocks,
-            self.config.kv_block_size, sharding=cache_sharding)
+            self.config.kv_block_size, sharding=cache_sharding,
+            enable_prefix_cache=self.config.enable_prefix_cache,
+            prefix_cache_max_blocks=self.config.prefix_cache_max_blocks)
         self.paged = PagedCausalLM(model, self.config.kv_block_size,
                                    max_blocks_per_seq, mesh=jmesh)
         self.batch = RaggedBatchWrapper(self.config.max_ragged_sequence_count,
@@ -111,7 +119,9 @@ class InferenceEngineV2:
             have = seq.cur_allocated_blocks if seq else 0
             need = -(-total // self.config.kv_block_size)
             blocks_needed += max(0, need - have)
-        if blocks_needed > self.state_manager.free_blocks:
+        # available = free + LRU-evictable cached blocks (identical to the
+        # free count when the prefix cache is disabled)
+        if blocks_needed > self.state_manager.available_blocks:
             return SchedulingResult.KVCacheLimitExceeded
         return SchedulingResult.Success
 
@@ -132,23 +142,65 @@ class InferenceEngineV2:
             raise SchedulingError(status)
 
         self.batch.clear()
+        staged = []
         for uid, toks in zip(uids, tokens_list):
             seq = self.state_manager.get_or_create_sequence(uid)
             self.state_manager.maybe_allocate_kv(seq, len(toks))
             self.batch.insert_sequence(uid, list(toks), seq.seen_tokens,
                                        seq.kv_blocks)
-            seq.seen_tokens += len(toks)
+            staged.append((seq, toks))
 
         arrays = self.batch.finalize()
         logits, new_cache = self.paged.forward(
             self.params, self.state_manager.kv_cache,
             jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["start_pos"]),
             jnp.asarray(arrays["n_tokens"]), jnp.asarray(arrays["block_tables"]))
+        # commit sequence state only after the forward was dispatched: a
+        # failed forward leaves seen_tokens unchanged (the step can be
+        # retried) and — critically — never registers blocks whose KV was
+        # never written in the prefix-cache index. Allocation above is safe
+        # either way: the blocks belong to the sequence and return to the
+        # pool at flush. (Assumes each uid appears at most once per batch,
+        # which the scheduler guarantees.)
         self.state_manager.kv_cache = new_cache
+        for seq, toks in staged:
+            seq.seen_tokens += len(toks)
+            self.state_manager.record_tokens(seq, toks)
         return logits[:len(uids)]
 
     def flush(self, uid: int) -> None:
         self.state_manager.flush_sequence(uid)
+
+    def match_prefix(self, uid: int, prompt_tokens: Sequence[int]) -> int:
+        """Prefix-cache lookup for a new sequence: share every cached
+        leading full KV block of ``prompt_tokens`` and return the matched
+        token count (the caller skips prefilling that many tokens).
+        Returns 0 when the prefix cache is disabled — and, critically,
+        creates no sequence state in that case."""
+        return self.state_manager.match_prefix(uid, prompt_tokens)
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Monotonic prefix-cache counters: hits/misses (block lookups),
+        evictions, tokens_saved, queries."""
+        return self.state_manager.prefix_stats()
+
+    def configure_prefix_cache(self, enabled: bool,
+                               max_blocks: Optional[int] = None) -> None:
+        """Toggle prefix caching on a built engine — the serving layer's
+        config-driven hook (``ServingConfig.prefix_cache``). Enabling is
+        safe at any time: matching/registration start from now (sequences
+        already mid-flight are excluded from hashing by the chain-state
+        consistency guard in ``record_tokens``). Disabling drops the whole
+        index so retained blocks cannot strand outside the free pool."""
+        self.config.enable_prefix_cache = bool(enabled)
+        self.config.prefix_cache_max_blocks = max_blocks
+        sm = self.state_manager
+        if enabled:
+            sm.prefix_cache_enabled = True
+            sm.prefix_cache_max_blocks = max_blocks or 0
+        else:
+            sm.clear_prefix_cache()
+            sm.prefix_cache_enabled = False
 
     @property
     def free_blocks(self) -> int:

@@ -72,7 +72,7 @@ class PagedCausalLM:
                                   n_tokens, alibi_slopes=slopes,
                                   window=window, sm_scale=sm_scale)
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ...compat import shard_map
 
         q_spec = P(None, None, "tensor", None)        # [N, C, H, D]
         kv_spec = P(None, "tensor", None, None)       # [NB, KH, bs, D]

@@ -3,11 +3,20 @@
 Free-list allocator over a fixed pool of KV blocks; the reference implements
 this as a linked list in a torch tensor — host-side Python is equally fast
 at this scale and keeps the device program pure.
+
+Blocks carry a reference count so the prefix cache (``manager.py``) can
+share one immutable KV block between many sequences: ``allocate`` hands out
+blocks at refcount 1, ``share`` adds a reference, ``release`` drops one and
+returns the block to the free list only when the count reaches zero.
+``free`` is the historical name for ``release`` and keeps the old
+double-free ``ValueError``; the allocated-set (the refcount dict) makes
+that check O(1) per block instead of a rebuild of the whole free list.
 """
 
 from __future__ import annotations
 
-from typing import List
+from collections import Counter
+from typing import Dict, List, Sequence
 
 
 class BlockedAllocator:
@@ -16,6 +25,7 @@ class BlockedAllocator:
             raise ValueError(f"need at least one block, got {num_blocks}")
         self._num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks))
+        self._refs: Dict[int, int] = {}      # allocated block -> refcount
 
     @property
     def free_blocks(self) -> int:
@@ -25,17 +35,45 @@ class BlockedAllocator:
     def total_blocks(self) -> int:
         return self._num_blocks
 
+    def ref_count(self, block: int) -> int:
+        """Current refcount (0 for free/unknown blocks)."""
+        return self._refs.get(block, 0)
+
     def allocate(self, num_blocks: int) -> List[int]:
         if num_blocks > len(self._free):
             raise ValueError(
                 f"cannot allocate {num_blocks} blocks ({len(self._free)} free)")
         out, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, blocks: List[int]) -> None:
-        seen = set(self._free)
+    def share(self, blocks: Sequence[int]) -> None:
+        """Add one reference to each (already-allocated) block."""
         for b in blocks:
-            if b < 0 or b >= self._num_blocks or b in seen:
+            if b not in self._refs:
+                raise ValueError(f"cannot share unallocated block {b}")
+        for b in blocks:
+            self._refs[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> List[int]:
+        """Drop one reference per block; blocks reaching refcount 0 go back
+        to the free list. Returns the blocks actually freed. Validates the
+        whole call before mutating, so an invalid/double release leaves the
+        allocator untouched."""
+        counts = Counter(blocks)
+        for b, n in counts.items():
+            if b < 0 or b >= self._num_blocks or n > self._refs.get(b, 0):
                 raise ValueError(f"invalid or double free of block {b}")
-            seen.add(b)
-        self._free.extend(blocks)
+        freed: List[int] = []
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                freed.append(b)
+        self._free.extend(freed)
+        return freed
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Historical single-owner API: identical to :meth:`release`."""
+        self.release(blocks)

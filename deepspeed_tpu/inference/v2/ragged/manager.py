@@ -7,12 +7,28 @@ counts and KV block ownership, allocates blocks on demand, and owns the
 device-side paged cache tensors [L, num_blocks, KH, block_size, D] (the
 per-(block, kv-head) slab is the trailing [block_size, D] — the layout the
 Pallas paged-attention index maps depend on, ops/paged_attention.py).
+
+Prefix cache (docs/SERVING.md "Prefix caching"): every *full* KV block a
+sequence fills is registered in a hash index keyed by the chain hash of
+its token content — ``h_i = hash((h_{i-1}, tokens_i))`` — so a later
+sequence whose prompt starts with the same tokens at the same positions
+shares those device blocks instead of re-prefilling them
+(:meth:`DSStateManager.match_prefix`). Shared blocks are immutable: a
+sequence only ever writes KV at positions ≥ its matched length, which land
+in blocks it allocated itself; the last, partially-filled block of a
+prompt is never matched (the walk stops at the last full-block boundary
+strictly below ``len(prompt)``), so the tail is re-prefilled — the
+copy-on-write of this design. The cache holds one reference of its own on
+each indexed block; blocks whose only reference is the cache's are
+*unreferenced* and evicted in LRU order when ``allocate`` would otherwise
+fail (or when ``max_cached_blocks`` is exceeded).
 """
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +42,11 @@ class DSSequenceDescriptor:
     seen_tokens: int = 0                   # tokens already in the KV cache
     kv_blocks: List[int] = field(default_factory=list)
     input_tokens: List[int] = field(default_factory=list)  # pending prompt
+    # prefix-cache chain state: hash through the last full block, how many
+    # leading blocks have been hashed, and the tokens of the partial block
+    chain_hash: int = 0
+    hashed_blocks: int = 0
+    pending_tokens: List[int] = field(default_factory=list)
 
     @property
     def cur_allocated_blocks(self) -> int:
@@ -37,13 +58,30 @@ class DSStateManager:
 
     def __init__(self, model_cfg, max_tracked_sequences: int = 256,
                  num_blocks: int = 256, block_size: int = 16,
-                 dtype=None, sharding=None):
+                 dtype=None, sharding=None,
+                 enable_prefix_cache: bool = False,
+                 prefix_cache_max_blocks: Optional[int] = None):
         self.cfg = model_cfg
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_tracked_sequences = max_tracked_sequences
         self.allocator = BlockedAllocator(num_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        # -- prefix cache ---------------------------------------------------
+        self.prefix_cache_enabled = bool(enable_prefix_cache)
+        self.prefix_cache_max_blocks = (prefix_cache_max_blocks
+                                        if prefix_cache_max_blocks else 0)
+        # index key = (parent_chain_hash, block_tokens_tuple): the block's
+        # own tokens are compared EXACTLY on lookup (dict equality), so a
+        # builtin-hash collision cannot alias two different blocks; only
+        # the parent linkage is compressed to its 64-bit chain hash.
+        self._index: "OrderedDict[tuple, int]" = OrderedDict()  # key -> block
+        self._block_hash: Dict[int, tuple] = {}                 # block -> key
+        self._evictable = 0       # indexed blocks whose only ref is the
+        #                           cache's own (kept incrementally — the
+        #                           admission path reads it per candidate)
+        self._stats = {"hits": 0, "misses": 0, "evictions": 0,
+                       "tokens_saved": 0, "queries": 0}
         dt = dtype or model_cfg.dtype
         # [L, NB, KH, bs, D]: the per-(block, kv-head) slab is the trailing
         # [bs, D] — one tileable VMEM block, DMA'd directly by the Pallas
@@ -73,10 +111,18 @@ class DSStateManager:
         return self._seqs.get(uid)
 
     def flush_sequence(self, uid: int) -> None:
-        """Release a finished sequence's blocks (reference engine_v2.flush)."""
+        """Release a finished sequence's blocks (reference engine_v2.flush).
+        Blocks held by the prefix cache stay resident (the cache's own
+        reference keeps them) and become evictable once no sequence refers
+        to them."""
         seq = self._seqs.pop(uid, None)
         if seq is not None and seq.kv_blocks:
-            self.allocator.free(seq.kv_blocks)
+            self.allocator.release(seq.kv_blocks)
+            if self.prefix_cache_enabled:
+                for b in seq.kv_blocks:
+                    if (b in self._block_hash
+                            and self.allocator.ref_count(b) == 1):
+                        self._evictable += 1
 
     @property
     def tracked_sequences(self) -> List[int]:
@@ -95,4 +141,138 @@ class DSStateManager:
     def maybe_allocate_kv(self, seq: DSSequenceDescriptor, new_tokens: int):
         need = self.blocks_needed(seq, new_tokens)
         if need > 0:
+            short = need - self.allocator.free_blocks
+            if short > 0 and self.prefix_cache_enabled:
+                self._evict(short)           # LRU unreferenced cached blocks
             seq.kv_blocks.extend(self.allocator.allocate(need))
+
+    # -- prefix cache --------------------------------------------------------
+    @property
+    def evictable_blocks(self) -> int:
+        """Cached blocks whose only reference is the cache's own.
+        Maintained incrementally (share on match / release on flush /
+        eviction are the only transitions) — the admission path reads
+        this once per candidate per step."""
+        if not self.prefix_cache_enabled:
+            return 0
+        return self._evictable
+
+    @property
+    def available_blocks(self) -> int:
+        """Blocks an allocate can obtain: free + evictable (admission
+        control must count reclaimable cache residency, or a warm cache
+        would wedge the scheduler on KVCacheLimitExceeded forever)."""
+        return self.allocator.free_blocks + self.evictable_blocks
+
+    def prefix_stats(self) -> Dict[str, int]:
+        return dict(self._stats)
+
+    def match_prefix(self, uid: int,
+                     prompt_tokens: Sequence[int]) -> int:
+        """Match a new sequence's prompt against the cache block-by-block.
+
+        Shares every leading full block whose chain hash is indexed, seeds
+        the sequence's ``seen_tokens`` at the matched length, and returns
+        it. The walk is capped at ``len(prompt) - 1`` so at least one
+        token is always left to prefill — the forward that produces the
+        first logits. No-op (returns 0, creates nothing) when the cache is
+        disabled or the sequence already has state.
+        """
+        if not self.prefix_cache_enabled:
+            return 0
+        seq = self.get_or_create_sequence(uid)
+        if seq.seen_tokens > 0 or seq.kv_blocks:
+            return seq.seen_tokens
+        self._stats["queries"] += 1
+        limit = len(prompt_tokens) - 1
+        matched: List[int] = []
+        h = 0
+        n = 0
+        while n + self.block_size <= limit:
+            key = (h, tuple(prompt_tokens[n:n + self.block_size]))
+            b = self._index.get(key)
+            if b is None:
+                self._stats["misses"] += 1
+                break
+            self._index.move_to_end(key)     # LRU touch
+            if self.allocator.ref_count(b) == 1:
+                self._evictable -= 1         # about to gain a sequence ref
+            matched.append(b)
+            h = hash(key)
+            n += self.block_size
+            self._stats["hits"] += 1
+        if matched:
+            self.allocator.share(matched)
+            seq.kv_blocks.extend(matched)
+            seq.seen_tokens = n
+            seq.chain_hash = h
+            seq.hashed_blocks = len(matched)
+            self._stats["tokens_saved"] += n
+        return n
+
+    def record_tokens(self, seq: DSSequenceDescriptor,
+                      tokens: Sequence[int]) -> None:
+        """Advance the sequence's hash chain with tokens just written to
+        its KV blocks; each block that becomes full is registered in the
+        index (prompt and generated tokens alike — a later request whose
+        prompt extends this conversation reuses both)."""
+        if not self.prefix_cache_enabled:
+            return
+        # chain-state consistency guard: hashing is only valid when the
+        # chain covers the sequence from position 0 (a sequence that was
+        # mid-flight when the cache got enabled would otherwise register
+        # its content under wrong positions). An inconsistent sequence
+        # skips without extending state, so it stays skipped.
+        if (seq.hashed_blocks * self.block_size + len(seq.pending_tokens)
+                != seq.seen_tokens - len(tokens)):
+            return
+        seq.pending_tokens.extend(int(t) for t in tokens)
+        while len(seq.pending_tokens) >= self.block_size:
+            chunk = tuple(seq.pending_tokens[:self.block_size])
+            del seq.pending_tokens[:self.block_size]
+            key = (seq.chain_hash, chunk)
+            seq.chain_hash = hash(key)
+            block = seq.kv_blocks[seq.hashed_blocks]
+            seq.hashed_blocks += 1
+            self._register(key, block)
+
+    def _register(self, key: tuple, block: int) -> None:
+        if key in self._index or block in self._block_hash:
+            return          # content already cached / block already indexed
+        if (self.prefix_cache_max_blocks
+                and len(self._index) >= self.prefix_cache_max_blocks
+                and not self._evict(1)):
+            return          # cache full of in-use blocks: skip registration
+        self.allocator.share([block])        # the cache's own reference
+        self._index[key] = block
+        self._block_hash[block] = key
+        # the registering sequence still holds its reference, so the block
+        # enters the index referenced (not evictable) — it becomes
+        # evictable in flush_sequence when the last sequence ref drops
+
+    def _evict(self, n: int) -> int:
+        """Drop up to ``n`` LRU unreferenced cached blocks; returns how
+        many were evicted (their cache reference released → free list)."""
+        evicted = 0
+        for key in list(self._index):
+            if evicted >= n:
+                break
+            b = self._index[key]
+            if self.allocator.ref_count(b) == 1:
+                del self._index[key]
+                del self._block_hash[b]
+                self.allocator.release([b])
+                self._evictable -= 1
+                self._stats["evictions"] += 1
+                evicted += 1
+        return evicted
+
+    def clear_prefix_cache(self) -> None:
+        """Drop every index entry, releasing the cache's references.
+        Blocks still shared by live sequences stay allocated until those
+        sequences flush; unreferenced ones return to the free list."""
+        for key, b in list(self._index.items()):
+            self.allocator.release([b])
+        self._index.clear()
+        self._block_hash.clear()
+        self._evictable = 0

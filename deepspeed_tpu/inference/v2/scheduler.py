@@ -34,6 +34,8 @@ class Request:
     on_finish: Optional[Callable[["Request", str], None]] = None
     # state
     prompt_fed: int = 0
+    prefix_matched: int = -1     # tokens served from the prefix cache
+    #                              (-1 = lookup not yet performed)
     generated: List[int] = dataclasses.field(default_factory=list)
     last_logits: Optional[np.ndarray] = None
     done: bool = False
@@ -101,6 +103,26 @@ class ContinuousBatchingScheduler:
         plan: List[tuple] = []        # (req, chunk, is_decode)
         budget = self._budget
 
+        # prompt candidates (running-but-prefilling, then pending) are
+        # pulled and prefix-matched up front, BEFORE any admission check:
+        # match_prefix pins shared blocks (refcounts), which moves them
+        # out of the evictable count admission reads — matching after an
+        # admit() could invalidate that admission and turn the engine's
+        # re-check in put() into a SchedulingError. One-time per request;
+        # a no-op returning 0 when the cache is disabled. Matched blocks
+        # stay shared across deferral/retry until finish/cancel flushes.
+        candidates: List[Request] = [r for r in self.running.values()
+                                     if r.prompt_remaining > 0]
+        new_candidates: List[Request] = []
+        while self.pending and len(self.running) + len(new_candidates) < self._max_seqs:
+            new_candidates.append(self.pending.popleft())
+        for req in candidates + new_candidates:
+            if req.prefix_matched < 0:
+                req.prefix_matched = self.engine.match_prefix(
+                    req.uid, req.prompt_tokens)
+                if req.prefix_matched > 0:
+                    req.prompt_fed = req.prefix_matched
+
         def admit(req, chunk) -> bool:
             ok = self.engine.can_schedule(uids + [req.uid],
                                           [len(c) for c in chunks] + [len(chunk)])
@@ -119,11 +141,6 @@ class ContinuousBatchingScheduler:
                 plan.append((req, [tok], True))
                 budget -= 1
         # (b) prompt chunks: running-but-prefilling first, then pending
-        candidates: List[Request] = [r for r in self.running.values()
-                                     if r.prompt_remaining > 0]
-        new_candidates: List[Request] = []
-        while self.pending and len(self.running) + len(new_candidates) < self._max_seqs:
-            new_candidates.append(self.pending.popleft())
         for req in candidates + new_candidates:
             scheduled = False
             if budget > 0 and len(uids) < self._max_seqs:

@@ -477,7 +477,7 @@ def _attention(q, k, v, cfg: TransformerConfig, causal=True, window=0):
 
     from functools import partial as _partial
 
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel import topology as topo

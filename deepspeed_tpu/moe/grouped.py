@@ -105,7 +105,7 @@ def dropless_moe_mlp_ep(tokens: jax.Array, router_logits: jax.Array,
     (sharded over data axes); w_in/w_out/w_gate [E, ...] carry the
     ``expert`` mesh axis on dim 0. Returns (out [N, H], aux_loss).
     """
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     dtype = dtype or tokens.dtype

@@ -90,7 +90,8 @@ def spmd_pipeline(layer_fn: Callable, local_layers, x, num_micro: int,
         return (nxt, out, aux_acc), None
 
     # carries become stage-varying after the first tick; mark them so
-    var = lambda a: lax.pcast(a, (axis_name,), to="varying")  # noqa: E731
+    from ..compat import pcast
+    var = lambda a: pcast(a, (axis_name,), to="varying")  # noqa: E731
     cur0 = var(jnp.zeros((mb,) + x.shape[1:], x.dtype))
     out0 = var(jnp.zeros_like(x_m))
     aux0 = var(jnp.zeros((), jnp.float32))
@@ -116,7 +117,7 @@ def pipelined_layer_apply(layer_fn: Callable, stacked_layers, x,
     """Host-level wrapper: shard_map ``spmd_pipeline`` with only the pipe
     axis manual. ``stacked_layers`` leaves have leading dim L (divisible by
     the pipe axis size); ``x`` [B, T, H]. Returns ``(out, aux)``."""
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as Pspec
 
     if mesh is None:

@@ -84,7 +84,8 @@ def quantized_all_gather(x, axis_name: str, gdim: int, *, qw_bits: Optional[int]
                                     dtype=out_dtype), None
 
     def _bwd(_, g):
-        world = lax.axis_size(axis_name)
+        from ..compat import axis_size
+        world = axis_size(axis_name)
         if qg_bits is None:
             return (lax.psum_scatter(g, axis_name, scatter_dimension=gdim,
                                      tiled=True),)
@@ -139,7 +140,7 @@ def make_quantized_gather_transform(mesh: Mesh, leaf_specs: Dict[str, Any],
                         if name in gathered else leaf_specs[name])
                  for name in leaf_specs}
 
-    from jax import shard_map
+    from ..compat import shard_map
 
     def body(lp: Dict[str, Any]) -> Dict[str, Any]:
         out = {}

@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
-from ..serving.config import ServingConfig
+from ..serving.config import PrefixCacheConfig, ServingConfig
 from ..utils.logging import logger
 
 # ----------------------------------------------------------------- defaults
@@ -340,6 +340,9 @@ class DeepSpeedTpuConfig(DSConfigModel):
     hybrid_engine: HybridEngineConfig = Field(default_factory=HybridEngineConfig)
     # request-serving layer (deepspeed_tpu/serving/, docs/SERVING.md)
     serving: ServingConfig = Field(default_factory=ServingConfig)
+    # prefix-cache KV block reuse for the v2 ragged engine (docs/SERVING.md
+    # "Prefix caching"); also reachable as ``serving.prefix_cache``
+    prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 1234

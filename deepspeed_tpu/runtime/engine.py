@@ -609,7 +609,7 @@ class DeepSpeedTpuEngine:
             # the engine's allreduce). Two compiled update programs — full-
             # precision warmup vs int8-compressed — dispatched host-side on
             # freeze_step, so no traced branch wraps the collectives.
-            from jax import shard_map
+            from ..compat import shard_map
             from jax.sharding import PartitionSpec as P
 
             mesh = self.mesh

@@ -144,7 +144,7 @@ def ring_attention_sharded(q, k, v, causal: bool = True,
     (q/k/v global [B, T, H, D], sequence-sharded on dim 1). ``batch_axes``
     (e.g. the engine's data axes) additionally split the batch dim; default
     replicates it, which any batch size supports."""
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     mesh = topo.get_topology().mesh

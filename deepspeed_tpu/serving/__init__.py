@@ -11,7 +11,7 @@ Light names import eagerly; ``ServingFrontend``/``Replica``/
 ``ReplicaRouter`` load lazily because they pull in the JAX engine stack.
 """
 
-from .config import ServingConfig  # noqa: F401
+from .config import PrefixCacheConfig, ServingConfig  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, serving_metrics)
 from .queue import AdmissionQueue  # noqa: F401
@@ -36,7 +36,8 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["ServingConfig", "MetricsRegistry", "serving_metrics", "Counter",
+__all__ = ["ServingConfig", "PrefixCacheConfig", "MetricsRegistry",
+           "serving_metrics", "Counter",
            "Gauge", "Histogram", "AdmissionQueue", "Priority", "Rejected",
            "RequestHandle", "RequestState", "ServingRequest", "TokenEvent",
            "DoneEvent", "FinishReason", "ServingFrontend", "Replica",

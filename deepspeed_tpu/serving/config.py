@@ -14,6 +14,26 @@ from pydantic import Field
 from ..runtime.config_utils import DSConfigModel
 
 
+class PrefixCacheConfig(DSConfigModel):
+    """``prefix_cache: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Prefix caching"): shared-prefix KV block reuse in the v2 ragged
+    engine. Mounted on both :class:`ServingConfig` and
+    ``DeepSpeedTpuConfig``."""
+
+    enabled: bool = False
+    # cap on hash-indexed blocks (0 = bounded only by the KV pool);
+    # unreferenced cached blocks are evicted LRU past this, or whenever
+    # an allocation would otherwise fail
+    max_cached_blocks: int = 0
+
+    def apply(self, engine_config) -> None:
+        """Stamp these settings onto a ``RaggedInferenceEngineConfig``
+        (the engine-factory hook for config-driven serving)."""
+        engine_config.enable_prefix_cache = self.enabled
+        engine_config.prefix_cache_max_blocks = (self.max_cached_blocks
+                                                 or None)
+
+
 class ServingConfig(DSConfigModel):
     """Queue bounds, SLO defaults, replica fleet shape, shed policy."""
 
@@ -35,3 +55,6 @@ class ServingConfig(DSConfigModel):
     drain_timeout_s: float = 30.0       # shutdown(drain=True) budget
     # metrics
     ttft_buckets_s: List[float] = Field(default_factory=list)  # [] = default
+    # prefix-cache KV block reuse (engine-level; ``from_engine_factory``
+    # callers apply it via ``PrefixCacheConfig.apply``)
+    prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)

@@ -43,6 +43,17 @@ class ServingFrontend:
         if self.config.ttft_buckets_s:
             self.metrics.histogram("ttft_s", self.config.ttft_buckets_s,
                                    reset=True)
+        if self.config.prefix_cache.enabled:
+            # config-driven prefix caching: flip it on every engine that
+            # supports it (enabling on a built engine is safe — matching
+            # simply starts now). Engines the caller already enabled
+            # directly are left alone when the config block is off.
+            for eng in engines:
+                configure = getattr(eng, "configure_prefix_cache", None)
+                if configure is not None:
+                    configure(True,
+                              self.config.prefix_cache.max_cached_blocks
+                              or None)
         self.admission = AdmissionQueue(self.config.max_queue_depth,
                                         self.metrics)
         replicas = [Replica(i, eng, self.metrics, sample_fn,

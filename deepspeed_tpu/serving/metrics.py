@@ -201,7 +201,11 @@ def serving_metrics() -> MetricsRegistry:
     reg = MetricsRegistry("serving")
     for c in ("requests_submitted", "requests_admitted", "requests_shed",
               "requests_expired", "requests_completed", "requests_cancelled",
-              "requests_failed", "tokens_generated"):
+              "requests_failed", "tokens_generated",
+              # prefix-cache KV reuse (engine-side counters, replicated up
+              # by each Replica — docs/SERVING.md "Prefix caching")
+              "prefix_blocks_hit", "prefix_blocks_missed",
+              "prefix_blocks_evicted", "prefix_tokens_saved"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens"):
         reg.gauge(g)

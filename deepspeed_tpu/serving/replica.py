@@ -60,6 +60,9 @@ class Replica:
         self.last_progress_t = time.monotonic()
         self._busy_since: Optional[float] = None
         self._steps_done = 0
+        # last engine prefix-cache snapshot, for delta-publishing the
+        # monotonic registry counters (summable across replicas)
+        self._prefix_last: Dict[str, int] = {}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"serving-replica-{replica_id}")
 
@@ -221,6 +224,26 @@ class Replica:
             self.metrics.histogram("e2e_latency_s").observe(
                 time.monotonic() - req.arrival_t)
 
+    _PREFIX_COUNTERS = (("hits", "prefix_blocks_hit"),
+                        ("misses", "prefix_blocks_missed"),
+                        ("evictions", "prefix_blocks_evicted"),
+                        ("tokens_saved", "prefix_tokens_saved"))
+
+    def _publish_prefix_stats(self) -> None:
+        """Forward the engine's monotonic prefix-cache counters into the
+        registry as deltas (so multi-replica numbers sum correctly)."""
+        if self.metrics is None:
+            return
+        stats_fn = getattr(self.engine, "prefix_stats", None)
+        if stats_fn is None:
+            return
+        stats = stats_fn()
+        for key, name in self._PREFIX_COUNTERS:
+            delta = stats.get(key, 0) - self._prefix_last.get(key, 0)
+            if delta:
+                self.metrics.counter(name).inc(delta)
+        self._prefix_last = stats
+
     def _enforce_slo(self) -> None:
         """Cancel/expire active requests; scheduler.cancel frees their KV
         blocks in the same iteration (no decode steps are wasted on them).
@@ -250,6 +273,7 @@ class Replica:
                     self._busy_since = self._busy_since or time.monotonic()
                     self.scheduler.step()
                     self._steps_done += 1
+                    self._publish_prefix_stats()
                 else:
                     self._busy_since = None
                     if self.state == ReplicaState.DRAINING:

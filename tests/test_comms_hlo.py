@@ -14,7 +14,7 @@ from deepspeed_tpu.utils.comms_logging import (analyze_compiled,
 
 
 def test_analyze_compiled_psum(devices8):
-    from jax import shard_map
+    from deepspeed_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     mesh = Mesh(np.array(devices8), ("data",))

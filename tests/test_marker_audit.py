@@ -1,0 +1,47 @@
+"""CI hygiene tripwires (ISSUE 2 satellites).
+
+1. ``shard_map`` must be imported from ``deepspeed_tpu.compat`` everywhere
+   — the installed JAX may only provide it under ``jax.experimental`` (and
+   with a differently-spelled replication-check kwarg), so a direct
+   ``from jax import shard_map`` / ``jax.shard_map(...)`` regresses the
+   ~80 SPMD tests the shim un-gated.
+2. The ``slow`` marker the tier-1 budget depends on (``-m 'not slow'``)
+   must stay registered in pyproject.toml.
+"""
+
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DIRECT_IMPORT = re.compile(
+    r"^\s*(?:from\s+jax(?:\.experimental(?:\.shard_map)?)?\s+import\s+"
+    r"(?:[\w,\s]*\bshard_map\b)|.*\bjax\.shard_map\s*\()", re.M)
+
+
+def _py_sources():
+    for root in ("deepspeed_tpu", "tests"):
+        for path in sorted((REPO / root).rglob("*.py")):
+            if path.name in ("compat.py", "test_marker_audit.py"):
+                continue        # the shim itself, and this file's docstring
+            yield path
+
+
+def test_no_direct_shard_map_imports():
+    offenders = []
+    for path in _py_sources():
+        for m in DIRECT_IMPORT.finditer(path.read_text()):
+            line = m.group(0).strip()
+            if line.startswith("#"):
+                continue
+            offenders.append(f"{path.relative_to(REPO)}: {line}")
+    assert not offenders, (
+        "import shard_map from deepspeed_tpu.compat, not jax directly "
+        "(see deepspeed_tpu/compat.py):\n" + "\n".join(offenders))
+
+
+def test_slow_marker_registered():
+    pyproject = (REPO / "pyproject.toml").read_text()
+    markers = re.search(r"markers\s*=\s*\[(.*?)\]", pyproject, re.S)
+    assert markers and "slow" in markers.group(1), (
+        "the 'slow' pytest marker must stay registered in pyproject.toml "
+        "(the tier-1 suite runs -m 'not slow')")

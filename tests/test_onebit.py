@@ -61,7 +61,7 @@ def test_registry_builds_real_onebit():
 def test_sign_compress_roundtrip_error_feedback(devices8):
     """avg + per-worker err must exactly decompose each worker's input:
     c_i = sign(c_i)·scale_i + err_i, and avg = mean_i sign(c_i)·scale_i."""
-    from jax import shard_map
+    from deepspeed_tpu.compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     mesh = Mesh(np.array(devices8), ("data",))
@@ -83,6 +83,7 @@ def test_sign_compress_roundtrip_error_feedback(devices8):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_warmup_matches_plain_adam(devices8):
     """With freeze_step beyond the horizon, OneBitAdam must be exact Adam."""
     data = tiny_data()
@@ -104,6 +105,7 @@ def test_warmup_matches_plain_adam(devices8):
 
 @pytest.mark.parametrize("opt_type", ["OneBitAdam", "ZeroOneAdam",
                                       "OneBitLamb"])
+@pytest.mark.slow
 def test_compressed_phase_trains(opt_type, devices8):
     """Short warmup then compressed steps: loss keeps decreasing and the
     compiled compressed update moves packed sign bits (u8) through the
@@ -152,6 +154,7 @@ def test_packed_wire_bytes_beat_int8(devices8):
     assert b1 < b8 / 3.5, f"packed wire {b1}B vs int8 {b8}B — expected >3.5x"
 
 
+@pytest.mark.slow
 def test_packed_and_int8_wires_both_converge(devices8):
     """Numeric sanity across wire formats with an adequate warmup (the
     reference defaults freeze_step to 100k for a reason — freezing the
@@ -197,6 +200,7 @@ def test_onebit_rejects_zero_stage_2(devices8):
         deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
 
 
+@pytest.mark.slow
 def test_onebit_checkpoint_roundtrip(tmp_path, devices8):
     """Error-feedback moments (dp-leading, data-sharded) survive a
     save/load round trip and training continues identically."""
@@ -230,7 +234,7 @@ def test_two_phase_error_feedback_invariants(devices8):
     lossless once its residual is carried)."""
     from functools import partial
 
-    from jax import shard_map
+    from deepspeed_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from deepspeed_tpu.ops.onebit import _sign_compress_two_phase

@@ -1,0 +1,344 @@
+"""Prefix-cache KV block reuse (ISSUE 2): refcounted BlockedAllocator,
+hash-chain block index in DSStateManager, scheduler tail-only prefill, LRU
+eviction, and cancel/deadline-expiry while blocks are shared. The
+cache-off engine must behave exactly like the pre-cache engine."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator, DSStateManager
+from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+VOCAB = 128
+BS = 8          # kv block size used throughout
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=VOCAB, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=2,
+                            max_seq_len=128, norm="rmsnorm",
+                            activation="silu", position="rope")
+    model = CausalLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def make_engine(model, params, enabled=True, kv_blocks=64, max_cached=None,
+                max_seqs=4):
+    vcfg = RaggedInferenceEngineConfig(
+        max_ragged_batch_size=128, max_ragged_sequence_count=max_seqs,
+        max_chunk_tokens=32, kv_blocks=kv_blocks, kv_block_size=BS,
+        max_tracked_sequences=64, enable_prefix_cache=enabled,
+        prefix_cache_max_blocks=max_cached)
+    return InferenceEngineV2(model, params=params, config=vcfg)
+
+
+def model_cfg():
+    return TransformerConfig(vocab_size=VOCAB, hidden_size=16,
+                             intermediate_size=32, num_layers=1, num_heads=2,
+                             max_seq_len=256, norm="rmsnorm",
+                             activation="silu", position="rope")
+
+
+def tiny_manager(enabled=True, num_blocks=16, max_cached=None):
+    return DSStateManager(model_cfg(), 32, num_blocks, BS,
+                          enable_prefix_cache=enabled,
+                          prefix_cache_max_blocks=max_cached)
+
+
+# -------------------------------------------------------- allocator refcounts
+def test_allocator_share_release_refcounts():
+    a = BlockedAllocator(8)
+    blocks = a.allocate(2)
+    assert all(a.ref_count(b) == 1 for b in blocks)
+    a.share(blocks)
+    assert all(a.ref_count(b) == 2 for b in blocks)
+    assert a.release(blocks) == []          # still referenced: nothing freed
+    assert a.free_blocks == 6
+    assert a.release(blocks) == blocks      # last reference: back to pool
+    assert a.free_blocks == 8
+    assert all(a.ref_count(b) == 0 for b in blocks)
+
+
+def test_allocator_release_validates_atomically():
+    a = BlockedAllocator(8)
+    b = a.allocate(1)[0]
+    with pytest.raises(ValueError, match="invalid or double free"):
+        a.release([b, b])                   # one ref, two releases
+    # failed call must not have mutated anything
+    assert a.ref_count(b) == 1
+    assert a.free_blocks == 7
+    a.share([b])
+    a.release([b, b])                       # two refs, two releases: fine
+    assert a.free_blocks == 8
+
+
+def test_allocator_free_keeps_double_free_error():
+    a = BlockedAllocator(10)
+    blocks = a.allocate(2)
+    a.free(blocks)
+    with pytest.raises(ValueError, match="invalid or double free"):
+        a.free(blocks)
+    with pytest.raises(ValueError, match="invalid or double free"):
+        a.free([99])
+    with pytest.raises(ValueError):
+        a.share([5])                        # free block cannot be shared
+
+
+# ------------------------------------------------------ manager hash index
+def _fill_sequence(mgr, uid, tokens):
+    seq = mgr.get_or_create_sequence(uid)
+    mgr.maybe_allocate_kv(seq, len(tokens))
+    seq.seen_tokens += len(tokens)
+    mgr.record_tokens(seq, tokens)
+    return seq
+
+
+def test_match_shares_full_blocks_only():
+    mgr = tiny_manager()
+    toks = list(range(20))                  # 2 full blocks + partial
+    seq = _fill_sequence(mgr, 1, toks)
+    donor_blocks = list(seq.kv_blocks[:2])
+    mgr.flush_sequence(1)
+    # cached full blocks survive the flush, partial block went free
+    assert mgr.free_blocks == 16 - 2
+    assert mgr.available_blocks == 16
+    matched = mgr.match_prefix(2, toks + [7, 7])
+    assert matched == 16                    # the two full blocks
+    seq2 = mgr.get_sequence(2)
+    assert seq2.kv_blocks == donor_blocks   # the same device blocks
+    assert seq2.seen_tokens == 16
+    assert all(mgr.allocator.ref_count(b) == 2 for b in donor_blocks)
+    assert mgr.prefix_stats()["tokens_saved"] == 16
+
+
+def test_last_token_never_served_from_cache():
+    """An exact-multiple prompt still leaves >= 1 token to prefill (the
+    forward that produces first-token logits)."""
+    mgr = tiny_manager()
+    toks = list(range(16))                  # exactly 2 blocks
+    _fill_sequence(mgr, 1, toks)
+    assert mgr.match_prefix(2, toks) == BS  # only the first block matches
+
+
+def test_disabled_cache_is_inert():
+    mgr = tiny_manager(enabled=False)
+    assert mgr.match_prefix(5, list(range(40))) == 0
+    assert mgr.get_sequence(5) is None      # no sequence state created
+    seq = _fill_sequence(mgr, 1, list(range(20)))
+    assert seq.hashed_blocks == 0           # record_tokens no-ops
+    mgr.flush_sequence(1)
+    assert mgr.free_blocks == 16            # nothing retained
+    assert mgr.available_blocks == mgr.free_blocks
+
+
+def test_lru_eviction_under_pool_pressure():
+    mgr = tiny_manager(num_blocks=8)
+    _fill_sequence(mgr, 1, list(range(16)))         # 2 cached after flush
+    mgr.flush_sequence(1)
+    _fill_sequence(mgr, 2, list(range(100, 116)))   # 2 more, newer
+    mgr.flush_sequence(2)
+    assert mgr.free_blocks == 4 and mgr.available_blocks == 8
+    # allocating 6 must evict LRU cached blocks instead of failing
+    seq = mgr.get_or_create_sequence(3)
+    mgr.maybe_allocate_kv(seq, 6 * BS)
+    assert len(seq.kv_blocks) == 6
+    st = mgr.prefix_stats()
+    assert st["evictions"] == 2
+    # LRU order: uid 1's older prefix was evicted, uid 2's survives
+    assert mgr.match_prefix(4, list(range(100, 116)) + [0]) == 16
+    assert mgr.match_prefix(5, list(range(16)) + [0]) == 0
+
+
+def test_max_cached_blocks_cap():
+    mgr = tiny_manager(num_blocks=16, max_cached=2)
+    _fill_sequence(mgr, 1, list(range(32)))         # 4 full blocks
+    evicted_or_skipped = mgr.prefix_stats()
+    assert len(mgr._index) <= 2
+    # in-use blocks are never evicted: everything still referenced by uid 1
+    assert evicted_or_skipped["evictions"] == 0
+    mgr.flush_sequence(1)
+    assert mgr.available_blocks == 16
+
+
+def test_referenced_blocks_never_evicted():
+    mgr = tiny_manager(num_blocks=4)
+    seq = _fill_sequence(mgr, 1, list(range(16)))   # holds 2 cached blocks
+    matched = mgr.match_prefix(2, list(range(16)) + [9])
+    assert matched == 16
+    # uid 1 and uid 2 both reference the cached blocks; pool has 2 free
+    with pytest.raises(ValueError):
+        mgr.maybe_allocate_kv(mgr.get_or_create_sequence(3), 3 * BS)
+    for b in seq.kv_blocks[:2]:
+        assert mgr.allocator.ref_count(b) == 3      # cache + two sequences
+
+
+# ------------------------------------------------- scheduler integration
+def _run_batch(engine, prompts, uid_base, max_new=4, cancel_uid=None,
+               cancel_after_steps=1):
+    sched = ContinuousBatchingScheduler(engine)
+    for i, p in enumerate(prompts):
+        sched.submit(uid_base + i, p, max_new_tokens=max_new)
+    steps = 0
+    while sched.has_work and steps < 500:
+        sched.step()
+        steps += 1
+        if cancel_uid is not None and steps == cancel_after_steps:
+            sched.cancel(cancel_uid)
+    return sched
+
+
+def test_generated_tokens_identical_cache_on_off(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    sys_p = rng.integers(0, VOCAB, size=24).tolist()
+    prompts = [sys_p + rng.integers(0, VOCAB, size=6).tolist()
+               for _ in range(4)]
+    outs = {}
+    for enabled in (False, True):
+        engine = make_engine(model, params, enabled=enabled)
+        sched = ContinuousBatchingScheduler(engine)
+        for i, p in enumerate(prompts):          # sequential: cache warms
+            sched.submit(100 + i, p, max_new_tokens=5)
+            sched.run_to_completion()
+        outs[enabled] = [sched.finished[100 + i].generated for i in range(4)]
+        if enabled:
+            st = engine.prefix_stats()
+            assert st["hits"] >= 3 * 3           # requests 1..3 hit sys blocks
+            assert st["tokens_saved"] >= 3 * 24
+    assert outs[True] == outs[False]
+
+
+def test_cancel_under_prefix_sharing(model_and_params):
+    """Cancelling one sharer must not free blocks the other still reads;
+    the pool is whole again (free + cached) once every request finished."""
+    model, params = model_and_params
+    rng = np.random.default_rng(1)
+    sys_p = rng.integers(0, VOCAB, size=24).tolist()
+    engine = make_engine(model, params, enabled=True)
+    # donor populates the cache
+    _run_batch(engine, [sys_p + [1, 2]], 300)
+    mgr = engine.state_manager
+    cached = dict(mgr._index)
+    assert cached, "donor registered no blocks"
+    # two sharers in flight; cancel one mid-generation
+    sched = _run_batch(engine, [sys_p + [3, 4], sys_p + [5, 6]], 310,
+                       max_new=8, cancel_uid=310, cancel_after_steps=2)
+    assert sched.finished[310].finish_reason == "cancelled"
+    assert sched.finished[311].finish_reason in ("length", "eos")
+    assert len(sched.finished[311].generated) == 8  # survivor unharmed
+    # every cached block is back to exactly one (cache-held) reference
+    for b in mgr._index.values():
+        assert mgr.allocator.ref_count(b) == 1
+    assert mgr.available_blocks == engine.config.kv_blocks
+    st = engine.prefix_stats()
+    assert st["tokens_saved"] >= 2 * 16           # both sharers matched
+
+
+def test_failed_forward_registers_nothing(model_and_params):
+    """A put() whose forward raises must leave no sequence-state commit
+    and no index entry — otherwise a later prompt could match blocks
+    whose KV was never written."""
+    model, params = model_and_params
+    engine = make_engine(model, params, enabled=True)
+    uid, toks = 500, list(range(20))
+
+    def boom(*a, **k):
+        raise RuntimeError("transient device error")
+
+    real_forward = engine.paged.forward
+    engine.paged.forward = boom
+    with pytest.raises(RuntimeError):
+        engine.put([uid], [toks])
+    seq = engine.state_manager.get_sequence(uid)
+    assert seq.seen_tokens == 0              # retryable: nothing committed
+    assert not engine.state_manager._index   # nothing matchable
+    engine.paged.forward = real_forward
+    engine.put([uid], [toks])                # retry succeeds and commits
+    assert engine.state_manager.get_sequence(uid).seen_tokens == 20
+    assert len(engine.state_manager._index) == 2
+
+
+def test_evictable_counter_matches_recount(model_and_params):
+    """The incremental evictable counter the admission path reads must
+    equal a full recount after a mixed share/flush/evict workload."""
+    model, params = model_and_params
+    engine = make_engine(model, params, enabled=True, kv_blocks=24)
+    rng = np.random.default_rng(3)
+    sys_p = rng.integers(0, VOCAB, size=24).tolist()
+    for i in range(5):
+        _run_batch(engine, [sys_p + rng.integers(0, VOCAB, size=4).tolist()],
+                   600 + 10 * i, max_new=6)
+    mgr = engine.state_manager
+    recount = sum(1 for b in mgr._index.values()
+                  if mgr.allocator.ref_count(b) == 1)
+    assert mgr._evictable == recount
+    assert mgr.available_blocks == mgr.free_blocks + recount
+
+
+def test_serving_config_enables_engine_cache(model_and_params):
+    """`serving: {prefix_cache: {enabled: true}}` must actually turn the
+    cache on for every replica engine (the config-driven path)."""
+    from deepspeed_tpu.serving import (PrefixCacheConfig, ServingConfig,
+                                       ServingFrontend)
+
+    model, params = model_and_params
+    engine = make_engine(model, params, enabled=False)
+    cfg = ServingConfig(max_queue_depth=8,
+                        prefix_cache=PrefixCacheConfig(enabled=True,
+                                                       max_cached_blocks=16))
+    fe = ServingFrontend([engine], cfg)
+    try:
+        assert engine.state_manager.prefix_cache_enabled
+        assert engine.state_manager.prefix_cache_max_blocks == 16
+        rng = np.random.default_rng(4)
+        sys_p = rng.integers(0, VOCAB, size=24).tolist()
+        h1 = fe.submit(sys_p + [1], max_new_tokens=2)
+        assert h1._req.wait(60)
+        h2 = fe.submit(sys_p + [2], max_new_tokens=2)
+        assert h2._req.wait(60)
+        assert fe.metrics_snapshot()["prefix_tokens_saved"] >= 16
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_deadline_expiry_under_prefix_sharing(model_and_params):
+    """Frontend deadline expiry with a shared prefix: the expired request
+    releases only its own references; refcounts never go negative and the
+    pool returns to total once all requests are done."""
+    from deepspeed_tpu.serving import RequestState, ServingConfig, \
+        ServingFrontend
+
+    model, params = model_and_params
+    engine = make_engine(model, params, enabled=True)
+    fe = ServingFrontend([engine], ServingConfig(max_queue_depth=8))
+    try:
+        rng = np.random.default_rng(2)
+        sys_p = rng.integers(0, VOCAB, size=24).tolist()
+        warm = fe.submit(sys_p + [1, 2], max_new_tokens=2)
+        assert warm._req.wait(60)
+        doomed = fe.submit(sys_p + [3, 4], max_new_tokens=90,
+                           deadline_ms=100.0)
+        ok = fe.submit(sys_p + [5, 6], max_new_tokens=4)
+        assert doomed._req.wait(60) and ok._req.wait(60)
+        assert doomed.state == RequestState.EXPIRED
+        assert ok.state == RequestState.FINISHED
+        mgr = engine.state_manager
+        deadline = time.monotonic() + 10
+        while mgr.available_blocks != engine.config.kv_blocks \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mgr.available_blocks == engine.config.kv_blocks
+        for b in mgr._index.values():
+            assert mgr.allocator.ref_count(b) == 1
+        snap = fe.metrics_snapshot()
+        assert snap["requests_expired"] == 1
+        assert snap["prefix_tokens_saved"] >= 16  # sharers matched the prefix
+    finally:
+        fe.shutdown(drain=False, timeout=5)

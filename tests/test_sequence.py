@@ -70,6 +70,7 @@ def test_distributed_attention_ulysses_matches_dense():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["flash", "ring"])
 def test_engine_trains_with_sequence_parallel(impl):
     cfg = dataclasses.replace(TINY_TEST, attention_impl=impl, num_kv_heads=4)
@@ -144,6 +145,7 @@ def test_ring_attention_sliding_window_grads():
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["flash", "ring"])
 def test_windowed_model_under_sequence_parallelism(impl):
     """A sliding-window model trained under a sequence mesh axis (Ulysses

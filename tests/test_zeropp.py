@@ -111,6 +111,7 @@ def test_qwz_quantizes_weight_allgather():
     assert losses[-1] < losses[0] - 0.5, f"no convergence: {losses}"
 
 
+@pytest.mark.slow
 def test_qgz_quantizes_grad_reduce():
     engine = _make_engine({"zero_quantized_weights": True,
                            "zero_quantized_gradients": True})
@@ -121,6 +122,7 @@ def test_qgz_quantizes_grad_reduce():
     assert losses[-1] < losses[0] - 0.5, f"no convergence: {losses}"
 
 
+@pytest.mark.slow
 def test_qwz_loss_close_to_fp():
     fp = _train(_make_engine())
     qw = _train(_make_engine({"zero_quantized_weights": True}))
