@@ -256,6 +256,75 @@ def bench_serving(on_tpu: bool):
             "queue_depth_bound": qdepth,
         }
 
+    def run_spec_phase():
+        """Speculative decoding (docs/SERVING.md "Speculative decoding"):
+        repetition-heavy prompts (motif loops — the prompt-lookup
+        proposer's best case, standing in for code/extraction traffic)
+        decoded greedily with the n-gram proposer on vs off. Reports TPOT
+        and tokens-per-forward both ways; the greedy streams must be
+        byte-identical (the lossless guarantee)."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+        from deepspeed_tpu.inference.v2.spec import NGramProposer
+        from deepspeed_tpu.inference.v2.testing import spec_summary
+
+        if on_tpu:
+            n_req, motif_len, reps, tail, max_new, k = 8, 16, 12, 8, 64, 6
+        else:
+            n_req, motif_len, reps, tail, max_new, k = 4, 5, 4, 3, 16, 4
+        prompts = []
+        for _ in range(n_req):
+            motif = rng.integers(0, cfg.vocab_size, size=motif_len).tolist()
+            prompts.append(motif * reps
+                           + rng.integers(0, cfg.vocab_size,
+                                          size=tail).tolist())
+
+        def run(proposer, uid_base):
+            pcfg = type(vcfg)(**vars(vcfg))
+            eng = InferenceEngineV2(engine.model, params=engine.params,
+                                    config=pcfg)
+            sched = ContinuousBatchingScheduler(eng, proposer=proposer,
+                                                max_draft_tokens=k)
+            times = {}
+
+            def on_token(uid, tok):
+                times.setdefault(uid, []).append(time.perf_counter())
+
+            # warmup request: compiles the prefill buckets AND (spec on)
+            # the verify-width program, so TPOT measures steady state
+            sched.submit(uid_base - 1, prompts[0], max_new_tokens=max_new)
+            sched.run_to_completion()
+            gens = []
+            for i, p in enumerate(prompts):
+                uid = uid_base + i
+                sched.submit(uid, p, max_new_tokens=max_new,
+                             on_token=on_token)
+                sched.run_to_completion()
+                gens.append(sched.finished[uid].generated)
+            tpots = [(ts[-1] - ts[0]) / (len(ts) - 1)
+                     for ts in times.values() if len(ts) > 1]
+            return gens, tpots, sched.spec_stats()
+
+        gens_off, tpot_off, _ = run(None, 80_000)
+        gens_on, tpot_on, stats = run(NGramProposer(ngram_max=3), 90_000)
+        derived = spec_summary(stats)
+        pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 3)  # noqa: E731
+        return {
+            "n_requests": n_req,
+            "max_new_tokens": max_new,
+            "max_draft_tokens": k,
+            "tokens_per_forward": round(derived["tokens_per_forward"], 3),
+            "acceptance_rate": round(derived["acceptance_rate"], 4),
+            "drafts_proposed": int(stats["proposed"]),
+            "drafts_accepted": int(stats["accepted"]),
+            "spec_on": {"p50_tpot_ms": pct(tpot_on, 50),
+                        "p95_tpot_ms": pct(tpot_on, 95)},
+            "spec_off": {"p50_tpot_ms": pct(tpot_off, 50),
+                         "p95_tpot_ms": pct(tpot_off, 95)},
+            "tokens_match": gens_on == gens_off,
+        }
+
     def run_prefix_phase():
         """Shared-prefix serving (docs/SERVING.md "Prefix caching"): N
         requests over K distinct system prompts, cache on vs off. Each
@@ -336,6 +405,7 @@ def bench_serving(on_tpu: bool):
                                           decode_budget)
     frontend = run_frontend_phase()
     prefix = run_prefix_phase()
+    spec = run_spec_phase()
     return {
         "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
@@ -356,7 +426,25 @@ def bench_serving(on_tpu: bool):
         "frontend": frontend,
         # shared-prefix KV reuse phase (docs/SERVING.md "Prefix caching")
         "prefix": prefix,
+        # speculative decoding phase (docs/SERVING.md "Speculative
+        # decoding"): TPOT + tokens-per-forward, n-gram proposer on/off
+        "speculative": spec,
     }
+
+
+def git_sha():
+    """Short SHA of the benched tree, or None outside a git checkout —
+    stamped into the bench JSON so the BENCH_* trajectory is attributable
+    to exact code across rounds."""
+    try:
+        import subprocess
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return out.stdout.strip() or None
+    except Exception:
+        return None
 
 
 def main():
@@ -474,6 +562,10 @@ def main():
             "n_params": n_params,
             "n_devices": n_dev,
             "platform": platform,
+            # provenance stamp (with n_devices/platform above): compare
+            # BENCH_* files across rounds knowing exactly what ran where
+            "jax_version": jax.__version__,
+            "git_sha": git_sha(),
             "final_loss": final_loss,
             "mfu_6nd": round(flops_6nd / dt / (detect_peak() * n_dev), 4),
             "serving": serving,

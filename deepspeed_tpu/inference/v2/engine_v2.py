@@ -134,9 +134,28 @@ class InferenceEngineV2:
 
     # -------------------------------------------------------------- serving
     def put(self, uids: Sequence[int],
-            tokens_list: Sequence[Sequence[int]]) -> jnp.ndarray:
+            tokens_list: Sequence[Sequence[int]], *,
+            verify_width: int = 0,
+            defer_commit: bool = False) -> jnp.ndarray:
         """Run one forward over the ragged batch; returns next-token logits
-        [len(uids), vocab] (reference engine_v2.py:89)."""
+        [len(uids), vocab] (reference engine_v2.py:89).
+
+        Speculative verification (spec/, docs/SERVING.md "Speculative
+        decoding") uses two keyword extensions; the default call is
+        byte-for-byte the historical path:
+
+        - ``verify_width`` W > 0: return logits for each row's last W
+          valid positions, right-aligned — [len(uids), W, vocab] with
+          row i's last valid token at position W-1 — so the caller can
+          read the target's greedy argmax at every draft offset without
+          the engine materializing logits for the whole padded chunk. W
+          is static per compiled program; callers should bucket it.
+        - ``defer_commit``: advance ``seen_tokens`` (the KV was written)
+          but do NOT advance the prefix-cache hash chain — the fed tokens
+          may contain unverified drafts, and the index must never refer to
+          content that a later ``trim_sequence`` rolls back. The caller
+          commits the accepted prefix afterwards via :meth:`commit_tokens`.
+        """
         status = self.can_schedule(uids, [len(t) for t in tokens_list])
         if status != SchedulingResult.Success:
             raise SchedulingError(status)
@@ -151,10 +170,16 @@ class InferenceEngineV2:
             staged.append((seq, toks))
 
         arrays = self.batch.finalize()
-        logits, new_cache = self.paged.forward(
-            self.params, self.state_manager.kv_cache,
-            jnp.asarray(arrays["tokens"]), jnp.asarray(arrays["start_pos"]),
-            jnp.asarray(arrays["n_tokens"]), jnp.asarray(arrays["block_tables"]))
+        args = (self.params, self.state_manager.kv_cache,
+                jnp.asarray(arrays["tokens"]),
+                jnp.asarray(arrays["start_pos"]),
+                jnp.asarray(arrays["n_tokens"]),
+                jnp.asarray(arrays["block_tables"]))
+        if verify_width:
+            logits, new_cache = self.paged.forward_verify(
+                *args, verify_width=int(verify_width))
+        else:
+            logits, new_cache = self.paged.forward(*args)
         # commit sequence state only after the forward was dispatched: a
         # failed forward leaves seen_tokens unchanged (the step can be
         # retried) and — critically — never registers blocks whose KV was
@@ -165,11 +190,29 @@ class InferenceEngineV2:
         self.state_manager.kv_cache = new_cache
         for seq, toks in staged:
             seq.seen_tokens += len(toks)
-            self.state_manager.record_tokens(seq, toks)
+            if not defer_commit:
+                self.state_manager.record_tokens(seq, toks)
         return logits[:len(uids)]
 
     def flush(self, uid: int) -> None:
         self.state_manager.flush_sequence(uid)
+
+    # ------------------------------------------------------- speculative
+    def trim_sequence(self, uid: int, n_tokens: int) -> int:
+        """Drop a sequence's trailing ``n_tokens`` from the KV cache —
+        speculative-decoding rollback of rejected draft tokens. Returns
+        the number of KV blocks released (see
+        :meth:`DSStateManager.trim_sequence` for the prefix-cache
+        interaction contract)."""
+        return self.state_manager.trim_sequence(uid, n_tokens)
+
+    def commit_tokens(self, uid: int, tokens: Sequence[int]) -> None:
+        """Advance the prefix-cache hash chain with verified tokens — the
+        second half of a ``put(defer_commit=True)`` step, called after
+        rejected drafts were trimmed. No-op when the cache is disabled."""
+        seq = self.state_manager.get_sequence(uid)
+        if seq is not None:
+            self.state_manager.record_tokens(seq, tokens)
 
     def match_prefix(self, uid: int, prompt_tokens: Sequence[int]) -> int:
         """Prefix-cache lookup for a new sequence: share every cached

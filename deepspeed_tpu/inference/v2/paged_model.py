@@ -62,6 +62,15 @@ class PagedCausalLM:
 
         self._attn_raw = instantiate_attn(self.cfg, name=attn_impl)
         self.forward = jax.jit(self._forward)
+        # trailing-positions logits variant for speculative verification
+        # (spec/): same forward, but the unembed runs over each row's LAST
+        # ``verify_width`` positions (right-aligned) so the target's
+        # greedy choice is known at every draft offset — without
+        # materializing [N, C, vocab] when only K+1 << C positions matter.
+        # A separate compiled program per width bucket — the default path
+        # stays byte-identical.
+        self.forward_verify = jax.jit(self._forward,
+                                      static_argnames=("verify_width",))
 
     def _attend(self, q, kc, vc, block_tables, start_pos, n_tokens, slopes,
                 window=0):
@@ -102,11 +111,16 @@ class PagedCausalLM:
 
     # ------------------------------------------------------------------
     def _forward(self, params, kv_cache, tokens, start_pos, n_tokens,
-                 block_tables):
+                 block_tables, verify_width: int = 0):
         """tokens [N, C]; start_pos/n_tokens [N]; block_tables [N, MB];
         kv_cache {k,v}: [L, NB, KH, bs, D].
 
-        Returns (last_logits [N, V], new_kv_cache).
+        Returns (last_logits [N, V], new_kv_cache) — or, with static
+        ``verify_width`` W > 0, (logits [N, W, V], new_kv_cache) holding
+        each row's last W valid positions *right-aligned*: position
+        ``W-1`` is the row's last valid token (what the default path
+        gathers), ``W-1-j`` is j tokens earlier; rows shorter than W
+        duplicate their first position in the left padding.
         """
         cfg = self.cfg
         N, C = tokens.shape
@@ -190,6 +204,15 @@ class PagedCausalLM:
             block_for, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
+        if verify_width:
+            # right-aligned trailing-positions gather: row i, slot j reads
+            # chunk position n_tokens[i] - W + j (clipped) — slot W-1 is
+            # exactly the default path's last-token gather
+            W = verify_width
+            idx = jnp.clip(n_tokens[:, None] - W + jnp.arange(W)[None, :],
+                           0, C - 1)                              # [N, W]
+            x_v = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [N,W,H]
+            return self.model._unembed(params, x_v), {"k": new_k, "v": new_v}
         # logits_gather: only the last valid token per sequence
         last_idx = jnp.clip(n_tokens - 1, 0, C - 1)
         x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]

@@ -39,6 +39,11 @@ class BlockedAllocator:
         """Current refcount (0 for free/unknown blocks)."""
         return self._refs.get(block, 0)
 
+    def is_shared(self, block: int) -> bool:
+        """More than one holder (prefix cache and/or other sequences) —
+        the owner must not mutate the block's KV in place."""
+        return self._refs.get(block, 0) > 1
+
     def allocate(self, num_blocks: int) -> List[int]:
         if num_blocks > len(self._free):
             raise ValueError(

@@ -117,12 +117,78 @@ class DSStateManager:
         to them."""
         seq = self._seqs.pop(uid, None)
         if seq is not None and seq.kv_blocks:
-            self.allocator.release(seq.kv_blocks)
-            if self.prefix_cache_enabled:
-                for b in seq.kv_blocks:
-                    if (b in self._block_hash
-                            and self.allocator.ref_count(b) == 1):
-                        self._evictable += 1
+            self._release_blocks(seq.kv_blocks)
+
+    def _release_blocks(self, blocks: List[int]) -> None:
+        """Drop one reference per block and keep the incremental
+        evictable count honest: an indexed block whose only remaining
+        reference is the cache's own just became reclaimable. The single
+        home for this transition — flush and trim both go through it."""
+        self.allocator.release(blocks)
+        if self.prefix_cache_enabled:
+            for b in blocks:
+                if (b in self._block_hash
+                        and self.allocator.ref_count(b) == 1):
+                    self._evictable += 1
+
+    def trim_sequence(self, uid: int, n_tokens: int) -> int:
+        """KV rollback: drop the trailing ``n_tokens`` from a sequence —
+        the speculative-decoding rejection path (spec/: drafts the target
+        model refuted must vanish from the cache before the next step).
+
+        Trailing blocks that become empty are ``release``d through the
+        refcount machinery: a private block returns to the free list; a
+        block the prefix cache also holds stays resident (the cache's own
+        reference keeps it) and becomes evictable. Blocks *below* the new
+        length — including prefix-shared ones — are untouched: no refcount
+        changes, no index changes.
+
+        Interaction with the prefix-cache index: draft tokens are never
+        chain-registered (the scheduler defers ``record_tokens`` until
+        after verification — ``put(defer_commit=True)``), so a trim of
+        speculative tokens can never cut into hashed coverage. Trimming
+        *into* an already-indexed block is refused with ``ValueError``:
+        the retained prefix of such a block would later be overwritten in
+        place while the index (and possibly other sequences) still
+        reference the old content. Callers that need that must flush and
+        re-prefill instead.
+
+        Returns the number of blocks released.
+        """
+        seq = self._seqs.get(uid)
+        if seq is None or n_tokens <= 0:
+            return 0
+        if n_tokens > seq.seen_tokens:
+            raise ValueError(
+                f"cannot trim {n_tokens} tokens from sequence {uid} "
+                f"({seq.seen_tokens} seen)")
+        new_seen = seq.seen_tokens - n_tokens
+        if new_seen < seq.hashed_blocks * self.block_size:
+            raise ValueError(
+                f"cannot trim sequence {uid} into prefix-indexed blocks "
+                f"({seq.hashed_blocks} blocks hashed, want "
+                f"{new_seen} tokens)")
+        keep = -(-new_seen // self.block_size)       # ceil; 0 when new_seen=0
+        dropped = seq.kv_blocks[keep:]
+        # sharing happens only through the prefix index, and indexed
+        # blocks sit inside hashed coverage (guarded above) — a dropped
+        # block that is shared yet unindexed means some other sequence
+        # reads KV this trim is rolling back: corruption, refuse loudly
+        for b in dropped:
+            if self.allocator.is_shared(b) and b not in self._block_hash:
+                raise ValueError(
+                    f"cannot trim block {b} of sequence {uid}: shared "
+                    "outside the prefix index (sharing invariant violated)")
+        del seq.kv_blocks[keep:]
+        seq.seen_tokens = new_seen
+        # chain state: un-blocked pending tokens past the new end are gone
+        over = (seq.hashed_blocks * self.block_size
+                + len(seq.pending_tokens)) - new_seen
+        if over > 0:
+            del seq.pending_tokens[len(seq.pending_tokens) - over:]
+        if dropped:
+            self._release_blocks(dropped)
+        return len(dropped)
 
     @property
     def tracked_sequences(self) -> List[int]:

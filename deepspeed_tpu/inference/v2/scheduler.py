@@ -8,6 +8,16 @@ for every running sequence and (b) prompt *chunks* from pending requests,
 splitting long prompts so every forward has near-constant token count — the
 Dynamic SplitFuse property that keeps TTFT low while decode throughput
 stays flat.
+
+Speculative decoding (``proposer`` + greedy sampling; spec/,
+docs/SERVING.md "Speculative decoding") rides the same packing: a decode
+row carries ``[certain_token, draft_1..draft_K]`` instead of one token —
+structurally a K+1-token prefill chunk — the forward returns per-position
+logits, ``verify_greedy`` accepts the longest draft prefix the target's
+argmax agrees with, and rejected tokens are rolled back with
+``engine.trim_sequence``. The emitted stream is byte-identical to
+speculation off; with no proposer the scheduler is byte-for-byte the
+historical one.
 """
 
 from __future__ import annotations
@@ -18,8 +28,10 @@ from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
+from ...utils.logging import logger
 from .engine_v2 import InferenceEngineV2
 from .scheduling_utils import SchedulingResult
+from .spec import DraftProposer, verify_greedy
 
 
 @dataclasses.dataclass
@@ -48,7 +60,9 @@ class Request:
 
 class ContinuousBatchingScheduler:
     def __init__(self, engine: InferenceEngineV2,
-                 sample_fn: Optional[Callable] = None):
+                 sample_fn: Optional[Callable] = None,
+                 proposer: Optional[DraftProposer] = None,
+                 max_draft_tokens: int = 4):
         self.engine = engine
         self.pending: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}
@@ -57,6 +71,35 @@ class ContinuousBatchingScheduler:
         self._budget = engine.config.max_ragged_batch_size
         self._max_seqs = engine.config.max_ragged_sequence_count
         self._chunk = engine.config.max_chunk_tokens
+        # speculative decoding: only lossless under greedy sampling — a
+        # custom sample_fn silently wins over the proposer (documented)
+        self.max_draft_tokens = max_draft_tokens
+        self.proposer = proposer
+        if proposer is not None and sample_fn is not None:
+            logger.warning(
+                "speculative decoding requires greedy sampling; custom "
+                "sample_fn given — proposer disabled for this scheduler")
+            self.proposer = None
+        self._spec_stats = {"proposed": 0, "accepted": 0, "emitted": 0,
+                            "decode_rows": 0}
+        self._proposer_warned = False
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self.proposer is not None
+
+    def spec_stats(self) -> Dict[str, int]:
+        """Monotonic speculative-decoding counters: ``proposed``/
+        ``accepted`` draft tokens, ``emitted`` decode tokens, and
+        ``decode_rows`` (decode row-forwards — each would have emitted
+        exactly one token without speculation, so tokens-per-forward =
+        emitted / decode_rows). ``proposed`` counts drafts that reached
+        verification — drafts discarded by the admission degrade path
+        were never judged and don't count; ``accepted`` counts only
+        *delivered* drafts (a draft verified beyond an EOS is trimmed,
+        not delivered), so acceptance_rate describes the streams the
+        requests actually received."""
+        return dict(self._spec_stats)
 
     def submit(self, uid: int, prompt_tokens: List[int],
                max_new_tokens: int = 64, eos_token_id: Optional[int] = None,
@@ -80,6 +123,8 @@ class ContinuousBatchingScheduler:
         if req is None or req.done:
             return False
         self.engine.flush(uid)
+        if self.proposer is not None:       # drop draft state mid-speculation
+            self.proposer.release(uid)
         req.done = True
         req.finish_reason = "cancelled"
         self.finished[uid] = req
@@ -132,12 +177,30 @@ class ContinuousBatchingScheduler:
             chunks.append(chunk)
             return True
 
-        # (a) one token for every running (decode) sequence that fits
+        # (a) one token for every running (decode) sequence that fits —
+        # plus up to max_draft_tokens proposer drafts when speculating
+        # (the chunk is then verified like a K+1-token prefill chunk)
         for uid, req in list(self.running.items()):
             if req.prompt_remaining > 0 or budget <= 0:
                 continue  # still prefilling (below) / out of budget (defer)
             tok = self.sample_fn(req.last_logits)
-            if admit(req, [tok]):
+            chunk = [tok]
+            if self.proposer is not None:
+                # cap drafts so the chunk fits every static budget; the
+                # last draft slot is pointless when the request can emit
+                # at most one more token anyway
+                k = min(self.max_draft_tokens, budget - 1, self._chunk - 1,
+                        req.max_new_tokens - len(req.generated) - 1)
+                if k > 0:
+                    drafts = self._propose(req, tok, k)
+                    if drafts:
+                        chunk = [tok] + [int(d) for d in drafts[:k]]
+            if admit(req, chunk):
+                plan.append((req, chunk, True))
+                budget -= len(chunk)
+            elif len(chunk) > 1 and admit(req, [tok]):
+                # speculative chunk didn't fit (KV pressure / seq-len
+                # ceiling) — degrade to plain decode rather than defer
                 plan.append((req, [tok], True))
                 budget -= 1
         # (b) prompt chunks: running-but-prefilling first, then pending
@@ -154,21 +217,78 @@ class ContinuousBatchingScheduler:
                 self.pending.appendleft(req)   # new request deferred
         return uids, chunks, plan
 
+    def _propose(self, req: Request, tok: int, k: int) -> List[int]:
+        """Fetch drafts, isolating the scheduler from proposer faults —
+        proposers are advisory, so any exception degrades to "no drafts"
+        (warned once) instead of killing the serving step loop. Proposers
+        with a bounded lookback (``context_window``) get only that tail,
+        saving a full-history list rebuild per decode row per step."""
+        win = getattr(self.proposer, "context_window", None)
+        if win is None:
+            ctx = req.prompt_tokens + req.generated + [tok]
+        else:
+            need = max(win - 1, 0)
+            gen = req.generated
+            if len(gen) >= need:
+                ctx = gen[len(gen) - need:] + [tok]
+            else:
+                ctx = (req.prompt_tokens[max(0, len(req.prompt_tokens)
+                                             - (need - len(gen))):]
+                       + gen + [tok])
+        try:
+            return self.proposer.propose(req.uid, ctx, k)
+        except Exception as e:
+            if not self._proposer_warned:
+                self._proposer_warned = True
+                logger.warning(f"draft proposer failed ({e!r}); "
+                               "continuing without speculation for the "
+                               "affected steps")
+            return []
+
     def step(self) -> List[int]:
         """One engine forward; returns uids of requests finished this step."""
         uids, chunks, plan = self._pack()
         if not uids:
             return []
-        logits = np.asarray(self.engine.put(uids, chunks))
+        # verification width: the widest speculative decode chunk this
+        # step, bucketed (pow2) to bound compiled-program variants. Steps
+        # with no drafts in flight — pure prefill, draft-less decode —
+        # take the exact historical path.
+        spec_w = max((len(c) for _, c, d in plan if d and len(c) > 1),
+                     default=0)
+        if self.proposer is None or spec_w == 0:
+            logits = np.asarray(self.engine.put(uids, chunks))
+        else:
+            W = self.engine.batch._bucket(spec_w, self._chunk)
+            # speculative step: right-aligned trailing-position logits for
+            # verification; the prefix-cache hash chain is committed
+            # per-row below, once rejected drafts have been trimmed (the
+            # index must never see tokens a trim can roll back)
+            logits = np.asarray(self.engine.put(uids, chunks,
+                                                verify_width=W,
+                                                defer_commit=True))
         done_now = []
         # commit state only after the forward succeeded
         for i, (req, chunk, is_decode) in enumerate(plan):
-            req.last_logits = logits[i]
-            if is_decode:
-                req.generated.append(chunk[0])
-                if req.on_token is not None:
-                    req.on_token(req.uid, chunk[0])
+            if self.proposer is None or spec_w == 0:
+                req.last_logits = logits[i]
+                if is_decode:
+                    req.generated.append(chunk[0])
+                    self._spec_stats["decode_rows"] += 1
+                    self._spec_stats["emitted"] += 1
+                    if req.on_token is not None:
+                        req.on_token(req.uid, chunk[0])
+                else:
+                    req.prompt_fed += len(chunk)
+                    self.running[req.uid] = req
+            elif is_decode:
+                # row i's valid positions are right-aligned: the last
+                # len(chunk) slots
+                self._apply_verified(req, chunk,
+                                     logits[i, logits.shape[1] - len(chunk):])
             else:
+                req.last_logits = logits[i, -1]   # slot W-1 = last valid
+                self.engine.commit_tokens(req.uid, chunk)
                 req.prompt_fed += len(chunk)
                 self.running[req.uid] = req
             if req.prompt_remaining > 0:
@@ -181,10 +301,41 @@ class ContinuousBatchingScheduler:
                 self.finished[req.uid] = req
                 self.running.pop(req.uid, None)
                 self.engine.flush(req.uid)
+                if self.proposer is not None:
+                    self.proposer.release(req.uid)
                 done_now.append(req.uid)
                 if req.on_finish is not None:
                     req.on_finish(req, req.finish_reason)
         return done_now
+
+    def _apply_verified(self, req: Request, chunk: List[int],
+                        rows: np.ndarray) -> None:
+        """Verify one speculative decode row and commit the outcome:
+        accept the longest target-agreeing draft prefix, trim the rejected
+        tail out of the KV cache, advance the prefix-cache chain with the
+        surviving tokens only, and stream the emitted tokens (stopping at
+        EOS — exactly where plain greedy decoding would have stopped)."""
+        emitted, last = verify_greedy(chunk, rows)
+        if req.eos_token_id is not None and req.eos_token_id in emitted:
+            # tokens the target accepted beyond EOS are never delivered —
+            # truncate BEFORE trim/commit/stats so the KV state, the
+            # prefix chain, and the counters all describe exactly the
+            # stream the request receives
+            cut = emitted.index(req.eos_token_id) + 1
+            emitted, last = emitted[:cut], cut - 1
+        rejected = len(chunk) - len(emitted)
+        if rejected:
+            self.engine.trim_sequence(req.uid, rejected)
+        self.engine.commit_tokens(req.uid, emitted)
+        req.last_logits = rows[last]
+        self._spec_stats["decode_rows"] += 1
+        self._spec_stats["proposed"] += len(chunk) - 1
+        self._spec_stats["accepted"] += len(emitted) - 1
+        for t in emitted:
+            req.generated.append(t)
+            self._spec_stats["emitted"] += 1
+            if req.on_token is not None:
+                req.on_token(req.uid, t)
 
     def run_to_completion(self, max_steps: int = 10000) -> Dict[int, Request]:
         steps = 0

@@ -1,0 +1,20 @@
+"""Speculative decoding for the v2 ragged engine (docs/SERVING.md
+"Speculative decoding").
+
+Greedy-lossless: a :class:`DraftProposer` guesses the next K tokens, the
+scheduler packs them into one ragged step (structurally a K-token prefill
+chunk), the target model's per-position argmax verifies them, and the
+longest agreeing prefix is accepted — rejected tokens are rolled back via
+``DSStateManager.trim_sequence``. The emitted stream is byte-identical to
+plain greedy decoding; speculation only changes how many forwards it takes.
+
+The reference DeepSpeed (0.12.3) has no speculative path — see
+docs/DIVERGENCES.md.
+"""
+
+from .proposer import (DraftModelProposer, DraftProposer,  # noqa: F401
+                       NGramProposer)
+from .verify import verify_greedy  # noqa: F401
+
+__all__ = ["DraftProposer", "NGramProposer", "DraftModelProposer",
+           "verify_greedy"]

@@ -1,0 +1,181 @@
+"""Draft proposers for speculative decoding.
+
+Two implementations of the :class:`DraftProposer` protocol:
+
+- :class:`NGramProposer` — prompt-lookup self-drafting: the sequence's own
+  token history is the draft model (match the current suffix n-gram against
+  an earlier occurrence and propose what followed it). No second model, no
+  device work, deterministic — repetition-heavy workloads (code, extraction,
+  multi-turn chat quoting context) accept most drafts for free.
+- :class:`DraftModelProposer` — a small same-family model runs greedily K
+  steps ahead on its own :class:`InferenceEngineV2`. Rollback of rejected
+  drafts reuses the same ``trim_sequence`` machinery as the target engine.
+
+Proposers are *advisory*: any (possibly empty) token list is correct —
+verification never trusts them. They may keep per-uid state; the scheduler
+calls :meth:`release` when a sequence finishes or is cancelled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Protocol, Sequence, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class DraftProposer(Protocol):
+    def propose(self, uid: int, context: Sequence[int],
+                k: int) -> List[int]:
+        """Up to ``k`` draft tokens predicted to follow ``context``
+        (the sequence's full token history: prompt + emitted tokens,
+        including the just-sampled one). Fewer (or none) is always legal."""
+        ...
+
+    def release(self, uid: int) -> None:
+        """Drop any per-sequence state (finish/cancel/expiry)."""
+        ...
+
+
+class NGramProposer:
+    """Prompt-lookup decoding (self-speculation).
+
+    Finds the longest suffix of the context (``ngram_min..ngram_max``
+    tokens) that also occurs earlier in the context and proposes the
+    tokens that followed that occurrence. Longer suffixes win; within a
+    suffix length, the occurrence with the longest continuation runway
+    (up to the k requested drafts) wins, most recent on ties — a match
+    one cycle period from the end would otherwise cap every proposal at
+    one period. ``max_history`` bounds the scan (O(max_history) integer
+    compares per call) regardless of context length.
+    """
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1,
+                 max_history: int = 4096):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError(f"need 1 <= ngram_min <= ngram_max, got "
+                             f"{ngram_min}..{ngram_max}")
+        self.ngram_max = ngram_max
+        self.ngram_min = ngram_min
+        self.max_history = max_history
+
+    @property
+    def context_window(self):
+        """Lookback bound — the scheduler passes only this many trailing
+        context tokens, skipping the full-history rebuild per call."""
+        return self.max_history
+
+    def propose(self, uid: int, context: Sequence[int], k: int) -> List[int]:
+        ctx = list(context[-self.max_history:])
+        L = len(ctx)
+        if k <= 0 or L < self.ngram_min + 1:
+            return []
+        # one backward pass: at each earlier occurrence of the last token,
+        # extend the suffix match leftward. Longest suffix wins; within a
+        # length, the most recent occurrence with a full k-token
+        # continuation (a near-the-end match — one cycle period back in a
+        # repetition loop — may leave fewer than k tokens of runway, in
+        # which case an older occurrence drafts deeper). The miss path is
+        # O(L) integer compares — no per-candidate slice allocations —
+        # so non-repetitive traffic pays near nothing per decode row.
+        last = ctx[-1]
+        n_cap = min(self.ngram_max, L - 1)
+        best_n, best_cont = 0, []
+        for j in range(L - 2, -1, -1):        # j: candidate match of `last`
+            if ctx[j] != last:
+                continue
+            n = 1
+            while n < n_cap and n <= j and ctx[j - n] == ctx[L - 1 - n]:
+                n += 1
+            if n < self.ngram_min:
+                continue
+            cont = ctx[j + 1:j + 1 + k]
+            if n > best_n or (n == best_n and len(cont) > len(best_cont)):
+                best_n, best_cont = n, cont
+            if best_n == n_cap and len(best_cont) >= k:
+                break                         # nothing can beat this
+        return best_cont
+
+    def release(self, uid: int) -> None:  # stateless
+        pass
+
+
+class DraftModelProposer:
+    """Greedy K-step lookahead with a small draft model.
+
+    ``engine`` is an :class:`InferenceEngineV2` over the draft model (same
+    tokenizer family as the target — token ids must mean the same thing).
+    The proposer mirrors each sequence's context into the draft engine
+    incrementally: on every call it trims the draft KV back to the longest
+    common prefix of what it fed and the (authoritative) target context —
+    this is where rejected drafts from the previous round roll back, via
+    the same ``trim_sequence`` path the target engine uses — then feeds the
+    missing context tokens and decodes ``k`` tokens greedily.
+
+    Cost model: the per-uid ``propose`` hook runs k serial single-token
+    draft forwards per decode row per step — S·k draft dispatches for S
+    running sequences, *not* one batched draft forward. That is the right
+    trade for latency-sensitive, low-concurrency serving with a much
+    cheaper draft; at high batch sizes the dispatch overhead erodes the
+    saved target forwards, and the n-gram proposer (zero device work) or
+    no speculation wins. Batched draft proposal needs a batch-level
+    proposer hook — future work (docs/SERVING.md).
+    """
+
+    # needs the FULL context from position 0 (the incremental mirror diffs
+    # against it) — no bounded lookback
+    context_window = None
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._fed: Dict[int, List[int]] = {}      # uid -> tokens in draft KV
+        self._last: Dict[int, np.ndarray] = {}    # uid -> last logits row
+
+    def propose(self, uid: int, context: Sequence[int], k: int) -> List[int]:
+        ctx = list(context)
+        if k <= 0 or not ctx:
+            return []
+        # roll rejected drafts back FIRST — even when the horizon check
+        # below skips proposing, stale refuted tokens must not keep
+        # occupying draft-engine KV blocks (or desync ``fed``)
+        fed = self._fed.setdefault(uid, [])
+        p = 0
+        while p < len(fed) and p < len(ctx) and fed[p] == ctx[p]:
+            p += 1
+        if p < len(fed):
+            self.engine.trim_sequence(uid, len(fed) - p)
+            del fed[p:]
+        # the draft model cannot see past its own horizon (it must be able
+        # to run ctx + k tokens); give up rather than overflow it
+        if len(ctx) + k > self.engine.model.cfg.max_seq_len:
+            return []
+        # every draft-engine put defers the prefix-cache chain commit: the
+        # fed tokens include drafts that the next call may trim back, and
+        # trim_sequence refuses to cut into chain-indexed blocks — with a
+        # prefix-cache-enabled draft engine the chain must simply never
+        # advance (the draft KV is scratch space, not reusable prefill)
+        chunk_cap = self.engine.config.max_chunk_tokens
+        pos = len(fed)
+        while pos < len(ctx):
+            take = min(chunk_cap, len(ctx) - pos)
+            self._last[uid] = np.asarray(
+                self.engine.put([uid], [ctx[pos:pos + take]],
+                                defer_commit=True))[0]
+            fed.extend(ctx[pos:pos + take])
+            pos += take
+        if uid not in self._last:                 # ctx fully cached, no
+            return []                             # logits to draft from
+        drafts: List[int] = []
+        for _ in range(k):
+            t = int(np.argmax(self._last[uid]))
+            drafts.append(t)
+            self._last[uid] = np.asarray(
+                self.engine.put([uid], [[t]], defer_commit=True))[0]
+            fed.append(t)
+        return drafts
+
+    def release(self, uid: int) -> None:
+        self._fed.pop(uid, None)
+        self._last.pop(uid, None)
+        if self.engine.state_manager.get_sequence(uid) is not None:
+            self.engine.flush(uid)

@@ -1,0 +1,46 @@
+"""Greedy-lossless verification of a speculative chunk.
+
+The scheduler packs ``chunk = [t0, d1, ..., dK]`` into one ragged step:
+``t0`` is the *certain* token (the target's own greedy sample from the
+previous step's logits), ``d1..dK`` the proposer's drafts. The target
+forward returns per-position logits for the whole chunk; position ``j``'s
+argmax is the target's greedy choice for the token *after* ``chunk[j]``.
+A draft ``d_{j+1}`` is accepted iff it equals that argmax — i.e. iff plain
+greedy decoding would have produced exactly it. Acceptance stops at the
+first disagreement, so the emitted stream is byte-identical to greedy
+decoding with speculation off; only the number of forwards changes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def verify_greedy(chunk: Sequence[int],
+                  logits_rows: np.ndarray) -> Tuple[List[int], int]:
+    """Verify one speculative chunk against the target's logits.
+
+    ``chunk``: the ``1 + K`` tokens fed this step (certain token + drafts).
+    ``logits_rows``: ``[>= len(chunk), vocab]`` per-position target logits
+    for the chunk (extra padded rows are ignored).
+
+    Returns ``(emitted, last_idx)``: the tokens proven correct this step —
+    ``chunk[0]`` plus the longest agreeing draft prefix — and the index of
+    the logits row holding the distribution *after* the last emitted token
+    (its argmax is the next certain token; the scheduler stores it as
+    ``last_logits``, which is also where the "+1 bonus token" of
+    speculative decoding comes from: one extra token is always known after
+    a fully-accepted chunk).
+    """
+    n = len(chunk)
+    emitted = [int(chunk[0])]
+    # one argmax over the chunk's rows; row j answers "what follows
+    # chunk[:j+1]?"
+    greedy = np.argmax(np.asarray(logits_rows[:n]), axis=-1)
+    for j in range(1, n):
+        if int(chunk[j]) != int(greedy[j - 1]):
+            break
+        emitted.append(int(chunk[j]))
+    return emitted, len(emitted) - 1

@@ -188,6 +188,15 @@ class OneBitOptimizer(Optimizer):
                 f"wire_bits must be 1 (packed two-phase) or 8 (int8 psum); "
                 f"got {self.wire_bits}")
 
+    def _frozen_c2(self) -> float:
+        """Bias-correction factor of the variance at the moment it froze.
+        Static Python float (freeze_step and betas are construction-time),
+        so it folds into the compiled compressed-step program."""
+        if not getattr(self, "bias_correction", True):
+            return 1.0
+        b2 = self.betas[1]
+        return 1.0 - b2 ** max(int(self.freeze_step), 1)
+
     def step(self, params, grads, state, lr):
         raise TypeError(
             f"{type(self).__name__} communicates inside its step and must "
@@ -260,11 +269,18 @@ class OneBitAdam(OneBitOptimizer):
         t = state.step + 1
         wd = self.weight_decay
         dp = self.dp_size
+        c2f = self._frozen_c2()
 
         def upd(p, g, m, v, e, e2):
             c = b1 * m + (1 - b1) * g + e[0]
             m2, err, e2n = self._compress(c, e2, dp)
-            update = m2 / (jnp.sqrt(v) + self.eps)   # v frozen at freeze_step
+            # v frozen at freeze_step — with its bias correction frozen
+            # alongside (1-b2^freeze): v alone underestimates g² by that
+            # factor forever (the bias never decays once updates stop), so
+            # small freeze_steps would blow the update up ~1/(1-b2^t)×.
+            # The reference omits this only because it defaults freeze_step
+            # to 100k where the factor is 1.0 (docs/DIVERGENCES.md).
+            update = m2 / (jnp.sqrt(v / c2f) + self.eps)
             if wd:
                 update = update + wd * p
             return p - lr * update, m2, v, err[None], e2n[None]
@@ -361,11 +377,13 @@ class OneBitLamb(OneBitOptimizer):
         b1, _ = self.betas
         t = state.step + 1
         dp = self.dp_size
+        c2f = self._frozen_c2()
 
         def upd(p, g, m, v, r, e, e2):
             c = b1 * m + (1 - b1) * g + e[0]
             m2, err, e2n = self._compress(c, e2, dp)
-            u = m2 / (jnp.sqrt(v) + self.eps) + self.weight_decay * p
+            # frozen v carries its frozen bias correction (see OneBitAdam)
+            u = m2 / (jnp.sqrt(v / c2f) + self.eps) + self.weight_decay * p
             return p - lr * r * u, m2, v, r, err[None], e2n[None]
 
         out = _tmap(upd, params, grads, state.moments["m"],

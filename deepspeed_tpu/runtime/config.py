@@ -20,7 +20,8 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
-from ..serving.config import PrefixCacheConfig, ServingConfig
+from ..serving.config import (PrefixCacheConfig, ServingConfig,
+                              SpeculativeConfig)
 from ..utils.logging import logger
 
 # ----------------------------------------------------------------- defaults
@@ -343,6 +344,9 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # prefix-cache KV block reuse for the v2 ragged engine (docs/SERVING.md
     # "Prefix caching"); also reachable as ``serving.prefix_cache``
     prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
+    # speculative decoding for the v2 ragged engine (docs/SERVING.md
+    # "Speculative decoding"); also reachable as ``serving.speculative``
+    speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 1234

@@ -11,7 +11,8 @@ Light names import eagerly; ``ServingFrontend``/``Replica``/
 ``ReplicaRouter`` load lazily because they pull in the JAX engine stack.
 """
 
-from .config import PrefixCacheConfig, ServingConfig  # noqa: F401
+from .config import (PrefixCacheConfig, ServingConfig,  # noqa: F401
+                     SpeculativeConfig)
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, serving_metrics)
 from .queue import AdmissionQueue  # noqa: F401
@@ -36,7 +37,8 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["ServingConfig", "PrefixCacheConfig", "MetricsRegistry",
+__all__ = ["ServingConfig", "PrefixCacheConfig", "SpeculativeConfig",
+           "MetricsRegistry",
            "serving_metrics", "Counter",
            "Gauge", "Histogram", "AdmissionQueue", "Priority", "Rejected",
            "RequestHandle", "RequestState", "ServingRequest", "TokenEvent",

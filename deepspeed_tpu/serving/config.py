@@ -34,6 +34,47 @@ class PrefixCacheConfig(DSConfigModel):
                                                  or None)
 
 
+class SpeculativeConfig(DSConfigModel):
+    """``speculative: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Speculative decoding"): greedy-lossless speculative decoding in the
+    v2 ragged engine. Mounted on both :class:`ServingConfig` and
+    ``DeepSpeedTpuConfig``; ``ServingFrontend`` applies it per replica
+    (each replica gets its own proposer — draft state is per-engine)."""
+
+    enabled: bool = False
+    mode: str = "ngram"                 # "ngram" | "draft_model"
+    max_draft_tokens: int = 4           # K: drafts verified per forward
+    ngram_max: int = 3                  # longest suffix n-gram to look up
+    # HF checkpoint path for mode="draft_model" (models/convert.py); the
+    # draft must share the target's tokenizer family
+    draft_model: Optional[str] = None
+
+    def build_proposer(self, draft_engine_factory=None):
+        """Construct the configured proposer (one per replica/scheduler),
+        or ``None`` when disabled. ``draft_engine_factory()`` overrides
+        checkpoint loading for mode="draft_model" — the programmatic path
+        (tests, pre-built draft engines)."""
+        if not self.enabled:
+            return None
+        from ..inference.v2.spec import DraftModelProposer, NGramProposer
+
+        if self.mode == "ngram":
+            return NGramProposer(ngram_max=self.ngram_max)
+        if self.mode == "draft_model":
+            if draft_engine_factory is not None:
+                return DraftModelProposer(draft_engine_factory())
+            if not self.draft_model:
+                raise ValueError(
+                    "speculative.mode='draft_model' needs draft_model "
+                    "(checkpoint path) or a draft_engine_factory")
+            from ..inference.v2.engine_v2 import InferenceEngineV2
+
+            return DraftModelProposer(
+                InferenceEngineV2(checkpoint_path=self.draft_model))
+        raise ValueError(f"unknown speculative.mode {self.mode!r} "
+                         "(expected 'ngram' or 'draft_model')")
+
+
 class ServingConfig(DSConfigModel):
     """Queue bounds, SLO defaults, replica fleet shape, shed policy."""
 
@@ -58,3 +99,5 @@ class ServingConfig(DSConfigModel):
     # prefix-cache KV block reuse (engine-level; ``from_engine_factory``
     # callers apply it via ``PrefixCacheConfig.apply``)
     prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
+    # speculative decoding (scheduler-level; applied per replica)
+    speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)

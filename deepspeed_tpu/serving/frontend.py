@@ -56,8 +56,13 @@ class ServingFrontend:
                               or None)
         self.admission = AdmissionQueue(self.config.max_queue_depth,
                                         self.metrics)
+        # speculative decoding is applied per replica: each Replica builds
+        # its own proposer from the block (draft state is per-engine)
+        spec = (self.config.speculative
+                if self.config.speculative.enabled else None)
         replicas = [Replica(i, eng, self.metrics, sample_fn,
-                            wedge_timeout_s=self.config.wedge_timeout_s)
+                            wedge_timeout_s=self.config.wedge_timeout_s,
+                            speculative=spec)
                     for i, eng in enumerate(engines)]
         self.router = ReplicaRouter(replicas, self.admission, self.metrics)
         self._closed = False

@@ -205,7 +205,13 @@ def serving_metrics() -> MetricsRegistry:
               # prefix-cache KV reuse (engine-side counters, replicated up
               # by each Replica — docs/SERVING.md "Prefix caching")
               "prefix_blocks_hit", "prefix_blocks_missed",
-              "prefix_blocks_evicted", "prefix_tokens_saved"):
+              "prefix_blocks_evicted", "prefix_tokens_saved",
+              # speculative decoding (scheduler-side counters, delta-
+              # published per Replica — docs/SERVING.md "Speculative
+              # decoding"); acceptance rate = accepted/proposed,
+              # tokens-per-forward = emitted/decode_forwards
+              "spec_tokens_proposed", "spec_tokens_accepted",
+              "spec_tokens_emitted", "spec_decode_forwards"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens"):
         reg.gauge(g)

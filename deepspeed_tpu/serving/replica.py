@@ -41,11 +41,33 @@ class Replica:
                  metrics: Optional[MetricsRegistry] = None,
                  sample_fn: Optional[Callable] = None,
                  wedge_timeout_s: float = 300.0,
-                 idle_wait_s: float = 0.005):
+                 idle_wait_s: float = 0.005,
+                 speculative=None):
         self.replica_id = replica_id
         self.engine = engine
         self.metrics = metrics
-        self.scheduler = ContinuousBatchingScheduler(engine, sample_fn)
+        # speculative decoding (docs/SERVING.md): each replica builds its
+        # OWN proposer — draft state (n-gram none, draft-model KV) is tied
+        # to this replica's sequences. A custom sampler makes the
+        # scheduler drop any proposer (lossless needs greedy), so don't
+        # pay proposer construction — draft-model mode loads a whole
+        # checkpoint — for something that would be discarded.
+        if (speculative is not None and speculative.enabled
+                and sample_fn is not None):
+            # surfaced here because the scheduler never sees the config —
+            # otherwise spec_tokens_* flatline with nothing in the logs
+            logger.warning(
+                f"serving replica {replica_id}: speculative decoding "
+                "configured but a custom sample_fn is set — speculation "
+                "disabled (lossless verification requires greedy sampling)")
+        proposer = (speculative.build_proposer()
+                    if speculative is not None and sample_fn is None
+                    else None)
+        max_drafts = (speculative.max_draft_tokens
+                      if speculative is not None else 4)
+        self.scheduler = ContinuousBatchingScheduler(
+            engine, sample_fn, proposer=proposer,
+            max_draft_tokens=max_drafts)
         self.wedge_timeout_s = wedge_timeout_s
         self.idle_wait_s = idle_wait_s
         self.state = ReplicaState.HEALTHY
@@ -60,9 +82,11 @@ class Replica:
         self.last_progress_t = time.monotonic()
         self._busy_since: Optional[float] = None
         self._steps_done = 0
-        # last engine prefix-cache snapshot, for delta-publishing the
-        # monotonic registry counters (summable across replicas)
+        # last engine prefix-cache / scheduler spec snapshots, for
+        # delta-publishing the monotonic registry counters (summable
+        # across replicas)
         self._prefix_last: Dict[str, int] = {}
+        self._spec_last: Dict[str, int] = {}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"serving-replica-{replica_id}")
 
@@ -228,21 +252,37 @@ class Replica:
                         ("misses", "prefix_blocks_missed"),
                         ("evictions", "prefix_blocks_evicted"),
                         ("tokens_saved", "prefix_tokens_saved"))
+    _SPEC_COUNTERS = (("proposed", "spec_tokens_proposed"),
+                      ("accepted", "spec_tokens_accepted"),
+                      ("emitted", "spec_tokens_emitted"),
+                      ("decode_rows", "spec_decode_forwards"))
 
     def _publish_prefix_stats(self) -> None:
-        """Forward the engine's monotonic prefix-cache counters into the
-        registry as deltas (so multi-replica numbers sum correctly)."""
+        """Forward the engine's monotonic prefix-cache counters (and the
+        scheduler's speculative-decoding counters) into the registry as
+        deltas (so multi-replica numbers sum correctly). Acceptance rate =
+        spec_tokens_accepted / spec_tokens_proposed; tokens-per-forward =
+        spec_tokens_emitted / spec_decode_forwards."""
         if self.metrics is None:
             return
         stats_fn = getattr(self.engine, "prefix_stats", None)
-        if stats_fn is None:
-            return
-        stats = stats_fn()
-        for key, name in self._PREFIX_COUNTERS:
-            delta = stats.get(key, 0) - self._prefix_last.get(key, 0)
+        if stats_fn is not None:
+            stats = stats_fn()
+            for key, name in self._PREFIX_COUNTERS:
+                delta = stats.get(key, 0) - self._prefix_last.get(key, 0)
+                if delta:
+                    self.metrics.counter(name).inc(delta)
+            self._prefix_last = stats
+        # published with or without a proposer: plain decode rows count
+        # one forward / one emitted token, so emitted/decode_forwards
+        # reads 1.0 for a spec-off replica (and fleet-wide ratios keep an
+        # honest denominator in mixed fleets)
+        sstats = self.scheduler.spec_stats()
+        for key, name in self._SPEC_COUNTERS:
+            delta = sstats.get(key, 0) - self._spec_last.get(key, 0)
             if delta:
                 self.metrics.counter(name).inc(delta)
-        self._prefix_last = stats
+        self._spec_last = sstats
 
     def _enforce_slo(self) -> None:
         """Cancel/expire active requests; scheduler.cancel frees their KV

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Tier-1 verify — THE single source of truth for the gate and its DOTS
+# count (ROADMAP.md "Tier-1 verify" and .claude/skills/verify/SKILL.md
+# both point here; change the command in this file only).
+#
+# Runs the fast test suite on the virtual CPU mesh (tests/conftest.py
+# pins 8 CPU devices) and prints DOTS_PASSED=<n>: the number of passing
+# tests counted from pytest's progress dots. Exit code is pytest's.
+#
+# Env knobs:
+#   TIER1_LOG      log path (default /tmp/_t1.log)
+#   TIER1_TIMEOUT  whole-run timeout in seconds (default 870)
+#   TIER1_ARGS     extra pytest args (e.g. "-k spec")
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+rm -f "$LOG"
+timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" \
+    | tr -cd . | wc -c)"
+exit "$rc"

@@ -105,7 +105,6 @@ def test_warmup_matches_plain_adam(devices8):
 
 @pytest.mark.parametrize("opt_type", ["OneBitAdam", "ZeroOneAdam",
                                       "OneBitLamb"])
-@pytest.mark.slow
 def test_compressed_phase_trains(opt_type, devices8):
     """Short warmup then compressed steps: loss keeps decreasing and the
     compiled compressed update moves packed sign bits (u8) through the
@@ -154,7 +153,6 @@ def test_packed_wire_bytes_beat_int8(devices8):
     assert b1 < b8 / 3.5, f"packed wire {b1}B vs int8 {b8}B — expected >3.5x"
 
 
-@pytest.mark.slow
 def test_packed_and_int8_wires_both_converge(devices8):
     """Numeric sanity across wire formats with an adequate warmup (the
     reference defaults freeze_step to 100k for a reason — freezing the

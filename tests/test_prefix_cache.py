@@ -13,6 +13,8 @@ from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                   RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.ragged import BlockedAllocator, DSStateManager
 from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.inference.v2.testing import (assert_greedy_parity,
+                                                greedy_generate)
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 
 VOCAB = 128
@@ -203,16 +205,14 @@ def test_generated_tokens_identical_cache_on_off(model_and_params):
     outs = {}
     for enabled in (False, True):
         engine = make_engine(model, params, enabled=enabled)
-        sched = ContinuousBatchingScheduler(engine)
-        for i, p in enumerate(prompts):          # sequential: cache warms
-            sched.submit(100 + i, p, max_new_tokens=5)
-            sched.run_to_completion()
-        outs[enabled] = [sched.finished[100 + i].generated for i in range(4)]
+        # sequential (the default): the cache warms in submission order
+        outs[enabled] = greedy_generate(engine, prompts, uid_base=100,
+                                        max_new_tokens=5)
         if enabled:
             st = engine.prefix_stats()
             assert st["hits"] >= 3 * 3           # requests 1..3 hit sys blocks
             assert st["tokens_saved"] >= 3 * 24
-    assert outs[True] == outs[False]
+    assert_greedy_parity(outs[False], outs[True], "prefix cache")
 
 
 def test_cancel_under_prefix_sharing(model_and_params):

@@ -1,0 +1,534 @@
+"""Speculative decoding (ISSUE 3): greedy-lossless verification, KV
+rollback via trim_sequence (block-boundary and prefix-cache edge cases),
+n-gram and draft-model proposers, scheduler/serving/config wiring. The
+hard guarantee throughout: greedy token streams are byte-identical with
+speculation on and off, and spec off is byte-for-byte the old engine."""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.ragged import DSStateManager
+from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.inference.v2.spec import (DraftModelProposer,
+                                             NGramProposer, verify_greedy)
+from deepspeed_tpu.inference.v2.testing import (assert_greedy_parity,
+                                                greedy_generate, spec_summary)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+VOCAB = 128
+BS = 8          # kv block size used throughout
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=VOCAB, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=2,
+                            max_seq_len=128, norm="rmsnorm",
+                            activation="silu", position="rope")
+    model = CausalLM(cfg)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def make_engine(model, params, prefix=False, kv_blocks=64, max_seqs=4,
+                chunk=32):
+    vcfg = RaggedInferenceEngineConfig(
+        max_ragged_batch_size=128, max_ragged_sequence_count=max_seqs,
+        max_chunk_tokens=chunk, kv_blocks=kv_blocks, kv_block_size=BS,
+        max_tracked_sequences=64, enable_prefix_cache=prefix)
+    return InferenceEngineV2(model, params=params, config=vcfg)
+
+
+def model_cfg():
+    return TransformerConfig(vocab_size=VOCAB, hidden_size=16,
+                             intermediate_size=32, num_layers=1, num_heads=2,
+                             max_seq_len=256, norm="rmsnorm",
+                             activation="silu", position="rope")
+
+
+def tiny_manager(enabled=False, num_blocks=16):
+    return DSStateManager(model_cfg(), 32, num_blocks, BS,
+                          enable_prefix_cache=enabled)
+
+
+def repetitive_prompts(rng, n=3, motif_len=5, reps=4, tail=3):
+    """Motif-repetition prompts: greedy decode settles into the loop, so
+    the n-gram proposer's drafts are mostly accepted."""
+    out = []
+    for _ in range(n):
+        motif = rng.integers(0, VOCAB, size=motif_len).tolist()
+        out.append(motif * reps + rng.integers(0, VOCAB, size=tail).tolist())
+    return out
+
+
+# ------------------------------------------------------------- verify unit
+def test_verify_greedy_accepts_agreeing_prefix():
+    V = 16
+
+    def rows_for(next_tokens):
+        r = np.zeros((len(next_tokens), V), np.float32)
+        for i, t in enumerate(next_tokens):
+            r[i, t] = 1.0
+        return r
+
+    # target would continue 7→5→9; drafts [5, 9] fully agree
+    emitted, last = verify_greedy([7, 5, 9], rows_for([5, 9, 3]))
+    assert emitted == [7, 5, 9] and last == 2
+    # first draft wrong: only the certain token survives
+    emitted, last = verify_greedy([7, 4, 9], rows_for([5, 9, 3]))
+    assert emitted == [7] and last == 0
+    # mid-chunk rejection: agreement stops after one draft
+    emitted, last = verify_greedy([7, 5, 1], rows_for([5, 9, 3]))
+    assert emitted == [7, 5] and last == 1
+    # no drafts: plain decode row
+    emitted, last = verify_greedy([7], rows_for([5]))
+    assert emitted == [7] and last == 0
+
+
+# ------------------------------------------------------------ ngram unit
+def test_ngram_prefers_longest_suffix_and_recent_match():
+    p = NGramProposer(ngram_max=3)
+    # suffix [1,2] occurs twice; the later occurrence is followed by 9
+    ctx = [1, 2, 7, 5, 1, 2, 9, 3, 1, 2]
+    assert p.propose(0, ctx, 2) == [9, 3]
+    # longer suffix wins over a shorter, more recent one
+    ctx2 = [5, 1, 2, 3, 8, 2, 3, 0, 5, 1, 2, 3]
+    assert p.propose(0, ctx2, 1) == [8]       # trigram [1,2,3] match
+    assert p.propose(0, [1, 2, 3], 0) == []   # k=0
+    assert p.propose(0, [4], 3) == []         # nothing to match
+    assert p.propose(0, list(range(9)), 3) == []  # no repeat → no draft
+
+
+def test_ngram_k_cap_and_history_window():
+    p = NGramProposer(ngram_max=2, max_history=8)
+    ctx = [1, 2, 3, 4, 5, 6, 1, 2]
+    assert p.propose(0, ctx, 10) == [3, 4, 5, 6, 1, 2]   # capped by history
+    # the matching occurrence fell outside the window → no proposal
+    p2 = NGramProposer(ngram_max=2, max_history=4)
+    assert p2.propose(0, ctx, 4) == []
+    with pytest.raises(ValueError):
+        NGramProposer(ngram_max=0)
+
+
+# ----------------------------------------------------- trim_sequence unit
+def _fill(mgr, uid, tokens):
+    seq = mgr.get_or_create_sequence(uid)
+    mgr.maybe_allocate_kv(seq, len(tokens))
+    seq.seen_tokens += len(tokens)
+    mgr.record_tokens(seq, tokens)
+    return seq
+
+
+def test_trim_across_block_boundary():
+    mgr = tiny_manager()
+    seq = _fill(mgr, 1, list(range(20)))        # 2 full blocks + 4 in third
+    assert len(seq.kv_blocks) == 3
+    assert mgr.trim_sequence(1, 6) == 1         # 20→14: third block empties
+    assert seq.seen_tokens == 14
+    assert len(seq.kv_blocks) == 2
+    assert mgr.free_blocks == 16 - 2
+
+
+def test_trim_to_exact_block_edge():
+    mgr = tiny_manager()
+    seq = _fill(mgr, 1, list(range(20)))
+    assert mgr.trim_sequence(1, 4) == 1         # 20→16: exactly 2 blocks
+    assert seq.seen_tokens == 16
+    assert len(seq.kv_blocks) == 2
+    # trimming zero more is a no-op; a fresh token reuses a new block
+    assert mgr.trim_sequence(1, 0) == 0
+    mgr.maybe_allocate_kv(seq, 1)
+    assert len(seq.kv_blocks) == 3
+
+
+def test_trim_entire_sequence_and_overtrim():
+    mgr = tiny_manager()
+    seq = _fill(mgr, 1, list(range(12)))
+    assert mgr.trim_sequence(1, 12) == 2
+    assert seq.seen_tokens == 0 and seq.kv_blocks == []
+    assert mgr.free_blocks == 16
+    with pytest.raises(ValueError, match="cannot trim"):
+        mgr.trim_sequence(1, 1)
+    assert mgr.trim_sequence(99, 3) == 0        # unknown uid: no-op
+
+
+def test_trim_spares_prefix_shared_blocks():
+    """Trim of a sequence whose EARLIER blocks are prefix-shared: only its
+    private trailing blocks are released; shared refcounts are untouched."""
+    mgr = tiny_manager(enabled=True)
+    toks = list(range(16))
+    _fill(mgr, 1, toks)                        # donor indexes 2 blocks
+    matched = mgr.match_prefix(2, toks + [7, 7, 7])
+    assert matched == 16
+    seq2 = mgr.get_sequence(2)
+    shared = list(seq2.kv_blocks)
+    # sharer extends into private blocks (as a speculative step would)
+    mgr.maybe_allocate_kv(seq2, 6)
+    seq2.seen_tokens += 6                      # e.g. 1 certain + 5 drafts
+    assert len(seq2.kv_blocks) == 3
+    private = seq2.kv_blocks[2]
+    assert mgr.trim_sequence(2, 5) == 0        # 22→17: block 3 still needed
+    assert mgr.trim_sequence(2, 1) == 1        # 17→16: private block freed
+    assert seq2.kv_blocks == shared
+    for b in shared:
+        assert mgr.allocator.ref_count(b) == 3  # cache + donor + sharer
+    assert mgr.allocator.ref_count(private) == 0
+    # trimming INTO the shared (indexed) blocks must refuse: their content
+    # is immutable while the index / the donor reference it
+    with pytest.raises(ValueError, match="prefix-indexed"):
+        mgr.trim_sequence(2, 1)
+
+
+def test_trim_drops_pending_chain_tokens():
+    """Un-blocked pending hash-chain tokens past the trim point must be
+    dropped, so a later record_tokens stays position-consistent."""
+    mgr = tiny_manager(enabled=True)
+    seq = _fill(mgr, 1, list(range(12)))       # 1 full block + 4 pending
+    assert len(seq.pending_tokens) == 4
+    mgr.trim_sequence(1, 2)                    # 12→10
+    assert seq.pending_tokens == [8, 9]
+    mgr.record_tokens(seq, [])                 # consistency guard happy
+    seq.seen_tokens += 6
+    mgr.record_tokens(seq, [10, 11, 12, 13, 14, 15])
+    assert seq.hashed_blocks == 2              # chain advanced cleanly
+    mgr.flush_sequence(1)
+    assert mgr.match_prefix(3, list(range(10)) + [10, 11, 12, 13, 14, 15, 0]
+                            ) == 16
+
+
+def test_trim_never_registers_draft_tokens(model_and_params):
+    """A speculative put (defer_commit) followed by trim + commit must
+    leave the prefix index with exactly the accepted tokens — a later
+    prompt matching the REJECTED continuation must miss."""
+    model, params = model_and_params
+    engine = make_engine(model, params, prefix=True)
+    base = list(range(10, 24))                  # 14 accepted context tokens
+    engine.put([1], [base], verify_width=4, defer_commit=True)
+    # feed 1 certain + 3 drafts; pretend only the certain token survived
+    engine.put([1], [[30, 31, 32, 33]], verify_width=4, defer_commit=True)
+    engine.trim_sequence(1, 3)
+    engine.commit_tokens(1, base + [30])        # accepted prefix only
+    seq = engine.state_manager.get_sequence(1)
+    assert seq.seen_tokens == 15
+    assert seq.hashed_blocks == 1               # one full block of 8
+    engine.flush(1)
+    # the indexed block covers base[:8] only — drafts never entered it
+    assert engine.state_manager.match_prefix(2, base[:8] + [99]) == 8
+    assert engine.state_manager.match_prefix(
+        3, base + [30, 31, 32, 33, 99]) == 8    # nothing past block 1
+
+
+# ----------------------------------------------------- scheduler parity
+def test_spec_parity_high_acceptance(model_and_params):
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    prompts = repetitive_prompts(rng)
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=100, max_new_tokens=12)
+    sched = ContinuousBatchingScheduler(
+        make_engine(model, params), proposer=NGramProposer(ngram_max=3),
+        max_draft_tokens=4)
+    spec = greedy_generate(prompts=prompts, uid_base=100, max_new_tokens=12,
+                           scheduler=sched)
+    assert_greedy_parity(base, spec, "ngram speculation")
+    stats = sched.spec_stats()
+    assert stats["accepted"] > 0
+    # speculation must actually reduce forwards on this workload
+    assert spec_summary(stats)["tokens_per_forward"] > 1.5
+
+
+def test_spec_parity_with_mid_stream_rejections(model_and_params):
+    """Random prompts with small n-grams: proposals fire but are often
+    wrong — the stream must still be byte-identical, with KV rolled back
+    at every rejection."""
+    model, params = model_and_params
+    rng = np.random.default_rng(7)
+    # low-entropy alphabet → suffix matches (and wrong continuations) abound
+    prompts = [rng.integers(0, 6, size=20).tolist() for _ in range(4)]
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=200, max_new_tokens=16)
+    sched = ContinuousBatchingScheduler(
+        make_engine(model, params),
+        proposer=NGramProposer(ngram_max=2, ngram_min=1),
+        max_draft_tokens=4)
+    spec = greedy_generate(prompts=prompts, uid_base=200, max_new_tokens=16,
+                           scheduler=sched)
+    assert_greedy_parity(base, spec, "ngram speculation (rejections)")
+    stats = sched.spec_stats()
+    assert stats["proposed"] > 0
+    assert stats["accepted"] < stats["proposed"], (
+        f"workload produced no rejections — not exercising rollback: "
+        f"{stats}")
+
+
+def test_spec_parity_draft_model(model_and_params):
+    """Draft model == target model: every draft verifies, every forward
+    emits max_draft_tokens+1 — and the stream is still byte-identical."""
+    model, params = model_and_params
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, VOCAB, size=12).tolist() for _ in range(2)]
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=300, max_new_tokens=9)
+    draft = DraftModelProposer(make_engine(model, params))
+    sched = ContinuousBatchingScheduler(make_engine(model, params),
+                                        proposer=draft, max_draft_tokens=4)
+    spec = greedy_generate(prompts=prompts, uid_base=300, max_new_tokens=9,
+                           scheduler=sched)
+    assert_greedy_parity(base, spec, "draft-model speculation")
+    stats = sched.spec_stats()
+    assert stats["accepted"] == stats["proposed"]   # perfect draft
+    assert spec_summary(stats)["tokens_per_forward"] > 2.0
+    # draft KV is reclaimed when sequences finish (release() flushes)
+    assert draft.engine.free_blocks == draft.engine.config.kv_blocks
+
+
+def test_spec_respects_max_new_tokens_and_concurrency(model_and_params):
+    """Drafts are capped so a request never emits past max_new_tokens,
+    including when several requests run concurrently (SplitFuse-packed
+    speculative rows)."""
+    model, params = model_and_params
+    rng = np.random.default_rng(5)
+    prompts = repetitive_prompts(rng, n=4)
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=400, max_new_tokens=7)
+    sched = ContinuousBatchingScheduler(
+        make_engine(model, params), proposer=NGramProposer(),
+        max_draft_tokens=6)
+    spec = greedy_generate(prompts=prompts, uid_base=400, max_new_tokens=7,
+                           scheduler=sched, sequential=False)
+    assert_greedy_parity(base, spec, "concurrent speculation")
+    assert all(len(g) == 7 for g in spec)
+
+
+def test_spec_kv_pressure_degrades_to_plain_decode(model_and_params):
+    """When the speculative chunk cannot be admitted (KV pool exhausted),
+    the scheduler falls back to single-token decode instead of deferring
+    the sequence; generation completes with identical tokens."""
+    model, params = model_and_params
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, VOCAB, size=7).tolist()
+    base = greedy_generate(make_engine(model, params), [prompt],
+                           uid_base=500, max_new_tokens=8)
+    # 2 blocks of 8 = 16 slots; prompt 7 + 8 new = 15 fits, but a 5-token
+    # speculative chunk near the end would need a 3rd block → fallback
+    sched = ContinuousBatchingScheduler(
+        make_engine(model, params, kv_blocks=2),
+        proposer=DraftModelProposer(make_engine(model, params)),
+        max_draft_tokens=4)
+    spec = greedy_generate(prompts=[prompt], uid_base=500, max_new_tokens=8,
+                           scheduler=sched)
+    assert_greedy_parity(base, spec, "KV-pressure fallback")
+
+
+def test_spec_eos_mid_chunk(model_and_params):
+    """EOS landing inside an accepted draft run must finish the request at
+    exactly the token where plain decoding would have stopped."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    prompts = repetitive_prompts(rng, n=1)
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=600, max_new_tokens=12)
+    # pick a token the stream emits mid-way as EOS
+    eos = base[0][5]
+    base_eos = greedy_generate(make_engine(model, params), prompts,
+                               uid_base=610, max_new_tokens=12,
+                               eos_token_id=eos)
+    sched = ContinuousBatchingScheduler(
+        make_engine(model, params), proposer=NGramProposer(),
+        max_draft_tokens=4)
+    spec_eos = greedy_generate(prompts=prompts, uid_base=610,
+                               max_new_tokens=12, eos_token_id=eos,
+                               scheduler=sched)
+    assert_greedy_parity(base_eos, spec_eos, "EOS mid-chunk")
+    assert spec_eos[0][-1] == eos
+    assert sched.finished[610].finish_reason == "eos"
+
+
+def test_trim_refuses_shared_unindexed_block():
+    """Sharing is only legal through the prefix index; a trim that would
+    drop a block some other holder shares outside it must refuse (that
+    holder would be reading rolled-back KV)."""
+    mgr = tiny_manager()
+    seq = _fill(mgr, 1, list(range(12)))
+    mgr.allocator.share([seq.kv_blocks[1]])     # rogue out-of-index share
+    with pytest.raises(ValueError, match="sharing invariant"):
+        mgr.trim_sequence(1, 6)                 # would drop block 1
+    assert seq.seen_tokens == 12                # refused: nothing changed
+
+
+def test_spec_parity_draft_engine_with_prefix_cache(model_and_params):
+    """A prefix-cache-enabled DRAFT engine must not break rollback: draft
+    feeds defer the hash chain, so trimming rejected drafts never hits
+    indexed blocks — rejections included, streams identical."""
+    model, params = model_and_params
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 6, size=20).tolist() for _ in range(3)]
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=900, max_new_tokens=16)
+    draft = DraftModelProposer(make_engine(model, params, prefix=True))
+    sched = ContinuousBatchingScheduler(make_engine(model, params),
+                                        proposer=draft, max_draft_tokens=4)
+    spec = greedy_generate(prompts=prompts, uid_base=900, max_new_tokens=16,
+                           scheduler=sched)
+    assert_greedy_parity(base, spec, "prefix-cached draft engine")
+    assert not sched._proposer_warned           # no swallowed faults
+    assert not draft.engine.state_manager._index    # chain never advanced
+
+
+def test_faulty_proposer_degrades_not_crashes(model_and_params):
+    """Proposers are advisory: one that raises must cost only its drafts
+    — generation completes with the exact greedy stream."""
+    model, params = model_and_params
+
+    class Boom:
+        calls = 0
+
+        def propose(self, uid, context, k):
+            Boom.calls += 1
+            raise RuntimeError("draft engine fell over")
+
+        def release(self, uid):
+            pass
+
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, VOCAB, size=10).tolist() for _ in range(2)]
+    base = greedy_generate(make_engine(model, params), prompts,
+                           uid_base=950, max_new_tokens=6)
+    sched = ContinuousBatchingScheduler(make_engine(model, params),
+                                        proposer=Boom(), max_draft_tokens=4)
+    out = greedy_generate(prompts=prompts, uid_base=950, max_new_tokens=6,
+                          scheduler=sched)
+    assert_greedy_parity(base, out, "faulty proposer")
+    assert Boom.calls > 0 and sched._proposer_warned
+
+
+def test_custom_sampler_disables_speculation(model_and_params):
+    model, params = model_and_params
+    sched = ContinuousBatchingScheduler(
+        make_engine(model, params),
+        sample_fn=lambda logits: int(np.argmax(logits)),
+        proposer=NGramProposer())
+    assert not sched.spec_enabled               # lossless only under greedy
+    # ...and the serving layer never builds the doomed proposer at all
+    from deepspeed_tpu.serving import Replica, SpeculativeConfig
+
+    class TrapSpec(SpeculativeConfig):
+        def build_proposer(self, draft_engine_factory=None):
+            raise AssertionError("proposer built despite custom sample_fn")
+
+    rep = Replica(0, make_engine(model, params),
+                  sample_fn=lambda logits: int(np.argmax(logits)),
+                  speculative=TrapSpec(enabled=True))
+    assert not rep.scheduler.spec_enabled
+
+
+def test_cancel_mid_speculation(model_and_params):
+    """Cancel while drafts are in flight: target KV is freed immediately,
+    the proposer's per-uid state (draft-model KV included) is released."""
+    model, params = model_and_params
+    rng = np.random.default_rng(9)
+    prompts = repetitive_prompts(rng, n=2)
+    draft = DraftModelProposer(make_engine(model, params))
+    engine = make_engine(model, params)
+    sched = ContinuousBatchingScheduler(engine, proposer=draft,
+                                        max_draft_tokens=4)
+    for i, p in enumerate(prompts):
+        sched.submit(700 + i, p, max_new_tokens=16)
+    steps = 0
+    while sched.has_work and steps < 100:
+        sched.step()
+        steps += 1
+        if steps == 2:
+            assert sched.cancel(700)
+    assert sched.finished[700].finish_reason == "cancelled"
+    assert sched.finished[701].finish_reason in ("length", "eos")
+    assert 700 not in draft._fed                # proposer state released
+    assert engine.free_blocks == engine.config.kv_blocks
+    assert draft.engine.free_blocks == draft.engine.config.kv_blocks
+
+
+# ------------------------------------------------------- serving wiring
+def test_serving_config_enables_speculation(model_and_params):
+    """`serving: {speculative: {enabled: true}}` must wire a per-replica
+    proposer and surface acceptance counters in the metrics registry —
+    with generations identical to a spec-off frontend run."""
+    from deepspeed_tpu.serving import (ServingConfig, ServingFrontend,
+                                       SpeculativeConfig)
+
+    model, params = model_and_params
+    rng = np.random.default_rng(2)
+    prompt = repetitive_prompts(rng, n=1)[0]
+    base = greedy_generate(make_engine(model, params), [prompt],
+                           uid_base=800, max_new_tokens=10)
+
+    engine = make_engine(model, params)
+    cfg = ServingConfig(max_queue_depth=8,
+                        speculative=SpeculativeConfig(enabled=True,
+                                                      mode="ngram",
+                                                      max_draft_tokens=4))
+    fe = ServingFrontend([engine], cfg)
+    try:
+        replica = fe.router.replicas[0]
+        assert replica.scheduler.spec_enabled
+        assert isinstance(replica.scheduler.proposer, NGramProposer)
+        h = fe.submit(prompt, max_new_tokens=10)
+        assert h._req.wait(60)
+        tokens = [ev.token for ev in h.stream(timeout=10)]
+        assert tokens == base[0]
+        snap = fe.metrics_snapshot()
+        assert snap["spec_tokens_proposed"] > 0
+        assert snap["spec_tokens_emitted"] > snap["spec_decode_forwards"]
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_deadline_expiry_mid_speculation(model_and_params):
+    """A deadline firing while a request is mid-speculation cancels it
+    between steps; its KV (and any rejected-draft bookkeeping) is fully
+    reclaimed and other requests are unaffected."""
+    from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                       ServingFrontend, SpeculativeConfig)
+
+    model, params = model_and_params
+    rng = np.random.default_rng(4)
+    prompt = repetitive_prompts(rng, n=1)[0]
+    engine = make_engine(model, params)
+    cfg = ServingConfig(max_queue_depth=8,
+                        speculative=SpeculativeConfig(enabled=True,
+                                                      max_draft_tokens=4))
+    fe = ServingFrontend([engine], cfg)
+    try:
+        doomed = fe.submit(prompt, max_new_tokens=100, deadline_ms=150.0)
+        ok = fe.submit(prompt, max_new_tokens=6)
+        assert doomed._req.wait(60) and ok._req.wait(60)
+        assert doomed.state == RequestState.EXPIRED
+        assert ok.state == RequestState.FINISHED
+        deadline = time.monotonic() + 10
+        while engine.free_blocks != engine.config.kv_blocks \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine.free_blocks == engine.config.kv_blocks
+        assert fe.metrics_snapshot()["requests_expired"] == 1
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_speculative_config_build_proposer(model_and_params):
+    from deepspeed_tpu.serving import SpeculativeConfig
+
+    model, params = model_and_params
+    assert SpeculativeConfig().build_proposer() is None
+    p = SpeculativeConfig(enabled=True, ngram_max=5).build_proposer()
+    assert isinstance(p, NGramProposer) and p.ngram_max == 5
+    dm = SpeculativeConfig(enabled=True, mode="draft_model").build_proposer(
+        draft_engine_factory=lambda: make_engine(model, params))
+    assert isinstance(dm, DraftModelProposer)
+    with pytest.raises(ValueError, match="draft_model"):
+        SpeculativeConfig(enabled=True,
+                          mode="draft_model").build_proposer()
+    with pytest.raises(ValueError, match="unknown speculative.mode"):
+        SpeculativeConfig(enabled=True, mode="magic").build_proposer()
