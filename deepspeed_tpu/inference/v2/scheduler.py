@@ -28,6 +28,7 @@ from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
+from ...telemetry import NOOP_TRACER
 from ...utils.logging import logger
 from .engine_v2 import InferenceEngineV2
 from .scheduling_utils import SchedulingResult
@@ -52,6 +53,11 @@ class Request:
     last_logits: Optional[np.ndarray] = None
     done: bool = False
     finish_reason: Optional[str] = None
+    # telemetry (docs/OBSERVABILITY.md): set by submit() when the
+    # scheduler's tracer is enabled and the caller passed a trace id;
+    # spans holds the open prefill/decode stage spans
+    trace_id: Optional[str] = None
+    spans: Optional[Dict[str, object]] = None
 
     @property
     def prompt_remaining(self) -> int:
@@ -62,8 +68,15 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine: InferenceEngineV2,
                  sample_fn: Optional[Callable] = None,
                  proposer: Optional[DraftProposer] = None,
-                 max_draft_tokens: int = 4):
+                 max_draft_tokens: int = 4,
+                 tracer=None, trace_label: str = "scheduler"):
         self.engine = engine
+        # telemetry: per-forward spans under ``trace_label``'s trace and
+        # per-request prefill/decode stage spans (docs/OBSERVABILITY.md).
+        # The default NOOP tracer keeps the historical hot path: one
+        # ``enabled`` attribute check per step.
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.trace_label = trace_label
         self.pending: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}
         self.finished: Dict[int, Request] = {}
@@ -104,9 +117,19 @@ class ContinuousBatchingScheduler:
     def submit(self, uid: int, prompt_tokens: List[int],
                max_new_tokens: int = 64, eos_token_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
-               on_finish: Optional[Callable[[Request, str], None]] = None):
-        self.pending.append(Request(uid, list(prompt_tokens), max_new_tokens,
-                                    eos_token_id, on_token, on_finish))
+               on_finish: Optional[Callable[[Request, str], None]] = None,
+               trace_id: Optional[str] = None):
+        req = Request(uid, list(prompt_tokens), max_new_tokens,
+                      eos_token_id, on_token, on_finish)
+        if trace_id is not None and self.tracer.enabled:
+            # the prefill stage starts at scheduler submission so the
+            # request's span chain stays gap-free: any wait for a packing
+            # slot is prefill time from the request's point of view
+            req.trace_id = trace_id
+            req.spans = {"prefill": self.tracer.begin(
+                "prefill", trace_id=trace_id,
+                attrs={"prompt_tokens": len(req.prompt_tokens)})}
+        self.pending.append(req)
 
     def cancel(self, uid: int) -> bool:
         """Abort a request wherever it is; frees its KV blocks immediately
@@ -125,6 +148,7 @@ class ContinuousBatchingScheduler:
         self.engine.flush(uid)
         if self.proposer is not None:       # drop draft state mid-speculation
             self.proposer.release(uid)
+        self._end_request_spans(req, "cancelled")
         req.done = True
         req.finish_reason = "cancelled"
         self.finished[uid] = req
@@ -167,6 +191,11 @@ class ContinuousBatchingScheduler:
                     req.uid, req.prompt_tokens)
                 if req.prefix_matched > 0:
                     req.prompt_fed = req.prefix_matched
+                if req.spans is not None:
+                    # cache outcome as a span attribute — the "where did
+                    # this TTFT go" answer includes what was skipped
+                    req.spans["prefill"].set("prefix_matched_tokens",
+                                             req.prefix_matched)
 
         def admit(req, chunk) -> bool:
             ok = self.engine.can_schedule(uids + [req.uid],
@@ -245,6 +274,30 @@ class ContinuousBatchingScheduler:
                                "affected steps")
             return []
 
+    # ----------------------------------------------------------- telemetry
+    def _note_first_token(self, req: Request) -> None:
+        """Request-trace stage transition at the first emitted token:
+        prefill ends (this instant IS the TTFT endpoint) and the decode
+        stage opens."""
+        if req.spans is None:
+            return
+        sp = req.spans.pop("prefill", None)
+        if sp is not None:
+            sp.end()
+        req.spans["decode"] = self.tracer.begin("decode",
+                                                trace_id=req.trace_id)
+
+    def _end_request_spans(self, req: Request, reason: str) -> None:
+        if req.spans is None:
+            return
+        dec = req.spans.get("decode")
+        if dec is not None:
+            dec.set("generated", len(req.generated))
+            dec.set("finish_reason", reason)
+        for sp in req.spans.values():
+            sp.end()
+        req.spans = None
+
     def step(self) -> List[int]:
         """One engine forward; returns uids of requests finished this step."""
         uids, chunks, plan = self._pack()
@@ -256,10 +309,21 @@ class ContinuousBatchingScheduler:
         # take the exact historical path.
         spec_w = max((len(c) for _, c, d in plan if d and len(c) > 1),
                      default=0)
+        # per-forward telemetry span (replica-level trace): brackets the
+        # device call including host materialization of the logits
+        traced = self.tracer.enabled
+        fspan = self.tracer.begin(
+            "forward", trace_id=self.trace_label,
+            attrs={"n_seqs": len(uids),
+                   "n_tokens": int(sum(len(c) for c in chunks))}) \
+            if traced else None
         if self.proposer is None or spec_w == 0:
             logits = np.asarray(self.engine.put(uids, chunks))
+            vspan = None
         else:
             W = self.engine.batch._bucket(spec_w, self._chunk)
+            if traced:
+                fspan.set("verify_width", W)
             # speculative step: right-aligned trailing-position logits for
             # verification; the prefix-cache hash chain is committed
             # per-row below, once rejected drafts have been trimmed (the
@@ -267,12 +331,21 @@ class ContinuousBatchingScheduler:
             logits = np.asarray(self.engine.put(uids, chunks,
                                                 verify_width=W,
                                                 defer_commit=True))
+            # host-side verify/trim/commit of this step, as its own span
+            vspan = self.tracer.begin("spec_verify",
+                                      trace_id=self.trace_label,
+                                      attrs={"verify_width": W}) \
+                if traced else None
+        if traced:
+            fspan.end()
         done_now = []
         # commit state only after the forward succeeded
         for i, (req, chunk, is_decode) in enumerate(plan):
             if self.proposer is None or spec_w == 0:
                 req.last_logits = logits[i]
                 if is_decode:
+                    if not req.generated:
+                        self._note_first_token(req)
                     req.generated.append(chunk[0])
                     self._spec_stats["decode_rows"] += 1
                     self._spec_stats["emitted"] += 1
@@ -298,6 +371,7 @@ class ContinuousBatchingScheduler:
             if len(req.generated) >= req.max_new_tokens or ended:
                 req.done = True
                 req.finish_reason = "eos" if ended else "length"
+                self._end_request_spans(req, req.finish_reason)
                 self.finished[req.uid] = req
                 self.running.pop(req.uid, None)
                 self.engine.flush(req.uid)
@@ -306,6 +380,8 @@ class ContinuousBatchingScheduler:
                 done_now.append(req.uid)
                 if req.on_finish is not None:
                     req.on_finish(req, req.finish_reason)
+        if vspan is not None:
+            vspan.end()
         return done_now
 
     def _apply_verified(self, req: Request, chunk: List[int],
@@ -331,6 +407,16 @@ class ContinuousBatchingScheduler:
         self._spec_stats["decode_rows"] += 1
         self._spec_stats["proposed"] += len(chunk) - 1
         self._spec_stats["accepted"] += len(emitted) - 1
+        if not req.generated and emitted:
+            self._note_first_token(req)
+        if req.spans is not None:
+            # accumulate this request's speculation outcome on its decode
+            # span — "how many of MY tokens came from accepted drafts"
+            dec = req.spans.get("decode")
+            if dec is not None:
+                a = dec.attrs
+                a["spec_proposed"] = a.get("spec_proposed", 0) + len(chunk) - 1
+                a["spec_accepted"] = a.get("spec_accepted", 0) + len(emitted) - 1
         for t in emitted:
             req.generated.append(t)
             self._spec_stats["emitted"] += 1

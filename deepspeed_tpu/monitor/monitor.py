@@ -12,6 +12,8 @@ import csv
 import os
 from typing import List, Tuple
 
+from ..utils.logging import logger
+
 Event = Tuple[str, float, int]
 
 
@@ -71,18 +73,27 @@ class MonitorMaster(Monitor):
         self.backends: List[Monitor] = []
         if not self.enabled:
             return
-        try:
-            if config.csv_monitor.enabled:
-                self.backends.append(CSVMonitor(config.csv_monitor.output_path,
-                                                config.csv_monitor.job_name))
-            if config.tensorboard.enabled:
-                self.backends.append(TensorBoardMonitor(config.tensorboard.output_path,
-                                                        config.tensorboard.job_name))
-            if config.wandb.enabled:
-                self.backends.append(WandbMonitor(config.wandb.project,
-                                                  config.wandb.group, config.wandb.team))
-        except Exception:
-            pass
+        # per-backend isolation: one backend failing to come up (missing
+        # wandb, unwritable tensorboard dir, ...) must not silently take
+        # the others down with it — warn with the backend's name and keep
+        # going (regression-tested in tests/test_telemetry.py)
+        builders = []
+        if config.csv_monitor.enabled:
+            builders.append(("csv_monitor", lambda: CSVMonitor(
+                config.csv_monitor.output_path, config.csv_monitor.job_name)))
+        if config.tensorboard.enabled:
+            builders.append(("tensorboard", lambda: TensorBoardMonitor(
+                config.tensorboard.output_path, config.tensorboard.job_name)))
+        if config.wandb.enabled:
+            builders.append(("wandb", lambda: WandbMonitor(
+                config.wandb.project, config.wandb.group, config.wandb.team)))
+        for name, build in builders:
+            try:
+                self.backends.append(build())
+            except Exception as e:
+                logger.warning(f"monitor backend '{name}' failed to "
+                               f"initialize ({e!r}); continuing with the "
+                               "remaining backends")
 
     def write_events(self, events: List[Event]):
         for b in self.backends:

@@ -22,6 +22,7 @@ from pydantic import Field, model_validator
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
 from ..serving.config import (PrefixCacheConfig, ServingConfig,
                               SpeculativeConfig)
+from ..telemetry.config import TelemetryConfig
 from ..utils.logging import logger
 
 # ----------------------------------------------------------------- defaults
@@ -347,6 +348,9 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # speculative decoding for the v2 ragged engine (docs/SERVING.md
     # "Speculative decoding"); also reachable as ``serving.speculative``
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
+    # unified telemetry (docs/OBSERVABILITY.md): training step spans here;
+    # serving request tracing via ``serving.telemetry``
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 1234

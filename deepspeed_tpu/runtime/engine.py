@@ -41,7 +41,8 @@ from ..ops.optimizers import OptimizerState, build_optimizer, FusedAdam
 from ..parallel import topology as topo
 from ..parallel.sharding import ZeroShardingPlan
 from ..utils.logging import logger, log_dist
-from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from ..utils.timer import (FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER,
+                           SynchronizedWallClockTimer, ThroughputTimer)
 from .config import DeepSpeedTpuConfig, DtypeEnum, load_config
 from .lr_schedules import LRSchedulerShim, build_schedule
 from .dataloader import DeepSpeedTpuDataLoader
@@ -240,8 +241,18 @@ class DeepSpeedTpuEngine:
         self.timers = SynchronizedWallClockTimer(sync_fn=self._sync)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
-            steps_per_output=self.config.steps_per_print)
+            steps_per_output=self.config.steps_per_print,
+            monitor_memory=self.config.memory_breakdown)
         self.monitor = self._build_monitor()
+        # step profiling (docs/OBSERVABILITY.md "Step profiling"):
+        # wall_clock_breakdown (reference engine.py flag) or an enabled
+        # telemetry block brackets fwd+bwd and the optimizer step with
+        # synchronized timers — a block_until_ready per bracket, so real
+        # device time is measured, at a small throughput cost — and
+        # records matching spans on the tracer ("train" trace).
+        self.tracer = self.config.telemetry.build_tracer()
+        self._profile_steps = bool(self.config.wall_clock_breakdown
+                                   or self.config.telemetry.enabled)
 
         log_dist(
             f"DeepSpeedTpuEngine ready: mesh={dict(self.mesh.shape)} "
@@ -847,10 +858,25 @@ class DeepSpeedTpuEngine:
         """
         self.tput_timer.start()
         batch = self._device_batch(batch) if not self._is_device_batch(batch) else batch
+        if self.tput_timer.flops_per_sample is None:
+            self._autofill_flops_per_sample(batch)
         step_rng = jax.random.fold_in(self._rng, self.micro_steps)
         if not self._layouts_tuned:
             self._autotune_layouts(batch, step_rng)
-        self.state, loss = self._micro_fn(self.state, batch, step_rng)
+        if self._profile_steps:
+            # synchronized bracket: start() waits out pending device work,
+            # stop() blocks until this micro step's fwd+bwd really ran (the
+            # two are one fused program — they cannot be timed separately
+            # from the host; docs/OBSERVABILITY.md)
+            fwd_timer = self.timers(FORWARD_MICRO_TIMER)
+            fwd_timer.start()
+            span = self.tracer.begin("fwd_bwd", trace_id="train",
+                                     attrs={"micro_step": self.micro_steps})
+            self.state, loss = self._micro_fn(self.state, batch, step_rng)
+            fwd_timer.stop(record=True)
+            span.end()
+        else:
+            self.state, loss = self._micro_fn(self.state, batch, step_rng)
         self._pending_loss = loss
         if self.config.check_numerics and not self.fp16_enabled \
                 and not np.isfinite(float(loss)):
@@ -863,6 +889,22 @@ class DeepSpeedTpuEngine:
                 f"step {self.micro_steps}; offending state leaves: "
                 f"{self._numerics_scan()}")
         return loss
+
+    def _autofill_flops_per_sample(self, batch):
+        """Feed :class:`ThroughputTimer` its per-sample FLOPs from the
+        flops profiler's analytic counting (profiling/flops_profiler.py)
+        so samples/sec reports come with a TFLOPS estimate without the
+        user wiring anything. Non-CausalLM modules (no analytic model)
+        set 0.0 — tflops() then stays silent — and never retry."""
+        if not isinstance(self.module, CausalLM) \
+                or not isinstance(batch, dict) or "input_ids" not in batch:
+            self.tput_timer.flops_per_sample = 0.0
+            return
+        from ..profiling.flops_profiler import train_step_flops
+
+        seq = max(1, int(batch["input_ids"].shape[-1]) - 1)
+        self.tput_timer.flops_per_sample = float(
+            train_step_flops(self.module.cfg, 1, seq))
 
     def _numerics_scan(self):
         """Per-leaf finiteness scan of params + accumulated grads; returns
@@ -904,6 +946,12 @@ class DeepSpeedTpuEngine:
         pre_scan = (self._numerics_scan()
                     if self.config.check_numerics and not self.fp16_enabled
                     else None)
+        if self._profile_steps:
+            step_timer = self.timers(STEP_GLOBAL_TIMER)
+            step_timer.start()
+            opt_span = self.tracer.begin(
+                "optimizer_step", trace_id="train",
+                attrs={"global_step": self.global_steps})
         if self._offload_plan is not None:
             metrics = self._offload_step()
         elif self._onebit and self.global_steps < self.opt.freeze_step:
@@ -912,6 +960,11 @@ class DeepSpeedTpuEngine:
             self.state, metrics = self._update_warm_fn(self.state)
         else:
             self.state, metrics = self._update_fn(self.state)
+        if self._profile_steps:
+            step_timer.stop(record=True)   # synced: real update duration
+            opt_span.set("skipped",
+                         bool(np.asarray(metrics.get("overflow", False)))) \
+                    .end()
         if pre_scan is not None \
                 and not np.isfinite(float(metrics.get("grad_norm", 0.0))):
             # under fp16 the dynamic-loss-scale automaton owns overflow
@@ -931,10 +984,33 @@ class DeepSpeedTpuEngine:
                 f"step={self.global_steps} loss={float(self._pending_loss):.4f} "
                 f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.3f} "
                 f"loss_scale={m['loss_scale']:.0f}", ranks=[0])
+            events = [
+                ("Train/loss", float(self._pending_loss), self.global_steps),
+                ("Train/lr", m["lr"], self.global_steps)]
+            if self._profile_steps:
+                # per-global-step wall-clock breakdown over the window
+                # since the last report (fwd_microstep accumulates gas
+                # micro steps per global step): the "what fraction of a
+                # step is fwd+bwd vs optimizer" numbers, through the same
+                # monitor fan-out as the loss curves
+                names = [n for n in (FORWARD_MICRO_TIMER, STEP_GLOBAL_TIMER)
+                         if self.timers.has(n)]
+                means = self.timers.log(
+                    names, normalizer=self.config.steps_per_print)
+                events += [(f"Train/timer/{k}_ms", v, self.global_steps)
+                           for k, v in means.items()]
+            events.append(("Train/samples_per_sec",
+                           self.tput_timer.avg_samples_per_sec(),
+                           self.global_steps))
+            if self.tput_timer.flops_per_sample:
+                events.append(("Train/tflops", self.tput_timer.tflops(),
+                               self.global_steps))
+            if self.tput_timer.memory_bytes is not None:
+                events.append(("Train/device_mem_gib",
+                               self.tput_timer.memory_bytes / 2**30,
+                               self.global_steps))
             if self.monitor is not None:
-                self.monitor.write_events([
-                    ("Train/loss", float(self._pending_loss), self.global_steps),
-                    ("Train/lr", m["lr"], self.global_steps)])
+                self.monitor.write_events(events)
         return metrics
 
     def _offload_step(self):

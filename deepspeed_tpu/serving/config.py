@@ -12,6 +12,7 @@ from typing import List, Optional
 from pydantic import Field
 
 from ..runtime.config_utils import DSConfigModel
+from ..telemetry.config import TelemetryConfig
 
 
 class PrefixCacheConfig(DSConfigModel):
@@ -101,3 +102,6 @@ class ServingConfig(DSConfigModel):
     prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
     # speculative decoding (scheduler-level; applied per replica)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
+    # unified telemetry: request tracing + flight recorder
+    # (docs/OBSERVABILITY.md); disabled = the no-op tracer
+    telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional, Sequence
 
+from ..telemetry import FlightRecorder
 from .config import ServingConfig
 from .metrics import MetricsRegistry, serving_metrics
 from .queue import AdmissionQueue
@@ -40,6 +41,14 @@ class ServingFrontend:
             raise ValueError("ServingFrontend needs at least one engine")
         self.config = config or ServingConfig()
         self.metrics = metrics or serving_metrics()
+        # telemetry (docs/OBSERVABILITY.md): one tracer for the whole
+        # frontend — request stage spans begin here at submit, the
+        # router/replicas/scheduler continue the chain — plus a flight
+        # recorder over it. Both are no-ops when ``telemetry.enabled`` is
+        # false; debug_dump() still works (metrics only, no spans).
+        self.tracer = self.config.telemetry.build_tracer()
+        self.recorder = self.config.telemetry.build_recorder(
+            self.tracer, metrics=self.metrics)
         if self.config.ttft_buckets_s:
             self.metrics.histogram("ttft_s", self.config.ttft_buckets_s,
                                    reset=True)
@@ -60,11 +69,16 @@ class ServingFrontend:
         # its own proposer from the block (draft state is per-engine)
         spec = (self.config.speculative
                 if self.config.speculative.enabled else None)
+        recorder = (self.recorder
+                    if self.config.telemetry.dump_on_error else None)
         replicas = [Replica(i, eng, self.metrics, sample_fn,
                             wedge_timeout_s=self.config.wedge_timeout_s,
-                            speculative=spec)
+                            speculative=spec, tracer=self.tracer,
+                            recorder=recorder)
                     for i, eng in enumerate(engines)]
-        self.router = ReplicaRouter(replicas, self.admission, self.metrics)
+        self.router = ReplicaRouter(replicas, self.admission, self.metrics,
+                                    tracer=self.tracer,
+                                    recorder=self.recorder)
         self._closed = False
         self.router.start()
 
@@ -105,6 +119,17 @@ class ServingFrontend:
             else cfg.default_max_new_tokens,
             priority, deadline_ms / 1e3 if deadline_ms is not None else None,
             eos_token_id)
+        if self.tracer.enabled:
+            # root of this request's trace + the first stage (queue wait).
+            # Rejection paths below close both via req.finish.
+            req.trace_id = f"req-{req.uid}"
+            req.spans = {"request": self.tracer.begin(
+                "request", trace_id=req.trace_id,
+                attrs={"uid": req.uid,
+                       "prompt_tokens": len(req.prompt_tokens),
+                       "max_new_tokens": req.max_new_tokens,
+                       "priority": req.priority})}
+            req.begin_span(self.tracer, "queue")
         max_len = min(r.engine.model.cfg.max_seq_len
                       for r in self.router.replicas)
         if len(req.prompt_tokens) + req.max_new_tokens > max_len:
@@ -153,6 +178,21 @@ class ServingFrontend:
         """Fan the registry out through a monitor/ backend (MonitorMaster,
         CSVMonitor, ...)."""
         self.metrics.publish(monitor, step)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of the serving registry — hand this
+        to whatever scrapes/serves /metrics (docs/OBSERVABILITY.md)."""
+        return self.metrics.render_prometheus()
+
+    # ------------------------------------------------------------ telemetry
+    def debug_dump(self, dump_dir: Optional[str] = None) -> dict:
+        """On-demand flight-recorder dump: recent spans (open ones
+        included) + metric snapshots, written as raw JSON and Chrome
+        ``trace_event`` JSON (chrome://tracing / Perfetto). Returns
+        ``{"json": path, "chrome_trace": path}``. Works with telemetry
+        disabled too (metrics only; the span list is empty)."""
+        self.recorder.snapshot_metrics()
+        return self.recorder.dump(dump_dir=dump_dir, reason="debug")
 
     # ------------------------------------------------------------ shutdown
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:

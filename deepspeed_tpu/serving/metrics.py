@@ -12,6 +12,7 @@ within the winning bucket.
 from __future__ import annotations
 
 import math
+import re
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -98,12 +99,16 @@ class Histogram:
 
     def percentile(self, q: float) -> float:
         """Estimated q-th percentile (q in [0, 100]) from the buckets.
-        Linear interpolation inside the winning bucket; the overflow
-        bucket reports its lower bound (the estimate is then a floor)."""
+        Linear interpolation inside the winning bucket. Over-range
+        samples land in the +Inf overflow bucket, which has no finite
+        upper bound to interpolate toward — the estimate CLAMPS to the
+        largest finite bucket bound (a documented floor) instead of
+        reporting +Inf/garbage; size the bucket list so real tails stay
+        inside it."""
         with self._lock:
             counts = list(self._counts)
             total = self._count
-        if total == 0:
+        if total == 0 or not self.bounds:
             return 0.0
         rank = max(1.0, math.ceil(q / 100.0 * total))
         seen = 0
@@ -111,14 +116,21 @@ class Histogram:
             if c == 0:
                 continue
             if seen + c >= rank:
-                if i >= len(self.bounds):       # overflow bucket
-                    return self.bounds[-1] if self.bounds else 0.0
+                if i >= len(self.bounds):       # overflow: clamp, never Inf
+                    return self.bounds[-1]
                 lo = self.bounds[i - 1] if i > 0 else 0.0
                 hi = self.bounds[i]
                 frac = (rank - seen) / c
                 return lo + (hi - lo) * frac
             seen += c
-        return self.bounds[-1] if self.bounds else 0.0
+        return self.bounds[-1]
+
+    def buckets_snapshot(self) -> Tuple[Tuple[float, ...], List[int],
+                                        float, int]:
+        """Consistent (bounds, per-bucket counts incl. the +Inf overflow,
+        sum, count) — the raw material for Prometheus exposition."""
+        with self._lock:
+            return self.bounds, list(self._counts), self._sum, self._count
 
     def snapshot(self) -> Dict[str, float]:
         return {"count": float(self._count), "sum": self._sum,
@@ -193,6 +205,56 @@ class MetricsRegistry:
 
     def publish(self, monitor, step: int = 0) -> None:
         monitor.write_events(self.events(step))
+
+    # ---------------------------------------------------------- prometheus
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        return re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+    @staticmethod
+    def _prom_num(v: float) -> str:
+        v = float(v)
+        if v == math.inf:
+            return "+Inf"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of the whole
+        registry: counters and gauges as single samples, histograms as
+        cumulative ``_bucket{le=...}`` series plus ``_sum``/``_count`` —
+        what a /metrics endpoint (or a textfile collector) serves so the
+        serving numbers land in existing dashboards
+        (docs/OBSERVABILITY.md "Prometheus names")."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        p = self._prom_name(self.prefix + "_" if self.prefix else "")
+        lines: List[str] = []
+        for name, c in sorted(counters.items()):
+            m = p + self._prom_name(name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {self._prom_num(c.value)}")
+        for name, g in sorted(gauges.items()):
+            m = p + self._prom_name(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {self._prom_num(g.value)}")
+        for name, h in sorted(hists.items()):
+            m = p + self._prom_name(name)
+            bounds, counts, total_sum, total_count = h.buckets_snapshot()
+            lines.append(f"# TYPE {m} histogram")
+            cum = 0
+            for bound, cnt in zip(bounds, counts):
+                cum += cnt
+                lines.append(
+                    f'{m}_bucket{{le="{self._prom_num(bound)}"}} {cum}')
+            cum += counts[-1] if len(counts) > len(bounds) else 0
+            lines.append(f'{m}_bucket{{le="+Inf"}} {cum}')
+            lines.append(f"{m}_sum {self._prom_num(total_sum)}")
+            lines.append(f"{m}_count {total_count}")
+        return "\n".join(lines) + "\n"
 
 
 def serving_metrics() -> MetricsRegistry:

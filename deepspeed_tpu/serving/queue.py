@@ -98,6 +98,9 @@ class AdmissionQueue:
                         continue
                     self._note_depth()
                     req.admitted_t = now
+                    # request trace: the queue stage ends here (expired/
+                    # cancelled pops close their spans via req.finish)
+                    req.end_span("queue")
                     if self.metrics is not None:
                         self.metrics.histogram("queue_wait_s").observe(
                             now - req.arrival_t)

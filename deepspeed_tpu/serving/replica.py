@@ -42,10 +42,17 @@ class Replica:
                  sample_fn: Optional[Callable] = None,
                  wedge_timeout_s: float = 300.0,
                  idle_wait_s: float = 0.005,
-                 speculative=None):
+                 speculative=None, tracer=None, recorder=None):
+        from ..telemetry import NOOP_TRACER
+
         self.replica_id = replica_id
         self.engine = engine
         self.metrics = metrics
+        # telemetry (docs/OBSERVABILITY.md): request-trace stage spans +
+        # per-forward spans (via the scheduler) and a flight-recorder
+        # dump when this replica dies; both default to no-ops
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.recorder = recorder
         # speculative decoding (docs/SERVING.md): each replica builds its
         # OWN proposer — draft state (n-gram none, draft-model KV) is tied
         # to this replica's sequences. A custom sampler makes the
@@ -67,7 +74,8 @@ class Replica:
                       if speculative is not None else 4)
         self.scheduler = ContinuousBatchingScheduler(
             engine, sample_fn, proposer=proposer,
-            max_draft_tokens=max_drafts)
+            max_draft_tokens=max_drafts, tracer=self.tracer,
+            trace_label=f"replica-{replica_id}")
         self.wedge_timeout_s = wedge_timeout_s
         self.idle_wait_s = idle_wait_s
         self.state = ReplicaState.HEALTHY
@@ -122,6 +130,12 @@ class Replica:
         with self._lock:
             self._outstanding += req.outstanding_tokens
         req.replica_id = self.replica_id
+        # trace stages: routing ends at the hand-off; "admit" covers the
+        # inbox wait until the worker loop submits to the scheduler
+        if req.spans is not None:
+            req.end_span("route")
+            req.begin_span(self.tracer, "admit",
+                           attrs={"replica": self.replica_id})
         self._inbox.put(req)
         return True
 
@@ -208,10 +222,12 @@ class Replica:
                 continue
             req.state = RequestState.RUNNING
             self._active[req.uid] = req
+            req.end_span("admit")
             self.scheduler.submit(
                 req.uid, req.prompt_tokens, req.max_new_tokens,
                 req.eos_token_id,
-                on_token=self._on_token, on_finish=self._on_finish)
+                on_token=self._on_token, on_finish=self._on_finish,
+                trace_id=req.trace_id)
 
     def _on_token(self, uid: int, token: int) -> None:
         req = self._active.get(uid)
@@ -322,6 +338,10 @@ class Replica:
                 self.last_progress_t = time.monotonic()
             except Exception as e:  # engine/scheduler fault → DEAD replica
                 logger.error(f"serving replica {self.replica_id} died: {e!r}")
+                if self.recorder is not None:
+                    # flight-recorder dump while the evidence (recent
+                    # spans, in-flight work, metric snapshots) is hot
+                    self.recorder.on_error(f"replica-{self.replica_id}", e)
                 self.state = ReplicaState.DEAD
                 for req in list(self._active.values()):
                     self._fail_request(req, FinishReason.ERROR,

@@ -105,6 +105,11 @@ class ServingRequest:
         self.n_generated = 0
         self._events: "queue.Queue[StreamEvent]" = queue.Queue()
         self._done = threading.Event()
+        # telemetry (docs/OBSERVABILITY.md): the frontend sets both when
+        # its tracer is enabled; None otherwise so disabled telemetry
+        # allocates nothing per request
+        self.trace_id: Optional[str] = None
+        self.spans: Optional[dict] = None
 
     # ------------------------------------------------------------- ordering
     @property
@@ -127,6 +132,25 @@ class ServingRequest:
         return max(0, len(self.prompt_tokens) + self.max_new_tokens
                    - self.n_generated)
 
+    # ------------------------------------------------------------ telemetry
+    def begin_span(self, tracer, name: str, attrs: Optional[dict] = None):
+        """Open the next stage span of this request's trace (no-op when
+        telemetry was off at submit). Parented under the root ``request``
+        span; stages end their predecessor explicitly, and ``finish``
+        closes whatever stage the request died in (``end`` is
+        idempotent)."""
+        if self.spans is None:
+            return None
+        sp = tracer.begin(name, trace_id=self.trace_id,
+                          parent=self.spans.get("request"), attrs=attrs)
+        self.spans[name] = sp
+        return sp
+
+    def end_span(self, name: str) -> None:
+        sp = self.spans.get(name) if self.spans is not None else None
+        if sp is not None:
+            sp.end()
+
     # ------------------------------------------------------------ streaming
     def push_token(self, token: int) -> None:
         now = time.monotonic()
@@ -143,6 +167,16 @@ class ServingRequest:
         self.state = state
         self.finish_reason = reason
         self.finished_t = time.monotonic()
+        if self.spans is not None:
+            # terminal close-out: stamp the outcome on the root span and
+            # end every stage still open (whichever stage the request
+            # died in — end() is idempotent for stages already closed)
+            root = self.spans.get("request")
+            if root is not None:
+                root.set("state", state.value).set("finish_reason", reason)
+                root.set("generated", self.n_generated)
+            for sp in self.spans.values():
+                sp.end()
         self._events.put(DoneEvent(self.uid, reason, self.finished_t))
         self._done.set()
 

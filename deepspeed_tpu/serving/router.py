@@ -28,12 +28,19 @@ from .request import FinishReason, RequestState, ServingRequest
 class ReplicaRouter:
     def __init__(self, replicas: List[Replica], admission: AdmissionQueue,
                  metrics: Optional[MetricsRegistry] = None,
-                 poll_interval_s: float = 0.05):
+                 poll_interval_s: float = 0.05,
+                 tracer=None, recorder=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
+        from ..telemetry import NOOP_TRACER
+
         self.replicas = list(replicas)
         self.admission = admission
         self.metrics = metrics
+        # request tracing + periodic flight-recorder metric snapshots
+        # (docs/OBSERVABILITY.md); both default to no-ops
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.recorder = recorder
         self.poll_interval_s = poll_interval_s
         self._stop = threading.Event()
         self.thread = threading.Thread(target=self._loop, daemon=True,
@@ -80,6 +87,9 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, req: ServingRequest) -> None:
+        # trace stage: routing (replica selection + any wait for a free
+        # slot); ended by Replica.assign, or by req.finish on failure
+        req.begin_span(self.tracer, "route")
         while not self._stop.is_set():
             if not self._any_accepting():
                 logger.warning(f"serving request {req.uid}: no healthy "
@@ -108,6 +118,8 @@ class ReplicaRouter:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            if self.recorder is not None:
+                self.recorder.maybe_snapshot()
             if self.pick() is None:
                 # no free slot anywhere: leave the backlog in the
                 # admission queue (priority/deadline order) rather than

@@ -1,0 +1,25 @@
+"""Unified telemetry: tracing core, flight recorder, config block.
+
+One subsystem behind the framework's three observability surfaces
+(docs/OBSERVABILITY.md):
+
+- **request tracing** — every serving request carries a span chain
+  queue→route→admit→prefill→decode→finish (serving/, inference/v2/);
+- **step profiling** — training fwd+bwd / optimizer brackets in
+  runtime/engine.py, published through monitor/;
+- **flight recorder** — a bounded ring of recent spans + metric
+  snapshots, dumped as raw JSON and Chrome ``trace_event`` JSON on
+  demand and on replica/scheduler errors.
+
+Importable without JAX: the tracer is pure stdlib; the optional
+``jax.profiler.TraceAnnotation`` pass-through imports lazily.
+"""
+
+from .config import TelemetryConfig  # noqa: F401
+from .flight_recorder import FlightRecorder  # noqa: F401
+from .tracer import (NOOP_SPAN, NOOP_TRACER, Span, Tracer,  # noqa: F401
+                     chrome_trace, trace_coverage, validate_chrome_trace)
+
+__all__ = ["Tracer", "Span", "NOOP_TRACER", "NOOP_SPAN", "TelemetryConfig",
+           "FlightRecorder", "chrome_trace", "validate_chrome_trace",
+           "trace_coverage"]

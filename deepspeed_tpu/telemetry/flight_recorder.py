@@ -1,0 +1,147 @@
+"""Flight recorder: a crash-dump surface over the tracer's span ring.
+
+Serving incidents are diagnosed after the fact — "why did TTFT spike at
+14:03?", "what was in flight when replica 2 died?" — so the recorder
+keeps the *recent past* resident (the tracer's bounded span ring plus a
+small ring of metric-registry snapshots) and writes it out on demand
+(:meth:`ServingFrontend.debug_dump`), and automatically on unhandled
+scheduler/replica errors. Two formats per dump: the raw JSON record
+(machine-greppable) and Chrome ``trace_event`` JSON loadable in
+``chrome://tracing`` / Perfetto (docs/OBSERVABILITY.md walks through
+opening one).
+
+Error dumps are rate-limited (a dying fleet must not fill the disk) and
+the dump path itself is exception-proof — telemetry must never turn a
+degraded service into a dead one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .tracer import Tracer, chrome_trace
+
+
+class FlightRecorder:
+    def __init__(self, tracer: Tracer, max_snapshots: int = 32,
+                 dump_dir: Optional[str] = None, max_error_dumps: int = 3,
+                 error_dump_window_s: float = 3600.0):
+        self.tracer = tracer
+        self.dump_dir = dump_dir
+        # error dumps are limited to max_error_dumps per sliding window
+        # (NOT per lifetime — a long-running service must still capture
+        # next week's incident after this week's burned a few slots)
+        self.max_error_dumps = int(max_error_dumps)
+        self.error_dump_window_s = float(error_dump_window_s)
+        self._providers: List[tuple] = []       # (name, fn() -> dict)
+        self._snapshots: "deque[Dict[str, Any]]" = deque(maxlen=max_snapshots)
+        self._lock = threading.Lock()
+        self._last_snapshot_t = 0.0
+        self._dump_seq = 0
+        self._error_dump_times: "deque[float]" = deque()
+
+    def add_metrics_provider(self, name: str,
+                             fn: Callable[[], dict]) -> None:
+        """Register a snapshot source (e.g. ``MetricsRegistry.snapshot``);
+        called at snapshot time, guarded — a raising provider is skipped."""
+        self._providers.append((name, fn))
+
+    # ------------------------------------------------------------ snapshots
+    def snapshot_metrics(self) -> None:
+        snap: Dict[str, Any] = {"t": self.tracer.clock(),
+                                "wall_time": time.time()}
+        for name, fn in self._providers:
+            try:
+                snap[name] = fn()
+            except Exception as e:
+                snap[name] = {"error": repr(e)}
+        with self._lock:
+            self._snapshots.append(snap)
+            self._last_snapshot_t = snap["t"]
+
+    def maybe_snapshot(self, interval_s: float = 1.0) -> None:
+        """Periodic-snapshot hook for polling loops (the serving router
+        calls this each iteration); cheap no-op when disabled or within
+        the interval."""
+        if not self.tracer.enabled:
+            return
+        if self.tracer.clock() - self._last_snapshot_t >= interval_s:
+            self.snapshot_metrics()
+
+    # ---------------------------------------------------------------- dumps
+    def record(self) -> Dict[str, Any]:
+        """The in-memory flight record: recent spans (open ones included)
+        + metric snapshots + provenance."""
+        with self._lock:
+            snapshots = list(self._snapshots)
+        return {
+            "format": "deepspeed_tpu.flight_recorder.v1",
+            "wall_time": time.time(),
+            "monotonic_time": self.tracer.clock(),
+            "telemetry_enabled": self.tracer.enabled,
+            "spans": self.tracer.export(include_open=True),
+            "metric_snapshots": snapshots,
+        }
+
+    def _resolve_dir(self, dump_dir: Optional[str]) -> str:
+        d = dump_dir or self.dump_dir or os.path.join(
+            tempfile.gettempdir(), "deepspeed_tpu_telemetry")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def dump(self, dump_dir: Optional[str] = None,
+             reason: str = "on_demand") -> Dict[str, str]:
+        """Write the flight record as ``flightrec_*.json`` (raw) and
+        ``trace_*.json`` (Chrome trace). Returns the two paths."""
+        d = self._resolve_dir(dump_dir)
+        with self._lock:
+            self._dump_seq += 1
+            seq = self._dump_seq
+        tag = f"{seq:03d}_{reason}_{os.getpid()}"
+        record = self.record()
+        record["reason"] = reason
+        raw_path = os.path.join(d, f"flightrec_{tag}.json")
+        with open(raw_path, "w") as fh:
+            json.dump(record, fh, indent=1, default=str)
+        trace_path = os.path.join(d, f"trace_{tag}.json")
+        with open(trace_path, "w") as fh:
+            json.dump(chrome_trace(record["spans"],
+                                   meta={"reason": reason,
+                                         "wall_time": record["wall_time"]}),
+                      fh, default=str)
+        return {"json": raw_path, "chrome_trace": trace_path}
+
+    def on_error(self, where: str, exc: BaseException) -> Optional[Dict[str, str]]:
+        """Crash hook for replica/scheduler error paths: best-effort dump,
+        rate-limited to ``max_error_dumps`` per ``error_dump_window_s``
+        (a dying fleet must not fill the disk, but a long-lived service
+        keeps capturing later incidents), never raises (the caller is
+        already handling a fault)."""
+        if not self.tracer.enabled:
+            return None
+        now = self.tracer.clock()
+        with self._lock:
+            while self._error_dump_times and \
+                    now - self._error_dump_times[0] > self.error_dump_window_s:
+                self._error_dump_times.popleft()
+            if len(self._error_dump_times) >= self.max_error_dumps:
+                return None
+            self._error_dump_times.append(now)
+        try:
+            self.snapshot_metrics()
+            paths = self.dump(reason=f"error_{where}")
+            logger.warning(
+                f"telemetry: flight-recorder dump for error in {where} "
+                f"({type(exc).__name__}: {exc}) -> {paths['json']}")
+            return paths
+        except Exception as dump_exc:  # pragma: no cover - defensive
+            logger.warning(f"telemetry: flight-recorder dump failed: "
+                           f"{dump_exc!r}")
+            return None

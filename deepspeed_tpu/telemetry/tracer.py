@@ -1,0 +1,341 @@
+"""Low-overhead tracing core: monotonic-clock spans in a bounded ring.
+
+One :class:`Tracer` serves every telemetry consumer in the framework
+(docs/OBSERVABILITY.md): serving request traces (queue→route→admit→
+prefill→decode→finish, serving/), per-forward engine spans
+(inference/v2/scheduler.py), and training step spans (runtime/engine.py).
+Design constraints, in priority order:
+
+- **Disabled must cost nothing.** ``Tracer(enabled=False).span(...)``
+  returns one shared no-op singleton — no allocation, no lock, no clock
+  read on the hot path (tests/test_telemetry.py pins this with
+  tracemalloc). Call sites guard attribute-dict construction on
+  ``tracer.enabled``.
+- **Bounded memory.** Completed spans land in a ``deque(maxlen=...)``
+  ring — the flight recorder's "recent history" window. Open spans are
+  tracked separately (so a crash dump shows what was *in flight*) with a
+  hard cap against leaks from error paths that never ``end()``.
+- **Explicit trace ids.** A trace is any string key (``req-17``,
+  ``replica-0``, ``train``); spans carry it verbatim. Parenting within a
+  thread is automatic for context-manager spans (a thread-local stack);
+  cross-thread chains (serving requests hop submit→router→replica
+  threads) pass ``parent=`` explicitly via :meth:`Tracer.begin`.
+
+Timestamps are ``time.monotonic()`` seconds; :func:`chrome_trace` turns a
+span list into Chrome ``trace_event`` JSON (chrome://tracing / Perfetto),
+mapping trace ids to pids so each request/replica/train trace renders as
+its own named track.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned by a disabled tracer. One instance
+    for the whole process — identity is the allocation-free guarantee."""
+
+    __slots__ = ()
+
+    def set(self, key: str, value: Any = None) -> "_NoopSpan":
+        return self
+
+    def end(self) -> None:
+        return None
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Span:
+    """One timed interval: ``[t_start, t_end]`` on the monotonic clock,
+    a ``trace_id`` naming the chain it belongs to, an optional parent
+    span id, and a free-form ``attrs`` dict. ``end()`` is idempotent —
+    stage code and terminal cleanup may both call it; the first wins."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "t_start", "t_end", "attrs", "tid", "_xla_ctx")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: Optional[str],
+                 parent_id: Optional[int], attrs: Optional[dict] = None):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = next(tracer._ids)
+        self.parent_id = parent_id
+        self.attrs = dict(attrs) if attrs else {}
+        self.tid = threading.get_ident()
+        self._xla_ctx = None
+        self.t_end: Optional[float] = None
+        self.t_start = tracer.clock()          # last: exclude setup time
+        tracer._note_open(self)
+
+    def set(self, key: str, value: Any) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def end(self) -> None:
+        if self.t_end is not None:
+            return
+        self.t_end = self.tracer.clock()
+        self.tracer._record(self)
+
+    # -- context-manager form: auto-parents off the thread-local stack and
+    # (optionally) mirrors into jax.profiler.TraceAnnotation so host spans
+    # line up with XLA device traces in the same Perfetto view.
+    def __enter__(self) -> "Span":
+        self.tracer._push(self)
+        if self.tracer.xla_annotations:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._xla_ctx = TraceAnnotation(self.name)
+                self._xla_ctx.__enter__()
+            except Exception:
+                self._xla_ctx = None
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self._xla_ctx is not None:
+            try:
+                self._xla_ctx.__exit__(*exc)
+            finally:
+                self._xla_ctx = None
+        self.tracer._pop(self)
+        self.end()
+        return False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "t_start": self.t_start, "t_end": self.t_end,
+                "tid": self.tid, "attrs": dict(self.attrs)}
+
+
+class Tracer:
+    """Thread-safe span factory + bounded completed-span ring.
+
+    ``span(...)`` is the context-manager form (auto-parented within the
+    thread); ``begin(...)`` returns a span the caller must ``end()`` —
+    the form for intervals that start and finish on different threads.
+    Both return :data:`NOOP_SPAN` when disabled."""
+
+    def __init__(self, enabled: bool = True, max_spans: int = 8192,
+                 clock=time.monotonic, xla_annotations: bool = False):
+        self.enabled = bool(enabled)
+        self.clock = clock
+        self.xla_annotations = bool(xla_annotations)
+        self.max_spans = int(max_spans)
+        self._spans: "deque[Span]" = deque(maxlen=self.max_spans)
+        # open (started, un-ended) spans, so crash dumps show in-flight
+        # work; insertion-ordered for the leak cap below
+        self._open: Dict[int, Span] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+
+    # ------------------------------------------------------------- creation
+    def span(self, name: str, trace_id: Optional[str] = None,
+             parent: Optional[Span] = None, attrs: Optional[dict] = None):
+        """Context-manager span. Parent defaults to the innermost span()
+        currently entered on this thread (nesting)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        if parent is None:
+            parent = self.current()
+        if parent is not None and trace_id is None:
+            trace_id = parent.trace_id
+        return Span(self, name, trace_id,
+                    parent.span_id if parent is not None else None, attrs)
+
+    def begin(self, name: str, trace_id: Optional[str] = None,
+              parent: Optional[Span] = None, attrs: Optional[dict] = None):
+        """Explicitly-ended span (cross-thread chains); never stacked."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return Span(self, name, trace_id,
+                    parent.span_id if parent is not None else None, attrs)
+
+    def current(self) -> Optional[Span]:
+        stack = getattr(self._local, "stack", None)
+        return stack[-1] if stack else None
+
+    # ------------------------------------------------------------ internals
+    def _push(self, span: Span) -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = getattr(self._local, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif stack and span in stack:       # mis-nested exit: best effort
+            stack.remove(span)
+
+    def _note_open(self, span: Span) -> None:
+        with self._lock:
+            self._open[span.span_id] = span
+            # leak cap: error paths may abandon spans without end(); keep
+            # at most max_spans of them (oldest dropped, they were likely
+            # abandoned long ago)
+            while len(self._open) > self.max_spans:
+                self._open.pop(next(iter(self._open)))
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._open.pop(span.span_id, None)
+            self._spans.append(span)
+
+    # -------------------------------------------------------------- reading
+    def export(self, include_open: bool = True) -> List[Dict[str, Any]]:
+        """Snapshot of recorded spans (oldest first), plus — by default —
+        currently-open spans with ``t_end=None`` and ``attrs["open"]``
+        set, so dumps taken mid-flight (or on a crash) show what was
+        running."""
+        with self._lock:
+            done = [s.to_dict() for s in self._spans]
+            open_ = [s.to_dict() for s in self._open.values()] \
+                if include_open else []
+        for d in open_:
+            d["attrs"]["open"] = True
+        return done + open_
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._open.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+#: Process-wide disabled tracer: the default everywhere a tracer is
+#: optional, so un-configured call sites pay only an attribute check.
+NOOP_TRACER = Tracer(enabled=False, max_spans=1)
+
+
+# --------------------------------------------------------------- chrome trace
+
+def chrome_trace(spans: Sequence[Dict[str, Any]],
+                 meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Render exported span dicts as Chrome ``trace_event`` JSON
+    (the object form — ``chrome://tracing`` and Perfetto both load it).
+
+    Each distinct ``trace_id`` becomes a pid with a ``process_name``
+    metadata event, so requests/replicas/train render as separate named
+    tracks; span attrs land in ``args``. Open spans (no ``t_end``) are
+    emitted as ``B`` (begin-only) events — Perfetto shows them as
+    unterminated slices, which is exactly what an in-flight crash dump
+    means."""
+    pids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for s in spans:
+        tid_key = s.get("trace_id") or "untraced"
+        if tid_key not in pids:
+            pid = len(pids) + 1
+            pids[tid_key] = pid
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": tid_key}})
+    for s in spans:
+        pid = pids[s.get("trace_id") or "untraced"]
+        args = dict(s.get("attrs") or {})
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_id") is not None:
+            args["parent_id"] = s["parent_id"]
+        ev = {"name": s["name"], "cat": "telemetry",
+              "ts": float(s["t_start"]) * 1e6,
+              "pid": pid, "tid": int(s.get("tid") or 0), "args": args}
+        if s.get("t_end") is not None:
+            ev["ph"] = "X"
+            ev["dur"] = max(0.0, (s["t_end"] - s["t_start"]) * 1e6)
+        else:
+            ev["ph"] = "B"
+        events.append(ev)
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if meta:
+        out["otherData"] = dict(meta)
+    return out
+
+
+def validate_chrome_trace(obj: Any) -> List[str]:
+    """Structural check of a Chrome-trace object (or its JSON string):
+    returns a list of problems, empty when the trace is loadable. Used by
+    ``bench.py``'s telemetry phase and tests so saved artifacts are
+    verified, not assumed."""
+    problems: List[str] = []
+    if isinstance(obj, (str, bytes)):
+        try:
+            obj = json.loads(obj)
+        except Exception as e:
+            return [f"not valid JSON: {e}"]
+    if not isinstance(obj, dict):
+        return [f"top level must be an object, got {type(obj).__name__}"]
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing 'traceEvents' list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            problems.append(f"{where}: missing 'name'")
+        ph = ev.get("ph")
+        if ph not in ("X", "B", "E", "M", "i", "I", "C"):
+            problems.append(f"{where}: bad phase {ph!r}")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                problems.append(f"{where}: '{key}' must be an int")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not isinstance(ts, (int, float)):
+                problems.append(f"{where}: 'ts' must be a number")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"{where}: 'X' event needs dur >= 0")
+    return problems
+
+
+def trace_coverage(spans: Iterable[Dict[str, Any]], t0: float,
+                   t1: float) -> float:
+    """Fraction of the window ``[t0, t1]`` covered by the union of the
+    given spans' intervals (open spans count up to ``t1``). The bench
+    telemetry phase uses this to enforce that a request's span chain
+    accounts for ≥95% of its measured TTFT — coverage, not vibes."""
+    if t1 <= t0:
+        return 1.0
+    ivals: List[Tuple[float, float]] = []
+    for s in spans:
+        a = max(float(s["t_start"]), t0)
+        b = min(float(s["t_end"]) if s.get("t_end") is not None else t1, t1)
+        if b > a:
+            ivals.append((a, b))
+    if not ivals:
+        return 0.0
+    ivals.sort()
+    covered = 0.0
+    cur_a, cur_b = ivals[0]
+    for a, b in ivals[1:]:
+        if a > cur_b:
+            covered += cur_b - cur_a
+            cur_a, cur_b = a, b
+        else:
+            cur_b = max(cur_b, b)
+    covered += cur_b - cur_a
+    return covered / (t1 - t0)

@@ -24,16 +24,20 @@ STEP_GLOBAL_TIMER = "step"
 
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, sync_fn=None):
         self.name = name
         self.started = False
         self._start = 0.0
         self._elapsed = 0.0
         self._record: list[float] = []
+        # default device-sync, inherited from the owning registry; an
+        # explicit start/stop sync_fn overrides per call
+        self._sync_fn = sync_fn
 
     def start(self, sync_fn=None) -> None:
         if self.started:
             return
+        sync_fn = sync_fn if sync_fn is not None else self._sync_fn
         if sync_fn is not None:
             sync_fn()
         self._start = time.perf_counter()
@@ -42,6 +46,7 @@ class _Timer:
     def stop(self, record: bool = False, sync_fn=None) -> None:
         if not self.started:
             return
+        sync_fn = sync_fn if sync_fn is not None else self._sync_fn
         if sync_fn is not None:
             sync_fn()
         delta = time.perf_counter() - self._start
@@ -79,7 +84,9 @@ class SynchronizedWallClockTimer:
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name)
+            # timers inherit the registry's device sync so start/stop
+            # bracket real device work, not async dispatch
+            self.timers[name] = _Timer(name, sync_fn=self._sync_fn)
         return self.timers[name]
 
     def has(self, name: str) -> bool:
@@ -104,12 +111,28 @@ class ThroughputTimer:
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
         self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
         self.initialized = False
         self.global_steps = 0
         self.total_elapsed = 0.0
         self._start = 0.0
         self.flops_per_sample: float | None = None
+        # last device-memory reading (bytes), when monitor_memory is on
+        self.memory_bytes: int | None = None
+
+    def device_memory_bytes(self) -> int | None:
+        """Total bytes of live jax.Arrays (reference THROUGHPUT timer's
+        ``see_memory_usage`` role). ``jax.live_arrays()`` enumerates every
+        uncollected device buffer — works on CPU and TPU alike — so this
+        is guarded and only sampled at report steps, never per step."""
+        try:
+            import jax
+
+            return int(sum(int(getattr(a, "nbytes", 0) or 0)
+                           for a in jax.live_arrays()))
+        except Exception:
+            return None
 
     def start(self) -> None:
         self._start = time.perf_counter()
@@ -124,9 +147,17 @@ class ThroughputTimer:
             if self.global_steps >= self.start_step:
                 self.total_elapsed += duration
             if report_speed and self.steps_per_output and self.global_steps % self.steps_per_output == 0:
+                mem = ""
+                if self.monitor_memory:
+                    self.memory_bytes = self.device_memory_bytes()
+                    if self.memory_bytes is not None:
+                        mem = (f", device_mem="
+                               f"{self.memory_bytes / 2**30:.3f}GiB"
+                               " (live arrays)")
                 self.logging(
                     f"step={self.global_steps}, samples/sec={self.avg_samples_per_sec():.2f}"
-                    + (f", TFLOPS={self.tflops():.2f}" if self.flops_per_sample else ""))
+                    + (f", TFLOPS={self.tflops():.2f}" if self.flops_per_sample else "")
+                    + mem)
 
     def avg_samples_per_sec(self) -> float:
         steps = max(1, self.global_steps - self.start_step + 1)

@@ -47,6 +47,9 @@ MODULES = [
     "deepspeed_tpu.runtime.zero_offload",
     "deepspeed_tpu.sequence.layer",
     "deepspeed_tpu.sequence.ring_attention",
+    "deepspeed_tpu.serving",
+    "deepspeed_tpu.telemetry",
+    "deepspeed_tpu.telemetry.flight_recorder",
     "deepspeed_tpu.utils.comms_logging",
     "deepspeed_tpu.utils.zero_to_fp32",
 ]
