@@ -508,6 +508,82 @@ def bench_serving(on_tpu: bool):
             "flight_recorder": dump_paths,
         }
 
+    def run_chaos_phase():
+        """Fault-tolerance chaos phase (docs/SERVING.md "Fault
+        tolerance"): a 2-replica supervised frontend serves a burst while
+        the fault injector crashes replica 0 mid-stream; its requests
+        fail over (resume on the survivor) and the supervisor restarts
+        the slot. Reports recovery time (death → replacement serving),
+        retry success rate (failed-over requests that still completed —
+        must be 1.0 for greedy traffic), and greedy-token parity vs an
+        unfaulted run of the same prompts."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+
+        if on_tpu:
+            n_req, max_new, plen, crash_step = 16, 16, 128, 4
+        else:
+            n_req, max_new, plen, crash_step = 8, 6, 24, 3
+        chaos_prompts = [rng.integers(0, cfg.vocab_size, size=plen).tolist()
+                         for _ in range(n_req)]
+
+        def engine_factory(i):
+            return InferenceEngineV2(engine.model, params=engine.params,
+                                     config=type(vcfg)(**vars(vcfg)))
+
+        def run(faulted):
+            scfg = ServingConfig(
+                max_queue_depth=max(64, n_req),
+                fault_tolerance={"enabled": True, "max_retries": 3,
+                                 "restart_backoff_s": 0.05,
+                                 "supervisor_poll_s": 0.02},
+                faults=({"enabled": True, "schedule": [
+                    {"kind": "crash", "replica": 0,
+                     "at_step": crash_step}]} if faulted
+                    else {"enabled": False}))
+            fe = ServingFrontend([engine_factory(0), engine_factory(1)],
+                                 scfg, engine_factory=engine_factory)
+            handles = [fe.submit(p, max_new_tokens=max_new)
+                       for p in chaos_prompts]
+            completed = fe.wait_all(handles, timeout=600)
+            gens = [[ev.token for ev in h.drain()] for h in handles]
+            if faulted:
+                # the burst usually finishes on the survivor before the
+                # replacement engine is built — recovery_time_s is about
+                # the RESTART, so wait for the supervisor to land it
+                deadline = time.monotonic() + 60
+                while not fe.supervisor.restart_log \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+            snap = fe.metrics_snapshot()
+            restart_log = list(fe.supervisor.restart_log)
+            attempts = [h.attempts for h in handles]
+            states = [h.state for h in handles]
+            fe.shutdown(drain=False, timeout=5)
+            return gens, snap, restart_log, attempts, states, completed
+
+        gens_ok, _, _, _, _, _ = run(faulted=False)
+        gens_chaos, snap, restarts, attempts, states, completed = \
+            run(faulted=True)
+        retried = [i for i, a in enumerate(attempts) if a > 1]
+        retry_ok = [i for i in retried
+                    if states[i] == RequestState.FINISHED]
+        return {
+            "n_requests": n_req,
+            "replicas": 2,
+            "crash_at_step": crash_step,
+            "all_completed": bool(completed)
+            and all(s == RequestState.FINISHED for s in states),
+            "requests_failed_over": int(snap["requests_failed_over"]),
+            "replica_restarts": int(snap["replica_restarts"]),
+            "recovery_time_s": (round(restarts[0]["recovery_s"], 4)
+                                if restarts else None),
+            "retry_success_rate": (round(len(retry_ok) / len(retried), 4)
+                                   if retried else None),
+            "parity": gens_chaos == gens_ok,
+        }
+
     run_phase(10_000)                   # warmup: compile all shape buckets
     ttfts, decode_tps = run_phase(20_000)
     run_ragged_phase(30_000, lens, target_active, decode_budget)  # warmup
@@ -517,6 +593,7 @@ def bench_serving(on_tpu: bool):
     prefix = run_prefix_phase()
     spec = run_spec_phase()
     telemetry = run_telemetry_phase()
+    chaos = run_chaos_phase()
     return {
         "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
         "decode_tokens_per_sec": round(decode_tps, 1),
@@ -545,6 +622,10 @@ def bench_serving(on_tpu: bool):
         # validated Chrome-trace artifact + flight-recorder dump paths,
         # and span coverage of measured TTFT
         "telemetry": telemetry,
+        # fault-tolerance chaos phase (docs/SERVING.md "Fault
+        # tolerance"): kill 1 of 2 replicas mid-burst — recovery time,
+        # retry success rate (1.0 for greedy), greedy parity vs unfaulted
+        "chaos": chaos,
     }
 
 

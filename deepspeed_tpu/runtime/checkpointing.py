@@ -78,14 +78,53 @@ class CheckpointWriter:
     def submit(self, fname: str, arr: np.ndarray):
         self._q.put((fname, arr))
 
-    def wait(self):
-        """Join queued writes, stop the worker, raise any collected error."""
+    def finalize(self):
+        """Join queued writes, stop the worker, raise any collected error.
+        The worker thread is ALWAYS joined before the error surfaces —
+        a failed save must not leak its writer thread — and a raised
+        IOError means the commit marker was never written (the previous
+        checkpoint's 'latest' stays loadable)."""
         self._q.join()
         self._q.put(None)          # terminate _run — no thread leak per save
         self._thread.join()
         if self._errors:
             errs, self._errors = self._errors, []
             raise IOError(f"checkpoint writes failed: {errs}")
+
+    # historical name, kept for callers of the async-save path
+    wait = finalize
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a rename into it is durable (POSIX: the
+    rename itself lives in the directory's metadata). Best-effort —
+    some filesystems refuse O_RDONLY-fsync on directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """Crash-safe file replace: write a temp file, fsync it, then
+    ``os.replace`` (atomic on POSIX) and fsync the directory. A reader
+    — or a restart after a crash at ANY point in here — sees either the
+    complete old content or the complete new content, never a torn
+    write. This is what makes 'latest' a real commit marker: a crash
+    mid-save can never leave it pointing at a half-written tag."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
 
 
 def _shard_fname(key: str, start) -> str:
@@ -235,11 +274,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     def commit():
         if jax.process_index() != 0:
             return
-        with open(os.path.join(ckpt_dir, "manifest.json"), "w") as fh:
-            json.dump(manifest, fh, indent=2, default=str)
+        # both the manifest and the 'latest' pointer go through the
+        # atomic temp-file + os.replace + dir-fsync path: a crash between
+        # (or inside) these writes leaves the PREVIOUS checkpoint fully
+        # loadable — 'latest' either still names the old tag or names a
+        # tag whose manifest is complete
+        _atomic_write_text(os.path.join(ckpt_dir, "manifest.json"),
+                           json.dumps(manifest, indent=2, default=str))
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as fh:
-                fh.write(str(tag))
+            _atomic_write_text(os.path.join(save_dir, "latest"), str(tag))
 
     engine._pending_ckpt_commit = commit
     if not async_save:
@@ -255,8 +298,16 @@ def wait_pending_save(engine):
     commit marker (reference checkpoint_engine commit() role)."""
     writer = getattr(engine, "_pending_ckpt_writer", None)
     if writer is not None:
-        writer.wait()
-        engine._pending_ckpt_writer = None
+        try:
+            writer.finalize()
+        except BaseException:
+            # failed shard writes: drop the pending commit closure too,
+            # or the NEXT save's join would run it and point 'latest' at
+            # this incomplete tag
+            engine._pending_ckpt_commit = None
+            raise
+        finally:
+            engine._pending_ckpt_writer = None
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -11,8 +11,9 @@ Light names import eagerly; ``ServingFrontend``/``Replica``/
 ``ReplicaRouter`` load lazily because they pull in the JAX engine stack.
 """
 
-from .config import (PrefixCacheConfig, ServingConfig,  # noqa: F401
-                     SpeculativeConfig)
+from .config import (FaultsConfig, FaultToleranceConfig,  # noqa: F401
+                     PrefixCacheConfig, ServingConfig, SpeculativeConfig)
+from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, serving_metrics)
 from .queue import AdmissionQueue  # noqa: F401
@@ -25,6 +26,8 @@ _LAZY = {
     "Replica": ("deepspeed_tpu.serving.replica", "Replica"),
     "ReplicaState": ("deepspeed_tpu.serving.replica", "ReplicaState"),
     "ReplicaRouter": ("deepspeed_tpu.serving.router", "ReplicaRouter"),
+    "ReplicaSupervisor": ("deepspeed_tpu.serving.supervisor",
+                          "ReplicaSupervisor"),
 }
 
 
@@ -38,6 +41,8 @@ def __getattr__(name):
 
 
 __all__ = ["ServingConfig", "PrefixCacheConfig", "SpeculativeConfig",
+           "FaultToleranceConfig", "FaultsConfig", "FaultInjector",
+           "InjectedFault", "ReplicaSupervisor",
            "MetricsRegistry",
            "serving_metrics", "Counter",
            "Gauge", "Histogram", "AdmissionQueue", "Priority", "Rejected",

@@ -7,7 +7,7 @@ is mounted on :class:`DeepSpeedTpuConfig` as the ``serving`` block.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 from pydantic import Field
 
@@ -76,6 +76,61 @@ class SpeculativeConfig(DSConfigModel):
                          "(expected 'ngram' or 'draft_model')")
 
 
+class FaultToleranceConfig(DSConfigModel):
+    """``fault_tolerance: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Fault tolerance"): replica supervision (restart DEAD replicas with
+    exponential backoff + a circuit breaker), transparent request
+    failover (re-enqueue a dead replica's work, resume from prompt +
+    delivered tokens — lossless under greedy decoding), and admission
+    brownout under degraded capacity. Disabled (the default) keeps the
+    historical fail-terminal behavior byte for byte."""
+
+    enabled: bool = False
+    # failover: extra replica assignments a request may take after its
+    # first (attempts <= max_retries + 1); deadline/cancel always win
+    max_retries: int = 2
+    # restart backoff: base * 2^(crashes_in_window - 1), capped, with
+    # deterministic seeded jitter so a fleet doesn't restart in lockstep
+    restart_backoff_s: float = 0.5
+    restart_backoff_max_s: float = 30.0
+    restart_backoff_jitter: float = 0.2
+    seed: int = 0
+    # circuit breaker: this many crashes inside the window parks the
+    # replica slot — no further restarts, capacity_alarm raised
+    max_restarts_in_window: int = 3
+    restart_window_s: float = 300.0
+    supervisor_poll_s: float = 0.05
+    # brownout: healthy-capacity fraction below which the admission
+    # queue shrinks and sheds lowest-urgency work first (0 = disabled)
+    brownout_threshold: float = 0.0
+
+
+class FaultsConfig(DSConfigModel):
+    """``faults: {...}`` TEST-ONLY deterministic fault injection
+    (docs/CONFIG.md, serving/faults.py): a seeded schedule of replica
+    crashes, wedges, ``engine.put`` errors, and slow-forward latency,
+    driving the chaos suite (tests/test_fault_tolerance.py) and
+    ``bench.py``'s chaos phase. Disabled = no engine proxying, no hooks
+    — byte-for-byte the uninstrumented serving stack."""
+
+    enabled: bool = False
+    seed: int = 0
+    # entries: {"kind": "crash"|"wedge"|"put_error"|"slow_forward",
+    #           "replica": i, "at_step": k | "at_put": n |
+    #           "at_step_range": [lo, hi] (seeded draw),
+    #           "duration_s": t, "count": c (0 = every time)}
+    schedule: List[Dict[str, Any]] = Field(default_factory=list)
+
+    def build_injector(self):
+        """The configured :class:`~deepspeed_tpu.serving.faults.
+        FaultInjector`, or ``None`` when disabled."""
+        if not self.enabled:
+            return None
+        from .faults import FaultInjector
+
+        return FaultInjector(self.schedule, seed=self.seed)
+
+
 class ServingConfig(DSConfigModel):
     """Queue bounds, SLO defaults, replica fleet shape, shed policy."""
 
@@ -105,3 +160,10 @@ class ServingConfig(DSConfigModel):
     # unified telemetry: request tracing + flight recorder
     # (docs/OBSERVABILITY.md); disabled = the no-op tracer
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    # replica supervision + request failover + brownout
+    # (docs/SERVING.md "Fault tolerance"); disabled = historical behavior
+    fault_tolerance: FaultToleranceConfig = Field(
+        default_factory=FaultToleranceConfig)
+    # test-only deterministic fault injection (chaos suite / bench chaos
+    # phase); disabled = no injection hooks anywhere on the hot path
+    faults: FaultsConfig = Field(default_factory=FaultsConfig)

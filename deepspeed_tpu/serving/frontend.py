@@ -33,10 +33,14 @@ from .router import ReplicaRouter
 class ServingFrontend:
     def __init__(self, engines: Sequence, config: Optional[ServingConfig] = None,
                  sample_fn: Optional[Callable] = None,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 engine_factory: Optional[Callable[[int], object]] = None):
         """``engines``: one InferenceEngineV2 per replica (the caller owns
         model/param placement; replicas never share an engine — each owns
-        its KV pool and scheduler)."""
+        its KV pool and scheduler). ``engine_factory(replica_id)``, when
+        given, is how the supervisor builds FRESH engines for restarted
+        replicas (docs/SERVING.md "Fault tolerance"); without it a
+        restart reuses the dead replica's engine when that is safe."""
         if not engines:
             raise ValueError("ServingFrontend needs at least one engine")
         self.config = config or ServingConfig()
@@ -52,35 +56,63 @@ class ServingFrontend:
         if self.config.ttft_buckets_s:
             self.metrics.histogram("ttft_s", self.config.ttft_buckets_s,
                                    reset=True)
+        ft = self.config.fault_tolerance
+        self.admission = AdmissionQueue(
+            self.config.max_queue_depth, self.metrics,
+            brownout_threshold=(ft.brownout_threshold if ft.enabled
+                                else 0.0))
+        # speculative decoding is applied per replica: each Replica builds
+        # its own proposer from the block (draft state is per-engine)
+        self._sample_fn = sample_fn
+        self._spec = (self.config.speculative
+                      if self.config.speculative.enabled else None)
+        self._replica_recorder = (self.recorder
+                                  if self.config.telemetry.dump_on_error
+                                  else None)
+        # deterministic fault injection (test-only; serving/faults.py) —
+        # None when the ``faults:`` block is off: no hooks, no proxies
+        self.injector = self.config.faults.build_injector()
+        replicas = [self._build_replica(i, eng)
+                    for i, eng in enumerate(engines)]
+        self.router = ReplicaRouter(replicas, self.admission, self.metrics,
+                                    tracer=self.tracer,
+                                    recorder=self.recorder)
+        self.supervisor = None
+        if ft.enabled:
+            from .supervisor import ReplicaSupervisor
+
+            self.supervisor = ReplicaSupervisor(
+                self.router, self._build_replica, engine_factory,
+                config=ft, metrics=self.metrics, tracer=self.tracer,
+                recorder=self.recorder)
+            self.router.supervisor = self.supervisor
+        self._closed = False
+        self.router.start()
+        if self.supervisor is not None:
+            self.supervisor.start()
+
+    def _build_replica(self, replica_id: int, engine) -> Replica:
+        """One replica over ``engine`` with this frontend's full wiring —
+        the constructor path AND the supervisor's restart path, so a
+        restarted replica is indistinguishable from a first-boot one
+        (prefix cache applied, proposer built, telemetry attached)."""
         if self.config.prefix_cache.enabled:
             # config-driven prefix caching: flip it on every engine that
             # supports it (enabling on a built engine is safe — matching
             # simply starts now). Engines the caller already enabled
             # directly are left alone when the config block is off.
-            for eng in engines:
-                configure = getattr(eng, "configure_prefix_cache", None)
-                if configure is not None:
-                    configure(True,
-                              self.config.prefix_cache.max_cached_blocks
-                              or None)
-        self.admission = AdmissionQueue(self.config.max_queue_depth,
-                                        self.metrics)
-        # speculative decoding is applied per replica: each Replica builds
-        # its own proposer from the block (draft state is per-engine)
-        spec = (self.config.speculative
-                if self.config.speculative.enabled else None)
-        recorder = (self.recorder
-                    if self.config.telemetry.dump_on_error else None)
-        replicas = [Replica(i, eng, self.metrics, sample_fn,
-                            wedge_timeout_s=self.config.wedge_timeout_s,
-                            speculative=spec, tracer=self.tracer,
-                            recorder=recorder)
-                    for i, eng in enumerate(engines)]
-        self.router = ReplicaRouter(replicas, self.admission, self.metrics,
-                                    tracer=self.tracer,
-                                    recorder=self.recorder)
-        self._closed = False
-        self.router.start()
+            configure = getattr(engine, "configure_prefix_cache", None)
+            if configure is not None:
+                configure(True,
+                          self.config.prefix_cache.max_cached_blocks
+                          or None)
+        ft = self.config.fault_tolerance
+        return Replica(replica_id, engine, self.metrics, self._sample_fn,
+                       wedge_timeout_s=self.config.wedge_timeout_s,
+                       speculative=self._spec, tracer=self.tracer,
+                       recorder=self._replica_recorder,
+                       faults=self.injector,
+                       on_failover=self._failover if ft.enabled else None)
 
     @classmethod
     def from_engine_factory(cls, engine_factory: Callable[[int], object],
@@ -92,6 +124,9 @@ class ServingFrontend:
         config = config or ServingConfig()
         engines = [engine_factory(i)
                    for i in range(max(1, config.num_replicas))]
+        # the factory doubles as the supervisor's fresh-engine source for
+        # restarted replicas (unless the caller passed its own)
+        kwargs.setdefault("engine_factory", engine_factory)
         return cls(engines, config, **kwargs)
 
     # ---------------------------------------------------------------- submit
@@ -140,6 +175,46 @@ class ServingFrontend:
                            f"tokens > max_seq_len {max_len}")
         self.admission.offer(req, block=cfg.shed_policy == "block")
         return RequestHandle(req, self)
+
+    # ----------------------------------------------------------- failover
+    def _failover(self, req: ServingRequest) -> bool:
+        """Replica-death hand-back (docs/SERVING.md "Fault tolerance").
+        Returns True when the request was handled here — re-enqueued for
+        another attempt (the stream stays open and resumes on a healthy
+        replica from prompt + delivered tokens, lossless under greedy
+        decoding) or completed because nothing more was owed. False →
+        the caller fails it terminally (retries exhausted, deadline
+        passed, cancellation, or shutdown)."""
+        ft = self.config.fault_tolerance
+        if self._closed or req.cancel_requested.is_set() or req.expired():
+            return False
+        if req.attempts > ft.max_retries:
+            return False          # attempts = 1 + retries already taken
+        ended_eos = (req.eos_token_id is not None and req.generated_tokens
+                     and req.generated_tokens[-1] == req.eos_token_id)
+        if req.remaining_new_tokens <= 0 or ended_eos:
+            # the crash raced the finish: every owed token was delivered
+            # (budget exhausted, or the EOS token itself already reached
+            # the stream — resuming would generate past EOS)
+            req.finish(RequestState.FINISHED,
+                       FinishReason.EOS if ended_eos else FinishReason.LENGTH)
+            self.metrics.counter("requests_completed").inc()
+            return True
+        req.attempts += 1
+        req.state = RequestState.QUEUED
+        req.replica_id = None
+        if req.spans is not None:
+            root = req.spans.get("request")
+            if root is not None:
+                root.set("attempts", req.attempts)
+            # the span chain re-enters the queue stage; the attempt
+            # number distinguishes the retry's stages in the trace
+            req.begin_span(self.tracer, "queue",
+                           attrs={"attempt": req.attempts})
+        if not self.admission.requeue(req):
+            return False          # queue closed mid-failover: shutdown
+        self.metrics.counter("requests_failed_over").inc()
+        return True
 
     # ---------------------------------------------------------- lifecycle
     def stream(self, handle: RequestHandle, timeout: Optional[float] = None):

@@ -273,9 +273,20 @@ def serving_metrics() -> MetricsRegistry:
               # decoding"); acceptance rate = accepted/proposed,
               # tokens-per-forward = emitted/decode_forwards
               "spec_tokens_proposed", "spec_tokens_accepted",
-              "spec_tokens_emitted", "spec_decode_forwards"):
+              "spec_tokens_emitted", "spec_decode_forwards",
+              # fault tolerance (docs/SERVING.md "Fault tolerance"):
+              # failover = a dead replica's request re-enqueued (stream
+              # resumed elsewhere); restarts = supervisor replaced a DEAD
+              # replica; brownout = shed by the degraded-capacity queue
+              "requests_failed_over", "replica_restarts",
+              "requests_shed_brownout"):
         reg.counter(c)
-    for g in ("queue_depth", "replicas_healthy", "outstanding_tokens"):
+    for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
+              # replicas_parked: circuit-broken slots (no more restarts);
+              # capacity_alarm: 1 while any slot is parked — page on it;
+              # brownout_active: 1 while the admission queue is shedding
+              # lowest-urgency work under degraded capacity
+              "replicas_parked", "capacity_alarm", "brownout_active"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)

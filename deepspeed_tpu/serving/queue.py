@@ -5,14 +5,23 @@ The queue is the backpressure point of the serving layer: it admits at most
 and *sheds* instead of growing — a full queue raises
 :class:`~deepspeed_tpu.serving.request.Rejected` at submit time so callers
 see an immediate, typed "overloaded" rather than an unbounded TTFT tail.
-Requests whose deadline passes while still queued are dropped at pop time
-(no replica cycles are spent on work that already missed its SLO) and
-finished with reason "deadline" so their streams terminate.
+Requests whose deadline passes while still queued are swept at pop time
+(the WHOLE heap, not just the top — doomed work deep in the backlog never
+reaches a replica) and finished with reason "deadline" so their streams
+terminate.
+
+Two fault-tolerance hooks (docs/SERVING.md "Fault tolerance"):
+:meth:`requeue` re-admits a request whose replica died — exempt from the
+depth bound, admitted work is conserved rather than shed — and *brownout*
+mode shrinks the effective depth when the router reports degraded healthy
+capacity, shedding the lowest-urgency queued work (reason "brownout")
+instead of letting the whole backlog time out on a half-sized fleet.
 """
 
 from __future__ import annotations
 
 import heapq
+import math
 import threading
 import time
 from typing import List, Optional
@@ -22,11 +31,21 @@ from .request import Rejected, RequestState, ServingRequest, FinishReason
 
 
 class AdmissionQueue:
-    def __init__(self, max_depth: int, metrics: Optional[MetricsRegistry] = None):
+    def __init__(self, max_depth: int, metrics: Optional[MetricsRegistry] = None,
+                 brownout_threshold: float = 0.0):
         self.max_depth = int(max_depth)
         self.metrics = metrics
+        # healthy-capacity fraction below this activates brownout
+        # (0 = brownout disabled, the historical behavior)
+        self.brownout_threshold = float(brownout_threshold)
+        self._healthy_frac = 1.0
+        self._brownout = False
         self._lock = threading.Condition()
         self._heap: List[tuple] = []      # (order_key, ServingRequest)
+        # earliest deadline among queued entries: the expired sweep only
+        # scans the heap once this watermark has actually passed, so the
+        # per-pop cost stays O(log n) on deadline-free / fresh traffic
+        self._earliest_deadline = float("inf")
         self._closed = False
 
     def __len__(self) -> int:
@@ -50,8 +69,16 @@ class AdmissionQueue:
             while True:
                 if self._closed:
                     self._shed(req, "draining")
-                if len(self._heap) < self.max_depth:
+                if len(self._heap) < self._effective_depth():
                     break
+                if self._brownout:
+                    # degraded capacity: make room by evicting the least
+                    # urgent queued request IF the incoming one outranks
+                    # it — otherwise the incoming request is the least
+                    # urgent work and is the one shed
+                    if self._evict_worst_for(req):
+                        break
+                    self._shed(req, FinishReason.BROWNOUT)
                 if not block:
                     self._shed(req, "overloaded")
                 wait = (None if deadline is None
@@ -59,18 +86,157 @@ class AdmissionQueue:
                 if wait is not None and wait <= 0:
                     self._shed(req, "overloaded")
                 self._lock.wait(wait if wait is not None else 0.05)
-            heapq.heappush(self._heap, (req.order_key, req))
-            self._note_depth()
-            self._lock.notify()
+            self._push_locked(req)
         if self.metrics is not None:
             self.metrics.counter("requests_admitted").inc()
+
+    def _push_locked(self, req: ServingRequest) -> None:
+        heapq.heappush(self._heap, (req.order_key, req))
+        if req.deadline_t is not None:
+            self._earliest_deadline = min(self._earliest_deadline,
+                                          req.deadline_t)
+        self._note_depth()
+        self._lock.notify()
+
+    def requeue(self, req: ServingRequest) -> bool:
+        """Re-admit a request whose replica died (transparent failover).
+        Exempt from the depth bound — the request was already admitted
+        once, and conserving admitted work must not depend on queue
+        headroom at the moment of the crash. False when the queue is
+        closed (shutdown) — the caller fails the request terminally."""
+        with self._lock:
+            if self._closed:
+                return False
+            self._push_locked(req)
+        return True
 
     def _shed(self, req: ServingRequest, reason: str) -> None:
         if self.metrics is not None:
             self.metrics.counter("requests_shed").inc()
+            if reason == FinishReason.BROWNOUT:
+                self.metrics.counter("requests_shed_brownout").inc()
         req.finish(RequestState.REJECTED, reason)
         raise Rejected(reason, f"queue depth {len(self._heap)}"
                                f"/{self.max_depth}")
+
+    # ------------------------------------------------------------ brownout
+    def _effective_depth(self) -> int:
+        """Depth bound under the current health: full ``max_depth`` in
+        normal operation, shrunk proportionally to the healthy-capacity
+        fraction during brownout (a half-dead fleet gets half the
+        backlog, so queue-wait stays bounded instead of doubling)."""
+        if not self._brownout:
+            return self.max_depth
+        return max(1, int(math.ceil(self.max_depth * self._healthy_frac)))
+
+    def set_healthy_fraction(self, frac: float) -> None:
+        """Router health sweep reports healthy/total replica capacity.
+        Below ``brownout_threshold`` the queue enters brownout: the depth
+        bound shrinks and already-queued lowest-urgency work is shed with
+        reason "brownout" — graceful degradation sacrifices the least
+        important work explicitly instead of timing everything out."""
+        if self.brownout_threshold <= 0.0:
+            return
+        shed: List[ServingRequest] = []
+        with self._lock:
+            self._healthy_frac = max(0.0, min(1.0, float(frac)))
+            was = self._brownout
+            self._brownout = self._healthy_frac < self.brownout_threshold
+            if self.metrics is not None and was != self._brownout:
+                self.metrics.gauge("brownout_active").set(
+                    1.0 if self._brownout else 0.0)
+            if self._brownout:
+                eff = self._effective_depth()
+                while len(self._heap) > eff:
+                    worst_i = self._worst_sheddable_index()
+                    if worst_i is None:
+                        break             # only retried work left: keep it
+                    shed.append(self._pop_index_locked(worst_i))
+                if shed:
+                    self._note_depth()
+        for req in shed:
+            if self.metrics is not None:
+                self.metrics.counter("requests_shed").inc()
+                self.metrics.counter("requests_shed_brownout").inc()
+            req.finish(RequestState.REJECTED, FinishReason.BROWNOUT)
+
+    def _worst_sheddable_index(self) -> Optional[int]:
+        """Index of the LOWEST-urgency entry eligible for brownout
+        shedding (max order_key: lowest priority class, then longest/
+        absent deadline). Failover-requeued requests (attempts > 1) are
+        never victims — they already streamed on a replica that died,
+        and conserving admitted work is the failover contract. Caller
+        holds the lock."""
+        best = None
+        for j, (key, r) in enumerate(self._heap):
+            if r.attempts > 1:
+                continue
+            if best is None or key > self._heap[best][0]:
+                best = j
+        return best
+
+    def _pop_index_locked(self, i: int) -> ServingRequest:
+        _, req = self._heap[i]
+        self._heap[i] = self._heap[-1]
+        self._heap.pop()
+        heapq.heapify(self._heap)
+        return req
+
+    def _evict_worst_for(self, req: ServingRequest) -> bool:
+        """Brownout room-making: evict the least urgent sheddable queued
+        request if ``req`` outranks it. Caller holds the lock."""
+        worst_i = self._worst_sheddable_index()
+        if worst_i is None:
+            # over-depth purely with retried work: admit rather than
+            # touch it (requeue is depth-exempt for the same reason)
+            return True
+        if req.order_key >= self._heap[worst_i][0]:
+            return False
+        victim = self._pop_index_locked(worst_i)
+        if self.metrics is not None:
+            self.metrics.counter("requests_shed").inc()
+            self.metrics.counter("requests_shed_brownout").inc()
+        victim.finish(RequestState.REJECTED, FinishReason.BROWNOUT)
+        return True
+
+    def _sweep_expired_locked(self, now: float) -> None:
+        """Fail every deadline-expired request anywhere in the heap —
+        not just at the top. An expired LOW request buried under fresher
+        HIGH traffic would otherwise occupy a depth slot (and eventually
+        a replica's admit path) long after it became doomed. Guarded by
+        the earliest-deadline watermark, so the O(n) scan only runs when
+        some queued deadline has actually passed. Caller holds the
+        lock."""
+        if now <= self._earliest_deadline:
+            return
+        keep, expired, cancelled = [], [], []
+        for entry in self._heap:
+            if not entry[1].expired(now):
+                keep.append(entry)
+            elif entry[1].cancel_requested.is_set():
+                # swept too (cancel takes precedence over deadline, as
+                # at pop) — left in the heap it would pin the watermark
+                # in the past and force this scan on every pop
+                cancelled.append(entry)
+            else:
+                expired.append(entry)
+        self._earliest_deadline = min(
+            (r.deadline_t for _, r in keep if r.deadline_t is not None),
+            default=float("inf"))
+        if not expired and not cancelled:
+            return
+        self._heap = keep
+        heapq.heapify(self._heap)
+        self._note_depth()
+        self._lock.notify_all()           # room freed: wake blocked offers
+        for _, req in expired:
+            req.finish(RequestState.EXPIRED, FinishReason.DEADLINE)
+            if self.metrics is not None:
+                self.metrics.counter("requests_expired").inc()
+        for _, req in cancelled:
+            req.finish(RequestState.CANCELLED, FinishReason.CANCELLED)
+            if self.metrics is not None:
+                self.metrics.counter("requests_cancelled").inc()
 
     def pop(self, timeout: Optional[float] = None) -> Optional[ServingRequest]:
         """Highest-urgency admitted request, skipping (and expiring) any
@@ -79,6 +245,7 @@ class AdmissionQueue:
         with self._lock:
             while True:
                 now = time.monotonic()
+                self._sweep_expired_locked(now)
                 while self._heap:
                     _, req = heapq.heappop(self._heap)
                     self._lock.notify_all()   # room freed: wake blocked offers
@@ -120,9 +287,7 @@ class AdmissionQueue:
         with self._lock:
             for i, (_, r) in enumerate(self._heap):
                 if r is req:
-                    self._heap[i] = self._heap[-1]
-                    self._heap.pop()
-                    heapq.heapify(self._heap)
+                    self._pop_index_locked(i)
                     self._note_depth()
                     self._lock.notify_all()
                     return True

@@ -13,6 +13,15 @@ Health is a state machine the router consults before assigning:
 ``STOPPED``; an engine exception or a step that exceeds
 ``wedge_timeout_s`` moves the replica to ``DEAD`` and fails its in-flight
 requests, so one wedged replica degrades capacity instead of the service.
+
+With fault tolerance enabled (docs/SERVING.md "Fault tolerance") death is
+no longer terminal for the *requests*: an ``on_failover`` callback hands
+each in-flight/queued request back to the frontend, which re-enqueues it
+to resume on another replica from prompt + delivered tokens; the
+:class:`~deepspeed_tpu.serving.supervisor.ReplicaSupervisor` then
+replaces the dead replica itself. A ``faults`` injector (test-only)
+hooks the loop at the step boundary and the engine at the put boundary
+to make those deaths schedulable.
 """
 
 from __future__ import annotations
@@ -42,10 +51,21 @@ class Replica:
                  sample_fn: Optional[Callable] = None,
                  wedge_timeout_s: float = 300.0,
                  idle_wait_s: float = 0.005,
-                 speculative=None, tracer=None, recorder=None):
+                 speculative=None, tracer=None, recorder=None,
+                 faults=None, on_failover: Optional[Callable] = None):
         from ..telemetry import NOOP_TRACER
 
         self.replica_id = replica_id
+        # fault injection (test-only, serving/faults.py): the engine is
+        # proxied ONLY when a put-level fault targets this replica; the
+        # step hook below fires crash/wedge events. None = no hooks.
+        self._faults = faults
+        if faults is not None:
+            engine = faults.wrap_engine(engine, replica_id)
+        # transparent failover (docs/SERVING.md "Fault tolerance"): on
+        # replica death the frontend re-enqueues this replica's requests
+        # instead of failing them; None = historical fail-terminal path
+        self._on_failover = on_failover
         self.engine = engine
         self.metrics = metrics
         # telemetry (docs/OBSERVABILITY.md): request-trace stage spans +
@@ -81,6 +101,11 @@ class Replica:
         self.state = ReplicaState.HEALTHY
         self._inbox: "queue.Queue[ServingRequest]" = queue.Queue()
         self._active: Dict[int, ServingRequest] = {}
+        # uids already detached by a failure path — the worker loop, the
+        # router's wedge check and the supervisor can all race to fail
+        # the same request; exactly one may fail over / finish it (a
+        # double requeue would split one stream across two replicas)
+        self._failed_uids: set = set()
         self._lock = threading.Lock()
         self._outstanding = 0             # token-weighted load estimate
         self._stop = threading.Event()
@@ -178,10 +203,16 @@ class Replica:
         busy = self._busy_since
         if (busy is not None and self._steps_done > 0
                 and now - max(busy, self.last_progress_t) > self.wedge_timeout_s):
+            with self._lock:
+                # router loop and supervisor both run this check — only
+                # one may perform the DEAD transition (and the failover
+                # hand-off below); the loser just reads the state
+                if self.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+                    return self.state
+                self.state = ReplicaState.DEAD
             logger.warning(f"serving replica {self.replica_id} wedged "
                            f"(>{self.wedge_timeout_s}s without progress); "
                            "marking DEAD")
-            self.state = ReplicaState.DEAD
             # the worker thread is stuck inside a device call and cannot
             # fail its own requests — do it from here so no stream hangs.
             # Detached entries make the thread's late callbacks no-op if
@@ -196,9 +227,19 @@ class Replica:
     def _fail_request(self, req: ServingRequest, reason: str,
                       state: RequestState) -> None:
         with self._lock:
+            if req.uid in self._failed_uids:
+                return            # another failure path already took it
+            self._failed_uids.add(req.uid)
             self._outstanding = max(0, self._outstanding
                                     - req.outstanding_tokens)
         self._active.pop(req.uid, None)
+        if (reason == FinishReason.ERROR and self._on_failover is not None
+                and self._on_failover(req)):
+            # handed back to the frontend: requeued (stream stays open,
+            # resumes on another replica) or completed there — either
+            # way not terminal-failed here. requests_failed_over is
+            # counted by the frontend.
+            return
         req.finish(state, reason)
         if self.metrics is not None:
             key = {FinishReason.DEADLINE: "requests_expired",
@@ -223,19 +264,32 @@ class Replica:
             req.state = RequestState.RUNNING
             self._active[req.uid] = req
             req.end_span("admit")
+            # resume semantics (a retried request re-prefills prompt +
+            # already-delivered tokens and owes only the remaining
+            # budget); for a first attempt these are exactly the
+            # original prompt and max_new_tokens
             self.scheduler.submit(
-                req.uid, req.prompt_tokens, req.max_new_tokens,
+                req.uid, req.resume_prompt(), req.remaining_new_tokens,
                 req.eos_token_id,
                 on_token=self._on_token, on_finish=self._on_finish,
                 trace_id=req.trace_id)
 
     def _on_token(self, uid: int, token: int) -> None:
-        req = self._active.get(uid)
-        if req is None:
-            return
-        prev_t = req.last_token_t
-        req.push_token(token)
+        # delivery is serialized with _fail_request under the replica
+        # lock: a failure path first marks the uid failed (same lock),
+        # so either this push completes BEFORE the mark — the token is
+        # in generated_tokens when the failover computes resume_prompt —
+        # or the uid is already marked and the late callback no-ops.
+        # Without this ordering a wedged worker waking mid-step could
+        # emit a duplicate of a token the retry re-generates.
         with self._lock:
+            if uid in self._failed_uids:
+                return
+            req = self._active.get(uid)
+            if req is None:
+                return
+            prev_t = req.last_token_t
+            req.push_token(token)
             self._outstanding = max(0, self._outstanding - 1)
         if self.metrics is not None:
             self.metrics.counter("tokens_generated").inc()
@@ -247,10 +301,12 @@ class Replica:
                     req.last_token_t - prev_t)
 
     def _on_finish(self, sreq, reason: str) -> None:
-        req = self._active.pop(sreq.uid, None)
-        if req is None:
-            return
         with self._lock:
+            if sreq.uid in self._failed_uids:
+                return    # already failed over / failed by a death path
+            req = self._active.pop(sreq.uid, None)
+            if req is None:
+                return
             self._outstanding = max(0, self._outstanding
                                     - req.outstanding_tokens)
         if reason == FinishReason.CANCELLED:
@@ -327,9 +383,26 @@ class Replica:
                 self._enforce_slo()
                 if self.scheduler.has_work:
                     self._busy_since = self._busy_since or time.monotonic()
+                    if self._faults is not None:
+                        # crash raises into the except below (the real
+                        # engine-fault path); wedge blocks right here
+                        # (the shape the wedge watchdog detects)
+                        self._faults.on_step(self.replica_id,
+                                             self._steps_done)
                     self.scheduler.step()
                     self._steps_done += 1
                     self._publish_prefix_stats()
+                    # routine-failure uids (cancel/deadline) can emit no
+                    # further scheduler callbacks once the step that
+                    # detached them completed — prune so the set doesn't
+                    # grow for the life of a healthy replica. Death-path
+                    # entries never reach here: the DEAD transition
+                    # happens under this lock before any are added.
+                    with self._lock:
+                        if self._failed_uids and self.state in (
+                                ReplicaState.HEALTHY,
+                                ReplicaState.DRAINING):
+                            self._failed_uids.clear()
                 else:
                     self._busy_since = None
                     if self.state == ReplicaState.DRAINING:

@@ -43,6 +43,7 @@ class FinishReason:
     DEADLINE = "deadline"
     ERROR = "error"            # engine fault / replica died mid-request
     NO_REPLICAS = "no_replicas"   # nothing healthy to dispatch to
+    BROWNOUT = "brownout"      # shed by the degraded-capacity queue
 
 
 class Rejected(Exception):
@@ -103,6 +104,12 @@ class ServingRequest:
         self.cancel_requested = threading.Event()
         self.replica_id: Optional[int] = None
         self.n_generated = 0
+        # fault tolerance (docs/SERVING.md "Fault tolerance"): delivered
+        # tokens are kept so a replica death can resume the request on
+        # another replica from prompt + generated-so-far (lossless under
+        # greedy decoding); ``attempts`` counts replica assignments
+        self.generated_tokens: List[int] = []
+        self.attempts = 1
         self._events: "queue.Queue[StreamEvent]" = queue.Queue()
         self._done = threading.Event()
         # telemetry (docs/OBSERVABILITY.md): the frontend sets both when
@@ -132,6 +139,20 @@ class ServingRequest:
         return max(0, len(self.prompt_tokens) + self.max_new_tokens
                    - self.n_generated)
 
+    # --------------------------------------------------------- failover
+    @property
+    def remaining_new_tokens(self) -> int:
+        """Generation budget still owed to the stream (resume semantics:
+        tokens already delivered are never re-generated)."""
+        return max(0, self.max_new_tokens - self.n_generated)
+
+    def resume_prompt(self) -> List[int]:
+        """The prefix a retry must prefill: original prompt + every token
+        already delivered. Greedy decoding of this prefix continues the
+        stream byte-identically, so failover is lossless (and composes
+        with the prefix cache — the re-prefill hits the shared index)."""
+        return self.prompt_tokens + self.generated_tokens
+
     # ------------------------------------------------------------ telemetry
     def begin_span(self, tracer, name: str, attrs: Optional[dict] = None):
         """Open the next stage span of this request's trace (no-op when
@@ -159,6 +180,7 @@ class ServingRequest:
         self.last_token_t = now
         self._events.put(TokenEvent(self.uid, int(token),
                                     self.n_generated, now))
+        self.generated_tokens.append(int(token))
         self.n_generated += 1
 
     def finish(self, state: RequestState, reason: str) -> None:
@@ -175,6 +197,7 @@ class ServingRequest:
             if root is not None:
                 root.set("state", state.value).set("finish_reason", reason)
                 root.set("generated", self.n_generated)
+                root.set("attempts", self.attempts)
             for sp in self.spans.values():
                 sp.end()
         self._events.put(DoneEvent(self.uid, reason, self.finished_t))
@@ -202,6 +225,12 @@ class RequestHandle:
     @property
     def finish_reason(self) -> Optional[str]:
         return self._req.finish_reason
+
+    @property
+    def attempts(self) -> int:
+        """Replica assignments this request took (1 = no failover; >1 =
+        the stream was spliced across replica deaths transparently)."""
+        return self._req.attempts
 
     def cancel(self) -> None:
         self._frontend.cancel(self)

@@ -9,7 +9,11 @@ replica that stopped making progress is marked DEAD and simply drops out
 of the candidate set, so the service degrades to the surviving capacity
 instead of queueing behind a stuck device call. With *no* healthy replica
 the router fails requests fast with reason "no_replicas" rather than
-letting streams hang.
+letting streams hang — unless a supervisor reports recovery pending
+(docs/SERVING.md "Fault tolerance"), in which case requests are *held*
+for the restarting capacity (deadline-aware) instead of bounced off a
+transiently-empty fleet. The health sweep also feeds the healthy-capacity
+fraction to the admission queue's brownout mode.
 """
 
 from __future__ import annotations
@@ -42,6 +46,9 @@ class ReplicaRouter:
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.recorder = recorder
         self.poll_interval_s = poll_interval_s
+        # attached by the frontend when fault_tolerance is enabled; the
+        # supervisor swaps restarted replicas in via replace_replica
+        self.supervisor = None
         self._stop = threading.Event()
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name="serving-router")
@@ -63,6 +70,9 @@ class ReplicaRouter:
                 sum(r.outstanding_tokens for r in self.replicas
                     if r.state not in (ReplicaState.DEAD,
                                        ReplicaState.STOPPED)))
+        # brownout feed: the queue shrinks and sheds lowest-urgency work
+        # when this fraction drops below its threshold (no-op otherwise)
+        self.admission.set_healthy_fraction(len(out) / len(self.replicas))
         return out
 
     def pick(self) -> Optional[Replica]:
@@ -85,6 +95,14 @@ class ReplicaRouter:
                 return
         raise KeyError(f"no replica {replica_id}")
 
+    def replace_replica(self, index: int, replacement: Replica) -> None:
+        """Supervisor restart hand-off: swap the replica at ``index`` and
+        start the replacement. The slot assignment is atomic (list item
+        write); in-flight iterations over ``self.replicas`` see either
+        the corpse (not accepting) or the replacement."""
+        self.replicas[index] = replacement
+        replacement.start()
+
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, req: ServingRequest) -> None:
         # trace stage: routing (replica selection + any wait for a free
@@ -92,12 +110,16 @@ class ReplicaRouter:
         req.begin_span(self.tracer, "route")
         while not self._stop.is_set():
             if not self._any_accepting():
-                logger.warning(f"serving request {req.uid}: no healthy "
-                               "replica; failing fast")
-                if self.metrics is not None:
-                    self.metrics.counter("requests_failed").inc()
-                req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
-                return
+                sup = self.supervisor
+                if sup is None or not sup.recovery_pending():
+                    logger.warning(f"serving request {req.uid}: no healthy "
+                                   "replica; failing fast")
+                    if self.metrics is not None:
+                        self.metrics.counter("requests_failed").inc()
+                    req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
+                    return
+                # supervised restart in flight: capacity is coming back
+                # — hold the request (deadline still enforced below)
             if req.expired():
                 if self.metrics is not None:
                     self.metrics.counter("requests_expired").inc()
@@ -116,6 +138,22 @@ class ReplicaRouter:
             self.metrics.counter("requests_shed").inc()
         req.finish(RequestState.REJECTED, "draining")
 
+    def _fail_undispatchable(self) -> None:
+        """Supervised fleets only: once every slot is parked or stopped
+        (nothing is coming back), queued work is failed fast with
+        "no_replicas" instead of waiting out its deadline. Unsupervised
+        fleets keep the legacy behavior (work waits; deadlines sweep)."""
+        sup = self.supervisor
+        if sup is None or self._any_accepting() or sup.recovery_pending():
+            return
+        while True:
+            req = self.admission.pop(timeout=0)
+            if req is None:
+                return
+            if self.metrics is not None:
+                self.metrics.counter("requests_failed").inc()
+            req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             if self.recorder is not None:
@@ -125,6 +163,7 @@ class ReplicaRouter:
                 # admission queue (priority/deadline order) rather than
                 # FIFO-ing it into replica inboxes
                 self.healthy_replicas()   # keep health/gauges fresh
+                self._fail_undispatchable()
                 self._stop.wait(self.poll_interval_s)
                 continue
             req = self.admission.pop(timeout=self.poll_interval_s)
@@ -138,6 +177,10 @@ class ReplicaRouter:
         The drain path must NOT set the replica stop flag first — the
         worker exits on its own once DRAINING and idle; stop() afterwards
         is the backstop for replicas that didn't finish in time."""
+        if self.supervisor is not None:
+            # no restarts during shutdown (a swap racing the drain loop
+            # below would resurrect capacity we are tearing down)
+            self.supervisor.stop()
         self._stop.set()
         if self.thread.is_alive():
             self.thread.join(timeout)

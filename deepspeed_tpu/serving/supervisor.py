@@ -1,0 +1,257 @@
+"""Replica supervision: restart DEAD replicas instead of shrinking forever.
+
+Before this layer, failure was visible but permanent: the wedge watchdog
+and the engine-fault path mark a replica DEAD and the router routes
+around the corpse — one exception per replica and the fleet is gone. The
+supervisor closes the loop (docs/SERVING.md "Fault tolerance"): a
+monitor thread notices DEAD replicas, schedules a restart with
+exponential backoff + deterministic seeded jitter, builds a *fresh*
+engine + Replica via the frontend's factories, and swaps it into the
+router's slot. A circuit breaker bounds the blast radius: N crashes
+inside a sliding window *parks* the slot — no more restarts, the
+``capacity_alarm`` gauge goes up, and the remaining fleet (plus the
+admission queue's brownout mode) absorbs what it can.
+
+Restart safety rules:
+
+- A replica whose worker thread is still alive (wedged inside a device
+  call) can only be restarted onto a **fresh** engine — the stuck thread
+  owns the old one. Without an ``engine_factory`` the slot is parked
+  rather than risk two threads driving one engine.
+- A replica whose thread exited (clean crash) may reuse its engine when
+  no factory exists; leftover sequences are flushed best-effort first so
+  the KV pool doesn't leak across the restart.
+- The dead replica's requests were already handed back through the
+  failover path before the restart (Replica fails/failovers them the
+  moment it goes DEAD); the supervisor only restores *capacity*.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from ..utils.logging import logger
+from .config import FaultToleranceConfig
+from .replica import ReplicaState
+
+
+class _Slot:
+    """Supervision state for one replica position in the router."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.crash_times: "deque[float]" = deque()
+        self.restart_at: Optional[float] = None
+        self.backoff_s = 0.0
+        self.restarting = False
+        self.parked = False
+
+
+class ReplicaSupervisor:
+    def __init__(self, router, replica_factory: Callable,
+                 engine_factory: Optional[Callable],
+                 config: Optional[FaultToleranceConfig] = None,
+                 metrics=None, tracer=None, recorder=None):
+        from ..telemetry import NOOP_TRACER
+
+        self.router = router
+        self.replica_factory = replica_factory   # (replica_id, engine) -> Replica
+        self.engine_factory = engine_factory     # (replica_id) -> engine, or None
+        self.config = config or FaultToleranceConfig(enabled=True)
+        self.metrics = metrics
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.recorder = recorder
+        self.rng = random.Random(self.config.seed)
+        self._slots = [_Slot(i) for i in range(len(router.replicas))]
+        self._lock = threading.Lock()
+        # per-restart records: {"replica", "t_dead", "t_restarted",
+        # "backoff_s", "attempt"} — the bench chaos phase's
+        # recovery_time_s = t_restarted - t_dead
+        self.restart_log: List[dict] = []
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._loop, daemon=True,
+                                       name="serving-supervisor")
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self.thread.is_alive():
+            self.thread.join(timeout)
+
+    # ------------------------------------------------------------- queries
+    def recovery_pending(self) -> bool:
+        """True while ANY dead capacity is expected back (a restart is
+        scheduled, in flight, or a fresh DEAD not yet ticked). The router
+        consults this before failing work with "no_replicas": a
+        recoverable fleet holds requests instead of bouncing them."""
+        with self._lock:
+            for slot in self._slots:
+                if slot.parked:
+                    continue
+                if slot.restart_at is not None or slot.restarting:
+                    return True
+                if self.router.replicas[slot.index].state == ReplicaState.DEAD:
+                    return True
+        return False
+
+    def parked_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s.parked)
+
+    # ---------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # pragma: no cover - defensive
+                # supervision must never die of its own bug: a broken
+                # tick this round is retried next round
+                logger.error(f"serving supervisor tick failed: {e!r}")
+            self._stop.wait(self.config.supervisor_poll_s)
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.monotonic()
+        for slot in self._slots:
+            replica = self.router.replicas[slot.index]
+            state = replica.check_health(now)
+            if slot.parked or state != ReplicaState.DEAD:
+                continue
+            if slot.restarting:
+                continue
+            if slot.restart_at is None:
+                self._on_crash(slot, now)
+            elif now >= slot.restart_at:
+                self._restart(slot, now)
+
+    # ------------------------------------------------------------- crashes
+    def _on_crash(self, slot: _Slot, now: float) -> None:
+        cfg = self.config
+        with self._lock:
+            slot.crash_times.append(now)
+            while slot.crash_times and \
+                    now - slot.crash_times[0] > cfg.restart_window_s:
+                slot.crash_times.popleft()
+            n = len(slot.crash_times)
+            if n >= max(1, cfg.max_restarts_in_window):
+                self._park_locked(slot, n)
+                return
+            backoff = min(cfg.restart_backoff_s * (2 ** (n - 1)),
+                          cfg.restart_backoff_max_s)
+            backoff *= 1.0 + cfg.restart_backoff_jitter * self.rng.random()
+            slot.restart_at = now + backoff
+            slot.backoff_s = backoff
+        logger.warning(f"serving replica {slot.index} dead (crash {n} in "
+                       f"window); restart in {backoff:.2f}s")
+
+    def _park_locked(self, slot: _Slot, n_crashes: int) -> None:
+        """Circuit breaker: stop restarting a slot that keeps dying —
+        restart loops burn compile time and requeue storms without adding
+        capacity. Raises the capacity alarm; operators un-park by fixing
+        the cause and restarting the frontend."""
+        slot.parked = True
+        slot.restart_at = None
+        parked = sum(1 for s in self._slots if s.parked)
+        logger.error(f"serving replica {slot.index} PARKED after "
+                     f"{n_crashes} crashes in "
+                     f"{self.config.restart_window_s:.0f}s window "
+                     f"({parked}/{len(self._slots)} slots parked)")
+        if self.metrics is not None:
+            self.metrics.gauge("replicas_parked").set(parked)
+            self.metrics.gauge("capacity_alarm").set(1.0)
+        if self.tracer.enabled:
+            self.tracer.begin("replica_parked",
+                              trace_id=f"replica-{slot.index}",
+                              attrs={"crashes_in_window": n_crashes}).end()
+
+    # ------------------------------------------------------------- restart
+    def _salvage_engine(self, old_replica):
+        """Engine for the restart when no factory exists: reuse the dead
+        replica's engine only if its worker thread has exited (a thread
+        still stuck in a device call owns the engine — returns None, the
+        slot parks). Unwraps any fault-injection proxy (the factory path
+        re-wraps) and flushes leftover sequences so KV blocks return."""
+        if old_replica.thread.is_alive():
+            return None
+        engine = getattr(old_replica.engine, "_ft_inner", old_replica.engine)
+        sched = old_replica.scheduler
+        for uid in list(sched.running) + [r.uid for r in sched.pending]:
+            try:
+                engine.flush(uid)
+            except Exception:
+                pass
+        return engine
+
+    def _restart(self, slot: _Slot, now: float) -> None:
+        if self._stop.is_set():
+            return
+        with self._lock:
+            slot.restarting = True
+            slot.restart_at = None
+        old = self.router.replicas[slot.index]
+        t_dead = slot.crash_times[-1] if slot.crash_times else now
+        try:
+            if self.recorder is not None and self.tracer.enabled:
+                # dump the evidence (spans in flight at death, metric
+                # history) BEFORE the slot's story is overwritten by the
+                # replacement — the post-incident record
+                try:
+                    self.recorder.snapshot_metrics()
+                    self.recorder.dump(
+                        reason=f"restart_replica-{slot.index}")
+                except Exception:  # pragma: no cover - defensive
+                    pass
+            if self.engine_factory is not None:
+                engine = self.engine_factory(slot.index)
+            else:
+                engine = self._salvage_engine(old)
+            if engine is None:
+                with self._lock:
+                    self._park_locked(slot, len(slot.crash_times))
+                return
+            attempt = len(slot.crash_times)
+            span = self.tracer.begin(
+                "replica_restart", trace_id=f"replica-{slot.index}",
+                attrs={"attempt": attempt,
+                       "backoff_s": round(getattr(slot, "backoff_s", 0.0), 4),
+                       "fresh_engine": self.engine_factory is not None}) \
+                if self.tracer.enabled else None
+            replacement = self.replica_factory(slot.index, engine)
+            if self._stop.is_set():
+                # shutdown raced the (possibly long, engine-compiling)
+                # build: installing + starting now would leak a live
+                # worker past ServingFrontend.shutdown — drop it instead
+                if span is not None:
+                    span.end()
+                return
+            self.router.replace_replica(slot.index, replacement)
+            old.stop(timeout=0.0)
+            if span is not None:
+                span.end()
+            t_up = time.monotonic()
+            with self._lock:
+                self.restart_log.append({
+                    "replica": slot.index, "t_dead": t_dead,
+                    "t_restarted": t_up,
+                    "recovery_s": t_up - t_dead,
+                    "backoff_s": getattr(slot, "backoff_s", 0.0),
+                    "attempt": attempt})
+            if self.metrics is not None:
+                self.metrics.counter("replica_restarts").inc()
+            logger.warning(f"serving replica {slot.index} restarted "
+                           f"(attempt {attempt}, "
+                           f"{t_up - t_dead:.2f}s after death)")
+        except Exception as e:
+            # a failed restart (engine build blew up) counts as a crash:
+            # backoff again or trip the breaker — never busy-loop
+            logger.error(f"serving replica {slot.index} restart failed: "
+                         f"{e!r}")
+            self._on_crash(slot, time.monotonic())
+        finally:
+            with self._lock:
+                slot.restarting = False

@@ -21,6 +21,13 @@ timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
+if [ "$rc" -ne 0 ]; then
+    # failure digest: the last 20 failed/errored test ids, so a
+    # regression is diagnosable from this log alone (no re-run needed)
+    echo "=== FAILURE DIGEST (last 20 failed test ids) ==="
+    grep -aE '^(FAILED|ERROR) ' "$LOG" | tail -20
+    echo "=== END DIGEST (full log: $LOG) ==="
+fi
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" \
     | tr -cd . | wc -c)"
 exit "$rc"

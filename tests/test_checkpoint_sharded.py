@@ -106,6 +106,72 @@ def test_async_save_does_not_block_and_is_durable(tmp_path):
     assert os.path.basename(tag_dir).startswith("global_step")
 
 
+def test_failed_save_leaves_previous_latest_loadable(tmp_path, monkeypatch):
+    """Crash-safe commit marker: a save that fails mid-write (simulated
+    np.save fault) must raise AND leave 'latest' pointing at the previous
+    fully-written tag — a restart resumes from it as if the failed save
+    never happened."""
+    engine = make_engine()
+    train(engine, 2)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    snap = [np.asarray(l) for l in jax.tree.leaves(engine.state.params)]
+
+    real_save = np.save
+    def exploding_save(fname, arr, *a, **kw):
+        raise IOError(f"disk full writing {fname}")
+    monkeypatch.setattr(np, "save", exploding_save)
+    train(engine, 1)
+    with pytest.raises(IOError):
+        engine.save_checkpoint(str(tmp_path), tag="torn")
+    monkeypatch.setattr(np, "save", real_save)
+
+    latest = (tmp_path / "latest").read_text().strip()
+    assert latest == "good", f"'latest' points at the failed tag {latest!r}"
+    assert not list(tmp_path.glob("latest.tmp*")), "torn temp file leaked"
+    engine2 = make_engine()
+    engine2.load_checkpoint(str(tmp_path))       # resolves via 'latest'
+    for a, b in zip(snap, jax.tree.leaves(engine2.state.params)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+
+
+def test_failed_async_save_never_commits_latest(tmp_path, monkeypatch):
+    """Async variant: shard-write errors surface on the join AND the
+    pending commit closure is dropped — a LATER save's join must not
+    publish the failed tag's 'latest' pointer."""
+    engine = make_engine()
+    train(engine, 2)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+
+    real_save = np.save
+    monkeypatch.setattr(np, "save",
+                        lambda *a, **kw: (_ for _ in ()).throw(
+                            IOError("injected write failure")))
+    engine.save_checkpoint(str(tmp_path), tag="torn", async_save=True)
+    with pytest.raises(IOError):
+        engine.wait_pending_checkpoint()
+    monkeypatch.setattr(np, "save", real_save)
+    # a subsequent good save must not resurrect the failed commit
+    engine.save_checkpoint(str(tmp_path), tag="good2")
+    assert (tmp_path / "latest").read_text().strip() == "good2"
+
+
+def test_checkpoint_writer_surfaces_ioerror_on_finalize():
+    """A writer thread hitting a bad path collects the error and
+    finalize() raises it (not silently dropped), with the worker thread
+    joined — no thread leaks out of a failed save."""
+    from deepspeed_tpu.runtime.checkpointing import CheckpointWriter
+
+    w = CheckpointWriter()
+    w.submit("/nonexistent-dir-xyz/leaf.npy", np.zeros(3))
+    with pytest.raises(IOError, match="checkpoint writes failed"):
+        w.finalize()
+    assert not w._thread.is_alive(), "failed finalize leaked the worker"
+    # a clean writer finalizes without error and also leaves no thread
+    w2 = CheckpointWriter()
+    w2.finalize()
+    assert not w2._thread.is_alive()
+
+
 def test_zero_to_fp32_offline_reconstruction(tmp_path):
     """zero_to_fp32 CLI role: rebuild full fp32 weights from shard files
     with no engine/mesh (reference utils/zero_to_fp32.py)."""

@@ -1,0 +1,501 @@
+"""Chaos suite for the serving fault-tolerance layer (ISSUE 5).
+
+Deterministic fault injection (serving/faults.py) drives the full stack
+on the CPU mesh: replicas crash/wedge mid-stream on schedule, the
+supervisor restarts them with backoff (or parks them via the circuit
+breaker), and every accepted request must still complete with greedy
+tokens byte-identical to an unfaulted run — the transparent-failover
+contract (docs/SERVING.md "Fault tolerance"). Queue-level brownout and
+the injector itself are unit-tested without engines.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.serving import (AdmissionQueue, FaultInjector,
+                                   FinishReason, InjectedFault, Priority,
+                                   Rejected, RequestState, ServingConfig,
+                                   ServingFrontend, serving_metrics)
+
+VOCAB = 128
+
+_model = None
+_params = None
+
+
+def tiny_engine(i=0):
+    """Fresh engine over a module-shared model/params (what an
+    ``engine_factory`` does in production: same weights, fresh KV)."""
+    global _model, _params
+    if _model is None:
+        _model = CausalLM(TransformerConfig(
+            vocab_size=VOCAB, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=2, max_seq_len=128, norm="rmsnorm",
+            activation="silu", position="rope"))
+    vcfg = RaggedInferenceEngineConfig(
+        max_ragged_batch_size=128, max_ragged_sequence_count=4,
+        max_chunk_tokens=32, kv_blocks=64, kv_block_size=8,
+        max_tracked_sequences=16)
+    eng = InferenceEngineV2(_model, params=_params, config=vcfg)
+    _params = eng.params
+    return eng
+
+
+def prompts(n, seed, lo=8, hi=24):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB, size=int(l)).tolist()
+            for l in rng.integers(lo, hi, size=n)]
+
+
+def greedy_reference(ps, max_new):
+    """Unfaulted single-replica run: the byte-parity baseline."""
+    fe = ServingFrontend([tiny_engine()], ServingConfig(max_queue_depth=64))
+    try:
+        hs = [fe.submit(p, max_new_tokens=max_new) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        return [[ev.token for ev in h.drain()] for h in hs]
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def ft_config(**over):
+    """Fast-recovery fault-tolerance block for tests."""
+    ft = {"enabled": True, "max_retries": 3, "restart_backoff_s": 0.05,
+          "restart_backoff_max_s": 0.2, "supervisor_poll_s": 0.02,
+          "restart_window_s": 60.0, "max_restarts_in_window": 5}
+    ft.update(over)
+    return ft
+
+
+def wait_metric(fe, name, value, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fe.metrics_snapshot().get(name, 0) >= value:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------------- injector
+def test_injector_schedule_fires_deterministically():
+    inj = FaultInjector([
+        {"kind": "crash", "replica": 0, "at_step": 2},
+        {"kind": "wedge", "replica": 1, "at_step": 0, "duration_s": 0.0,
+         "count": 2},
+    ])
+    inj.on_step(0, 0)
+    inj.on_step(0, 1)                      # below at_step: nothing
+    with pytest.raises(InjectedFault):
+        inj.on_step(0, 2)
+    inj.on_step(0, 3)                      # count=1: fired, now inert
+    inj.on_step(1, 0)                      # wedge (0s) fires twice, then
+    inj.on_step(1, 1)                      # stops
+    inj.on_step(1, 2)
+    fired = inj.fired_events()
+    assert [(k, r, i) for k, r, i, _ in fired] == [
+        ("crash", 0, 2), ("wedge", 1, 0), ("wedge", 1, 1)]
+
+
+def test_injector_seeded_step_range_is_reproducible():
+    sched = [{"kind": "crash", "replica": 0, "at_step_range": [2, 40]}]
+    a = FaultInjector(sched, seed=7)
+    b = FaultInjector(sched, seed=7)
+    c = FaultInjector(sched, seed=8)
+    assert a.events[0].at_step == b.events[0].at_step
+    assert 2 <= a.events[0].at_step <= 40
+    assert any(FaultInjector(sched, seed=s).events[0].at_step
+               != a.events[0].at_step for s in range(20)), \
+        "seed never changes the drawn step"
+
+
+def test_injector_count_zero_fires_every_time():
+    inj = FaultInjector([{"kind": "crash", "replica": 0, "at_step": 1,
+                          "count": 0}])
+    for step in (1, 2, 5):
+        with pytest.raises(InjectedFault):
+            inj.on_step(0, step)
+
+
+def test_injector_rejects_malformed_events():
+    with pytest.raises(ValueError):
+        FaultInjector([{"kind": "meteor", "replica": 0, "at_step": 0}])
+    with pytest.raises(ValueError):
+        FaultInjector([{"kind": "crash", "replica": 0}])      # no at_step
+    with pytest.raises(ValueError):
+        FaultInjector([{"kind": "put_error", "replica": 0}])  # no at_put
+
+
+def test_engine_proxy_injects_only_put_faults():
+    class Eng:
+        config = "cfg-sentinel"
+
+        def put(self, uids, chunks):
+            return ("ok", uids)
+
+    inj = FaultInjector([
+        {"kind": "put_error", "replica": 0, "at_put": 1},
+        {"kind": "slow_forward", "replica": 1, "at_put": 0,
+         "duration_s": 0.05},
+    ])
+    wrapped = inj.wrap_engine(Eng(), 0)
+    assert wrapped is not inj.wrap_engine(Eng(), 5), "sanity"
+    assert inj.wrap_engine(Eng(), 5).__class__ is Eng, \
+        "unfaulted replica must get the raw engine, not a proxy"
+    assert wrapped.config == "cfg-sentinel"          # delegation
+    assert wrapped.put([1], [[2]]) == ("ok", [1])    # put 0 clean
+    with pytest.raises(InjectedFault):
+        wrapped.put([1], [[2]])                      # put 1 injected
+    assert wrapped.put([1], [[2]]) == ("ok", [1])    # one-shot
+    slow = inj.wrap_engine(Eng(), 1)
+    t0 = time.monotonic()
+    slow.put([1], [[2]])
+    assert time.monotonic() - t0 >= 0.05             # latency injected
+
+
+# ------------------------------------------------------------- brownout
+def ServingRequest_(prompt, max_new, priority, deadline_s):
+    from deepspeed_tpu.serving import ServingRequest
+
+    return ServingRequest(prompt, max_new, priority, deadline_s, None)
+
+
+def test_brownout_sheds_lowest_urgency_queued_work():
+    reg = serving_metrics()
+    q = AdmissionQueue(max_depth=4, metrics=reg, brownout_threshold=0.5)
+    highs = [ServingRequest_([1] * 4, 4, Priority.HIGH, 60.0)
+             for _ in range(2)]
+    lows = [ServingRequest_([1] * 4, 4, Priority.LOW, None)
+            for _ in range(2)]
+    for r in highs + lows:
+        q.offer(r)
+    # half the fleet died: depth shrinks to ceil(4*0.4)=2, the two LOW/
+    # no-deadline requests are shed first — HIGHs survive untouched
+    q.set_healthy_fraction(0.4)
+    assert len(q) == 2
+    for r in lows:
+        assert r.state == RequestState.REJECTED
+        assert r.finish_reason == FinishReason.BROWNOUT
+    for r in highs:
+        assert r.state == RequestState.QUEUED
+    snap = reg.snapshot()
+    assert snap["requests_shed_brownout"] == 2
+    assert snap["brownout_active"] == 1.0
+    # recovery: full depth again, gauge drops
+    q.set_healthy_fraction(1.0)
+    assert reg.snapshot()["brownout_active"] == 0.0
+    q.offer(ServingRequest_([1] * 4, 4, Priority.LOW, None))
+    assert len(q) == 3
+
+
+def test_brownout_offer_displaces_less_urgent_or_sheds_incoming():
+    reg = serving_metrics()
+    q = AdmissionQueue(max_depth=4, metrics=reg, brownout_threshold=0.6)
+    q.set_healthy_fraction(0.5)          # effective depth 2
+    low = ServingRequest_([1] * 4, 4, Priority.LOW, None)
+    norm = ServingRequest_([1] * 4, 4, Priority.NORMAL, 60.0)
+    q.offer(low)
+    q.offer(norm)
+    # a HIGH arrival outranks the queued LOW: LOW is displaced
+    high = ServingRequest_([1] * 4, 4, Priority.HIGH, 10.0)
+    q.offer(high)
+    assert low.state == RequestState.REJECTED
+    assert low.finish_reason == FinishReason.BROWNOUT
+    assert len(q) == 2
+    # another LOW arrival outranks nothing queued: it is the one shed
+    with pytest.raises(Rejected) as ei:
+        q.offer(ServingRequest_([1] * 4, 4, Priority.LOW, None))
+    assert ei.value.reason == FinishReason.BROWNOUT
+    # failover requeue stays exempt even in brownout (admitted work)
+    retried = ServingRequest_([1] * 4, 4, Priority.LOW, None)
+    assert q.requeue(retried) is True
+    assert len(q) == 3
+
+
+def test_brownout_never_evicts_failover_requeued_work():
+    """A retried request (attempts > 1) already streamed tokens on a
+    replica that died; brownout victim selection must skip it — both the
+    shrink sweep and offer-time displacement — or failover would not be
+    lossless exactly when capacity is degraded."""
+    reg = serving_metrics()
+    q = AdmissionQueue(max_depth=4, metrics=reg, brownout_threshold=0.6)
+    retried = [ServingRequest_([1] * 4, 4, Priority.LOW, None)
+               for _ in range(2)]
+    for r in retried:
+        r.attempts = 2
+        assert q.requeue(r)
+    fresh = ServingRequest_([1] * 4, 4, Priority.LOW, None)
+    q.offer(fresh)
+    # shrink to effective depth 2 (3 queued): only the FRESH low goes
+    q.set_healthy_fraction(0.5)
+    assert fresh.state == RequestState.REJECTED
+    assert fresh.finish_reason == FinishReason.BROWNOUT
+    assert all(r.state == RequestState.QUEUED for r in retried)
+    # offer-time displacement: a HIGH arrival cannot displace retried
+    # LOWs — with no sheddable victim the incoming request is admitted
+    # (depth-exempt, like requeue itself)
+    high = ServingRequest_([1] * 4, 4, Priority.HIGH, 10.0)
+    q.offer(high)
+    assert all(r.state == RequestState.QUEUED for r in retried)
+    assert len(q) == 3
+
+
+# --------------------------------------------------- end-to-end failover
+def test_crash_failover_resumes_stream_losslessly():
+    """Single supervised replica, crash mid-decode: the in-flight streams
+    splice across the restart — one uninterrupted, byte-identical token
+    stream per request, with attempts > 1 visible on the handle."""
+    ps = prompts(3, seed=1)
+    ref = greedy_reference(ps, max_new=6)
+    scfg = ServingConfig(
+        max_queue_depth=32, fault_tolerance=ft_config(),
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 3}]})
+    fe = ServingFrontend([tiny_engine()], scfg, engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=6) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        assert all(h.state == RequestState.FINISHED for h in hs)
+        got = []
+        for h in hs:
+            evs = [ev for ev in h.drain()]
+            # spliced stream: contiguous indexes, no duplicates/gaps
+            assert [ev.index for ev in evs] == list(range(len(evs)))
+            got.append([ev.token for ev in evs])
+        assert got == ref, "failover resume broke greedy byte-parity"
+        assert any(h.attempts > 1 for h in hs), \
+            "crash at step 3 failed over nothing"
+        snap = fe.metrics_snapshot()
+        assert snap["requests_failed_over"] >= 1
+        assert snap["replica_restarts"] == 1
+        assert snap["requests_failed"] == 0
+        assert fe.supervisor.restart_log[0]["recovery_s"] > 0
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_chaos_kill_one_of_two_replicas_mid_burst(tmp_path):
+    """The acceptance scenario: 2 replicas, fault injection kills one
+    mid-stream. Every accepted request completes with byte-identical
+    greedy tokens vs an unfaulted run, the dead replica is restarted
+    (replica_restarts + a flight-recorder dump), and service never
+    deadlocks."""
+    ps = prompts(8, seed=2)
+    ref = greedy_reference(ps, max_new=6)
+    scfg = ServingConfig(
+        max_queue_depth=32, fault_tolerance=ft_config(),
+        telemetry={"enabled": True, "dump_dir": str(tmp_path)},
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 3}]})
+    fe = ServingFrontend([tiny_engine(), tiny_engine()], scfg,
+                         engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=6) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        assert all(h.state == RequestState.FINISHED for h in hs)
+        got = [[ev.token for ev in h.drain()] for h in hs]
+        assert got == ref, "chaos run lost greedy byte-parity"
+        snap = fe.metrics_snapshot()
+        assert snap["replica_restarts"] >= 1
+        assert snap["requests_failed"] == 0
+        assert snap["replicas_parked"] == 0
+        # flight recorder: the replica death and/or the restart dumped
+        dumps = list(tmp_path.glob("flightrec_*.json"))
+        assert dumps, "no flight-recorder dump for the chaos incident"
+        assert any("restart" in p.name or "error" in p.name
+                   for p in dumps)
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_put_error_injection_takes_engine_fault_path():
+    """`engine.put` raising (proxy injection) must look exactly like a
+    real engine fault: replica dies, requests fail over, service
+    recovers."""
+    ps = prompts(2, seed=3)
+    ref = greedy_reference(ps, max_new=4)
+    scfg = ServingConfig(
+        max_queue_depth=16, fault_tolerance=ft_config(),
+        faults={"enabled": True, "schedule": [
+            {"kind": "put_error", "replica": 0, "at_put": 2}]})
+    fe = ServingFrontend([tiny_engine()], scfg, engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=4) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        assert [[ev.token for ev in h.drain()] for h in hs] == ref
+        assert fe.metrics_snapshot()["replica_restarts"] == 1
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_circuit_breaker_parks_repeatedly_crashing_replica():
+    """Replica 0 crashes every time it works; after max_restarts_in_window
+    crashes the slot is parked (no restart loop), capacity_alarm raises,
+    and the surviving replica keeps serving — shed load, no deadlock."""
+    ps = prompts(4, seed=4)
+    scfg = ServingConfig(
+        max_queue_depth=32,
+        fault_tolerance=ft_config(max_restarts_in_window=2, max_retries=5),
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 0, "count": 0}]})
+    fe = ServingFrontend([tiny_engine(), tiny_engine()], scfg,
+                         engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=4) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        assert all(h.state == RequestState.FINISHED for h in hs)
+        assert wait_metric(fe, "replica_restarts", 1), \
+            "first crash never produced a restart"
+        # second wave: the restarted (idle, least-loaded) replica 0 takes
+        # work again, crashes again → circuit breaker parks the slot
+        late = [fe.submit(p, max_new_tokens=4) for p in prompts(4, seed=5)]
+        assert fe.wait_all(late, timeout=300)
+        assert all(h.state == RequestState.FINISHED for h in late)
+        assert wait_metric(fe, "replicas_parked", 1), \
+            "crashing replica was never parked"
+        snap = fe.metrics_snapshot()
+        assert snap["capacity_alarm"] == 1.0
+        assert snap["replica_restarts"] == 1      # 2nd crash parks
+        # the parked fleet still serves new traffic on the survivor
+        tail = [fe.submit(p, max_new_tokens=4) for p in prompts(3, seed=12)]
+        assert fe.wait_all(tail, timeout=300)
+        assert all(h.state == RequestState.FINISHED for h in tail)
+        assert all(h._req.replica_id == 1 for h in tail)
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_failover_bounded_by_max_retries():
+    """A request whose every attempt dies is failed terminally once
+    max_retries is exhausted — retry storms are bounded."""
+    scfg = ServingConfig(
+        max_queue_depth=16,
+        fault_tolerance=ft_config(max_retries=1, max_restarts_in_window=10),
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 0, "count": 0}]})
+    fe = ServingFrontend([tiny_engine()], scfg, engine_factory=tiny_engine)
+    try:
+        h = fe.submit(prompts(1, seed=6)[0], max_new_tokens=4)
+        assert h._req.wait(120), "request never reached a terminal state"
+        assert h.state == RequestState.FAILED
+        assert h.finish_reason == FinishReason.ERROR
+        assert h.attempts == 2               # 1 original + 1 retry
+        assert fe.metrics_snapshot()["requests_failed_over"] == 1
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_all_parked_fails_queued_and_new_requests_fast():
+    """Once every slot is parked nothing is coming back: queued work is
+    failed with "no_replicas" (not left to rot until its deadline) and
+    new submissions fail fast the same way."""
+    scfg = ServingConfig(
+        max_queue_depth=16,
+        fault_tolerance=ft_config(max_restarts_in_window=1, max_retries=5),
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 0, "count": 0}]})
+    fe = ServingFrontend([tiny_engine()], scfg, engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=4) for p in prompts(3, seed=7)]
+        assert fe.wait_all(hs, timeout=120), \
+            "parked fleet left requests hanging"
+        assert all(h.state == RequestState.FAILED for h in hs)
+        assert all(h.finish_reason == FinishReason.NO_REPLICAS
+                   for h in hs)
+        assert wait_metric(fe, "replicas_parked", 1)
+        h = fe.submit(prompts(1, seed=8)[0], max_new_tokens=4)
+        assert h._req.wait(60)
+        assert h.finish_reason == FinishReason.NO_REPLICAS
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_wedge_recovers_on_fresh_engine():
+    """A wedged replica (worker blocked in a 'device call') is detected
+    by the watchdog, its requests fail over, and the supervisor restarts
+    the slot on a FRESH engine (the stuck thread owns the old one)."""
+    ps = prompts(2, seed=9)
+    ref = greedy_reference(ps, max_new=4)
+    # wedge_timeout_s must stay ABOVE the worst single-step XLA compile
+    # (the documented sizing rule) or the watchdog kills the restarted
+    # replica mid-compile; ~1s/step on this tiny CPU model → 2.5s budget
+    scfg = ServingConfig(
+        max_queue_depth=16, wedge_timeout_s=2.5,
+        fault_tolerance=ft_config(),
+        faults={"enabled": True, "schedule": [
+            {"kind": "wedge", "replica": 0, "at_step": 1,
+             "duration_s": 6.0}]})
+    fe = ServingFrontend([tiny_engine()], scfg, engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=4) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        assert all(h.state == RequestState.FINISHED for h in hs)
+        assert [[ev.token for ev in h.drain()] for h in hs] == ref
+        assert fe.metrics_snapshot()["replica_restarts"] >= 1
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+@pytest.mark.slow
+def test_wedge_without_engine_factory_parks_slot():
+    """No engine factory + a worker thread still stuck inside the engine:
+    the supervisor must refuse to reuse that engine (two threads, one KV
+    pool) and park the slot instead — safety beats availability."""
+    scfg = ServingConfig(
+        max_queue_depth=16, wedge_timeout_s=0.2,
+        fault_tolerance=ft_config(max_retries=1),
+        faults={"enabled": True, "schedule": [
+            {"kind": "wedge", "replica": 0, "at_step": 1,
+             "duration_s": 8.0}]})
+    fe = ServingFrontend([tiny_engine()], scfg)   # NO engine_factory
+    try:
+        h = fe.submit(prompts(1, seed=10)[0], max_new_tokens=4)
+        assert h._req.wait(120), "wedged fleet left the request hanging"
+        assert h.state == RequestState.FAILED
+        assert wait_metric(fe, "replicas_parked", 1, timeout=30), \
+            "unsalvageable slot was not parked"
+        assert fe.metrics_snapshot()["replica_restarts"] == 0
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_faults_disabled_is_byte_identical_and_unhooked():
+    """faults.enabled=false (default): no injector, no engine proxy, no
+    failover callback when fault_tolerance is also off — byte-for-byte
+    the old serving behavior."""
+    eng = tiny_engine()
+    fe = ServingFrontend([eng], ServingConfig(max_queue_depth=16))
+    try:
+        assert fe.injector is None
+        assert fe.supervisor is None
+        assert fe.router.replicas[0].engine is eng      # no proxy
+        assert fe.router.replicas[0]._on_failover is None
+        ps = prompts(2, seed=11)
+        hs = [fe.submit(p, max_new_tokens=4) for p in ps]
+        assert fe.wait_all(hs, timeout=300)
+        got = [[ev.token for ev in h.drain()] for h in hs]
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+    assert got == greedy_reference(ps, max_new=4)
+
+
+def test_brownout_wired_from_fault_tolerance_config():
+    scfg = ServingConfig(max_queue_depth=8,
+                         fault_tolerance=ft_config(brownout_threshold=0.5))
+    fe = ServingFrontend([tiny_engine()], scfg)
+    try:
+        assert fe.admission.brownout_threshold == 0.5
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+    # ft disabled → brownout stays off even if a threshold is set
+    fe2 = ServingFrontend([tiny_engine()], ServingConfig(
+        max_queue_depth=8,
+        fault_tolerance={"enabled": False, "brownout_threshold": 0.5}))
+    try:
+        assert fe2.admission.brownout_threshold == 0.0
+    finally:
+        fe2.shutdown(drain=False, timeout=5)

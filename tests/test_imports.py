@@ -48,6 +48,8 @@ MODULES = [
     "deepspeed_tpu.sequence.layer",
     "deepspeed_tpu.sequence.ring_attention",
     "deepspeed_tpu.serving",
+    "deepspeed_tpu.serving.faults",
+    "deepspeed_tpu.serving.supervisor",
     "deepspeed_tpu.telemetry",
     "deepspeed_tpu.telemetry.flight_recorder",
     "deepspeed_tpu.utils.comms_logging",

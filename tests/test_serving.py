@@ -388,3 +388,118 @@ def test_queue_remove_frees_slot():
     assert len(q) == 1
     q.offer(_req())                      # freed slot is usable again
     assert q.pop(timeout=0.5) is b
+
+
+def test_pop_sweeps_expired_entries_anywhere_in_heap():
+    """Deadline sweep at pop time covers the WHOLE heap: an expired LOW
+    request buried under fresh HIGH traffic is failed with DEADLINE on
+    the next pop instead of occupying a depth slot until it surfaces."""
+    reg = serving_metrics()
+    q = AdmissionQueue(max_depth=10, metrics=reg)
+    buried = _req(priority=Priority.LOW, deadline_s=0.01)
+    tops = [_req(priority=Priority.HIGH, deadline_s=60.0) for _ in range(3)]
+    q.offer(buried)
+    for r in tops:
+        q.offer(r)
+    time.sleep(0.05)
+    got = q.pop(timeout=0.5)
+    assert got is tops[0]                # urgency order unchanged
+    # the buried request was swept by that same pop, not left queued
+    assert buried.state == RequestState.EXPIRED
+    assert buried.finish_reason == FinishReason.DEADLINE
+    assert buried.wait(0)                # stream terminated
+    assert len(q) == 2
+    assert reg.snapshot()["requests_expired"] == 1
+
+
+# ------------------------------------------------------ replica lifecycle
+class _CompletingFakeEngine(_FakeEngine):
+    """Fake engine whose scheduler path actually completes requests:
+    constant logits, every chunk schedulable — enough surface to run the
+    worker loop end-to-end without JAX."""
+
+    def can_schedule(self, uids, lengths):
+        from deepspeed_tpu.inference.v2.scheduling_utils import (
+            SchedulingResult)
+
+        return SchedulingResult.Success
+
+    def put(self, uids, chunks, **kw):
+        import numpy as np
+
+        return np.zeros((len(uids), 8), dtype=np.float32)
+
+    def match_prefix(self, uid, prompt_tokens):
+        return 0
+
+
+def test_check_health_on_draining_replica():
+    """DRAINING is not DEAD: check_health reports it untouched while the
+    replica makes progress, but a WEDGED draining replica still crosses
+    to DEAD (drain must not disable the watchdog)."""
+    from deepspeed_tpu.serving import ReplicaState
+    from deepspeed_tpu.serving.replica import Replica
+
+    r = Replica(0, _FakeEngine(), wedge_timeout_s=0.01)
+    r.drain()
+    assert r.check_health() == ReplicaState.DRAINING
+    # now simulate a wedge while draining: watchdog still fires
+    r._steps_done = 1
+    r._busy_since = time.monotonic() - 1.0
+    r.last_progress_t = time.monotonic() - 1.0
+    assert r.check_health() == ReplicaState.DEAD
+
+
+def test_assign_racing_drain():
+    """assign() after drain() refuses; an assign that WON the race (the
+    request entered the inbox before DRAINING) still runs to completion
+    — drain finishes accepted work, it never drops it."""
+    from deepspeed_tpu.serving import ReplicaState
+    from deepspeed_tpu.serving.replica import Replica
+
+    reg = serving_metrics()
+    r = Replica(0, _CompletingFakeEngine(), reg)
+    won = _req(prompt_len=3, max_new=2)
+    assert r.assign(won) is True         # accepted while HEALTHY
+    r.drain()
+    lost = _req()
+    assert r.assign(lost) is False       # refused while DRAINING
+    assert lost.state == RequestState.QUEUED   # untouched, router retries
+    r.start()
+    assert won.wait(10), "drain dropped an accepted request"
+    assert won.state == RequestState.FINISHED
+    r.thread.join(10)
+    assert r.state == ReplicaState.STOPPED     # drained to completion
+    r.stop(1.0)
+
+
+def test_double_stop_idempotent():
+    from deepspeed_tpu.serving import ReplicaState
+    from deepspeed_tpu.serving.replica import Replica
+
+    r = Replica(0, _CompletingFakeEngine(), serving_metrics())
+    r.start()
+    r.stop(2.0)
+    assert r.state == ReplicaState.STOPPED
+    r.stop(2.0)                          # second stop: no-op, no raise
+    assert r.state == ReplicaState.STOPPED
+    assert not r.thread.is_alive()
+
+
+def test_fault_tolerance_config_in_runtime_config():
+    from deepspeed_tpu.runtime.config import load_config
+
+    cfg = load_config({"serving": {
+        "fault_tolerance": {"enabled": True, "max_retries": 5,
+                            "brownout_threshold": 0.5},
+        "faults": {"enabled": True, "seed": 7, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 3}]}}})
+    ft = cfg.serving.fault_tolerance
+    assert ft.enabled and ft.max_retries == 5
+    assert ft.brownout_threshold == 0.5
+    inj = cfg.serving.faults.build_injector()
+    assert inj is not None and inj.events[0].at_step == 3
+    # defaults: both off, injector not built
+    dflt = load_config({}).serving
+    assert not dflt.fault_tolerance.enabled
+    assert dflt.faults.build_injector() is None
