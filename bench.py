@@ -100,6 +100,193 @@ def detect_peak():
     return PEAK_FLOPS["v5e" if dev.platform == "tpu" else "cpu"]
 
 
+def _is_backend_loss(exc: BaseException) -> bool:
+    """Does this exception smell like the TPU backend died under us (the
+    r3/r4 failure mode: axon tunnel UNAVAILABLE / dead device)? Backend
+    loss is terminal for the process — later phases are skipped with an
+    explicit stamp instead of each burning their full budget."""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(s in text for s in (
+        "UNAVAILABLE", "Unable to initialize backend",
+        "failed to connect", "Device or resource busy",
+        "TPU is DEAD", "DEADLINE_EXCEEDED", "Socket closed"))
+
+
+class PhaseRunner:
+    """Phase-resumable serving bench (the ROADMAP prerequisite: the
+    official perf trajectory has been blind since round 3 because one
+    wedged phase erased the whole serving JSON).
+
+    Each phase runs under its own wall-clock budget on a daemon worker;
+    a phase that exceeds it, or raises, degrades to an explicit
+    ``{"phase_skipped": reason}`` stamp instead of sinking the run.
+    Backend loss (``_is_backend_loss``) short-circuits every later phase
+    with a stamped reason, and so does a budget timeout: the abandoned
+    worker may still be running against shared engine state, so later
+    phases would race it — they skip with a "prior phase wedged" stamp
+    and the next ``BENCH_RESUME=1`` run (fresh process, cached
+    artifacts) picks up exactly where this one stopped. Completed
+    phase results are written to per-phase artifact files
+    (``$BENCH_PHASE_DIR``, default ``./bench_phases``) and merged back
+    into the final JSON; ``BENCH_RESUME=1`` loads cached artifacts so a
+    rerun only executes what's missing. ``BENCH_PHASES=a,b`` restricts
+    the run to named phases (the tier-1 smoke knob — scripts/tier1.sh
+    ``TIER1_PHASE``). Every phase result is stamped with the engine's
+    KV-pool occupancy snapshot."""
+
+    def __init__(self, stamp=None):
+        self.artifact_dir = os.environ.get(
+            "BENCH_PHASE_DIR", os.path.join(os.getcwd(), "bench_phases"))
+        self.resume = os.environ.get("BENCH_RESUME", "") not in ("", "0")
+        try:
+            self.budget_s = float(os.environ.get("BENCH_PHASE_TIMEOUT_S",
+                                                 "240") or 0)
+        except ValueError:
+            self.budget_s = 240.0
+        only = os.environ.get("BENCH_PHASES", "")
+        self.only = ({p.strip() for p in only.split(",") if p.strip()}
+                     or None)
+        self.stamp = stamp
+        self.backend_lost = None
+        self.wedged = None      # name of a phase whose worker we abandoned
+
+    def _artifact(self, name):
+        if not self.artifact_dir:
+            return None
+        try:
+            os.makedirs(self.artifact_dir, exist_ok=True)
+        except OSError:
+            return None
+        return os.path.join(self.artifact_dir, f"phase_{name}.json")
+
+    def _attempt(self, fn):
+        box = {}
+
+        def work():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — stamped, not lost
+                box["error"] = e
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        th.join(self.budget_s if self.budget_s > 0 else None)
+        if th.is_alive():
+            return None, TimeoutError(
+                f"phase budget {self.budget_s:.0f}s exceeded")
+        return box.get("result"), box.get("error")
+
+    def run(self, name, fn):
+        if self.only is not None and name not in self.only:
+            return {"phase_skipped": "not selected (BENCH_PHASES)"}
+        art = self._artifact(name)
+        if self.resume and art and os.path.exists(art):
+            try:
+                with open(art) as fh:
+                    cached = json.load(fh)
+                cached["phase_cached"] = True
+                return cached
+            except (OSError, ValueError):
+                pass                    # corrupt artifact: re-run the phase
+        if self.backend_lost:
+            out = {"phase_skipped":
+                   f"tpu_backend_lost: {self.backend_lost}"}
+        elif self.wedged:
+            # the abandoned worker may still be mutating shared engine
+            # state — running more phases in this process would race it
+            out = {"phase_skipped":
+                   f"prior phase wedged ({self.wedged}); "
+                   "rerun with BENCH_RESUME=1"}
+        else:
+            result, err = self._attempt(fn)
+            if err is None:
+                out = result if isinstance(result, dict) else {"value": result}
+            else:
+                # no blind retry: a failed attempt may have half-mutated
+                # shared engine state, and a rerun over that could
+                # SUCCEED with silently wrong numbers — a skip stamp is
+                # the honest record (BENCH_RESUME re-runs it fresh)
+                msg = f"{type(err).__name__}: {str(err)[:200]}"
+                if _is_backend_loss(err):
+                    self.backend_lost = msg
+                    msg = f"tpu_backend_lost: {msg}"
+                elif isinstance(err, TimeoutError):
+                    self.wedged = name
+                out = {"phase_skipped": msg}
+        if self.stamp is not None:
+            try:
+                out.setdefault("kv_occupancy", self.stamp())
+            except Exception:
+                pass
+        if art and "phase_skipped" not in out:
+            # only COMPLETED phases are cached — caching a skip stamp
+            # would make BENCH_RESUME replay the skip instead of
+            # re-running the phase
+            try:
+                tmp = art + ".tmp"
+                with open(tmp, "w") as fh:
+                    json.dump(out, fh, default=str)
+                os.replace(tmp, art)
+            except (OSError, TypeError, ValueError):
+                pass                    # artifacts are best-effort
+        return out
+
+
+# Typed shape of the serving-bench JSON pieces this round's gates read.
+# ``validate_serving_schema`` is run by bench itself (the result carries
+# ``schema_problems``) and asserted by tests/test_kv_quant.py.
+_OCCUPANCY_KEYS = ("total_blocks", "free_blocks", "in_use_blocks",
+                   "bytes_per_block", "bytes_in_use", "bytes_total",
+                   "evictable_blocks", "available_blocks")
+_KV_QUANT_KEYS = (("max_concurrent_base", int),
+                  ("max_concurrent_int8", int),
+                  ("concurrency_ratio", (int, float)),
+                  ("budget_bytes", int),
+                  ("ppl_base", (int, float)),
+                  ("ppl_int8", (int, float)),
+                  ("ppl_ratio", (int, float)),
+                  ("ppl_gate_ok", bool),
+                  ("greedy_parity", bool),
+                  ("mean_matched_prefix_frac", (int, float)),
+                  ("disabled_parity", bool))
+_STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
+                   "telemetry", "chaos", "kv_quant")
+
+
+def validate_serving_schema(serving: dict):
+    """Assert the kv_quant phase fields and per-phase occupancy stamps
+    are present and correctly typed; returns a list of problems (empty =
+    schema holds). Skipped phases (``phase_skipped``) are exempt from
+    field checks but must still be dicts."""
+    problems = []
+    kq = serving.get("kv_quant")
+    if not isinstance(kq, dict):
+        problems.append("kv_quant: missing or not an object")
+    elif "phase_skipped" not in kq:
+        for key, types in _KV_QUANT_KEYS:
+            if key not in kq:
+                problems.append(f"kv_quant.{key}: missing")
+            elif not isinstance(kq[key], types):
+                problems.append(f"kv_quant.{key}: "
+                                f"{type(kq[key]).__name__}")
+    for name in _STAMPED_PHASES:
+        ph = serving.get(name)
+        if not isinstance(ph, dict):
+            problems.append(f"{name}: missing or not an object")
+            continue
+        if "phase_skipped" in ph:
+            continue            # a skip stamp IS the phase's record
+        occ = ph.get("kv_occupancy")
+        if not isinstance(occ, dict):
+            problems.append(f"{name}.kv_occupancy: missing")
+            continue
+        for key in _OCCUPANCY_KEYS:
+            if not isinstance(occ.get(key), int):
+                problems.append(f"{name}.kv_occupancy.{key}: "
+                                f"{type(occ.get(key)).__name__}")
+    return problems
+
+
 def bench_serving(on_tpu: bool):
     """FastGen-equivalent serving bench on the v2 ragged engine: p50 TTFT
     (prefill via SplitFuse chunks) + batched decode tokens/sec, exercising
@@ -584,22 +771,152 @@ def bench_serving(on_tpu: bool):
             "parity": gens_chaos == gens_ok,
         }
 
-    run_phase(10_000)                   # warmup: compile all shape buckets
-    ttfts, decode_tps = run_phase(20_000)
-    run_ragged_phase(30_000, lens, target_active, decode_budget)  # warmup
-    rag_ttfts, rag_tps = run_ragged_phase(50_000, lens, target_active,
-                                          decode_budget)
-    frontend = run_frontend_phase()
-    prefix = run_prefix_phase()
-    spec = run_spec_phase()
-    telemetry = run_telemetry_phase()
-    chaos = run_chaos_phase()
-    return {
-        "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
-        "decode_tokens_per_sec": round(decode_tps, 1),
-        "n_seqs": n_seqs,
-        "prompt_len": prompt_len,
-        "ragged": {
+    def run_kv_quant_phase():
+        """int8 KV-cache quantization (docs/SERVING.md "KV quantization"):
+        at a FIXED KV-pool byte budget, int8 blocks cost ~half the bytes
+        of bf16 (a quarter of fp32), so the same HBM buys ~2x (~4x) the
+        blocks — measured as the peak number of sequences the scheduler
+        actually keeps decoding concurrently, same workload both ways.
+        Quality gates: teacher-forced perplexity ratio vs the
+        unquantized engine (<= 1.05) and greedy-token divergence
+        (parity-or-bounded, reported), plus a byte-identical check of
+        the disabled path."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.kv_quant import kv_bytes_per_block
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+        from deepspeed_tpu.inference.v2.testing import greedy_generate
+
+        bs = vcfg.kv_block_size
+        if on_tpu:
+            plen, gen, budget_blocks, nll_chunk = 256, 32, 40, 64
+        else:
+            plen, gen, budget_blocks, nll_chunk = 24, 8, 16, 16
+        bpb = {False: kv_bytes_per_block(cfg, bs, quant=False),
+               True: kv_bytes_per_block(cfg, bs, quant=True)}
+        budget_bytes = budget_blocks * bpb[False]
+        nb = {False: budget_blocks, True: budget_bytes // bpb[True]}
+        blocks_per_seq = -(-(plen + gen) // bs)
+        # one workload for both runs, sized past the int8 capacity so the
+        # KV pool — not the arrival pattern — is the binding constraint
+        n_req = nb[True] // blocks_per_seq + 4
+        kq_prompts = [rng.integers(0, cfg.vocab_size, size=plen).tolist()
+                      for _ in range(n_req)]
+
+        def build(quant, n_blocks):
+            pcfg = type(vcfg)(**vars(vcfg))
+            pcfg.kv_quant_enabled = quant
+            pcfg.kv_blocks = int(n_blocks)
+            # admission must be KV-bound: lift the row/token ceilings
+            # past anything the pool could admit
+            pcfg.max_ragged_sequence_count = n_req + 1
+            pcfg.max_tracked_sequences = n_req + 1
+            pcfg.max_ragged_batch_size = max(pcfg.max_ragged_batch_size,
+                                             n_req + pcfg.max_chunk_tokens)
+            return InferenceEngineV2(engine.model, params=engine.params,
+                                     config=pcfg)
+
+        def peak_concurrency(quant, uid_base):
+            eng = build(quant, nb[quant])
+            sched = ContinuousBatchingScheduler(eng)
+            for i, p in enumerate(kq_prompts):
+                sched.submit(uid_base + i, p, max_new_tokens=gen)
+            peak_running = peak_blocks = steps = 0
+            while sched.has_work and steps < 20000:
+                sched.step()
+                steps += 1
+                peak_running = max(peak_running, len(sched.running))
+                peak_blocks = max(peak_blocks,
+                                  eng.occupancy()["in_use_blocks"])
+            done = sum(1 for r in sched.finished.values()
+                       if r.finish_reason in ("length", "eos"))
+            return peak_running, peak_blocks, done
+
+        peak_base, blocks_base, done_base = peak_concurrency(False, 110_000)
+        peak_int8, blocks_int8, done_int8 = peak_concurrency(True, 120_000)
+
+        # teacher-forced NLL over one held-out sequence (verify_width
+        # logits give every position's next-token distribution)
+        nll_toks = rng.integers(0, cfg.vocab_size,
+                                size=4 * nll_chunk).tolist()
+
+        def seq_nll(quant, uid):
+            eng = build(quant, nb[quant])
+            total, count = 0.0, 0
+            for lo in range(0, len(nll_toks), nll_chunk):
+                ch = nll_toks[lo:lo + nll_chunk]
+                logits = np.asarray(
+                    eng.put([uid], [ch], verify_width=len(ch)))[0]
+                for j in range(len(ch)):
+                    t = lo + j + 1
+                    if t >= len(nll_toks):
+                        break
+                    row = logits[j].astype(np.float64)
+                    m = row.max()
+                    lse = m + np.log(np.exp(row - m).sum())
+                    total += lse - row[nll_toks[t]]
+                    count += 1
+            eng.flush(uid)
+            return total / count
+
+        ppl_base = float(np.exp(seq_nll(False, 130_000)))
+        ppl_int8 = float(np.exp(seq_nll(True, 131_000)))
+        ppl_ratio = ppl_int8 / ppl_base
+
+        # greedy divergence (parity-or-bounded) + disabled byte-parity
+        par_prompts = kq_prompts[:4]
+        gens_base = greedy_generate(build(False, nb[False]), par_prompts,
+                                    uid_base=140_000, max_new_tokens=gen)
+        gens_int8 = greedy_generate(build(True, nb[True]), par_prompts,
+                                    uid_base=140_000, max_new_tokens=gen)
+        gens_off = greedy_generate(build(False, nb[False]), par_prompts,
+                                   uid_base=140_000, max_new_tokens=gen)
+        fracs = []
+        for a, b in zip(gens_base, gens_int8):
+            matched = next((i for i, (x, y) in enumerate(zip(a, b))
+                            if x != y), min(len(a), len(b)))
+            fracs.append(matched / max(1, len(a)))
+        return {
+            "budget_bytes": int(budget_bytes),
+            "base_dtype": str(np.dtype(cfg.dtype).name
+                              if cfg.dtype != jnp.bfloat16 else "bfloat16"),
+            "bytes_per_block": {"base": int(bpb[False]),
+                                "int8": int(bpb[True])},
+            "kv_blocks": {"base": int(nb[False]), "int8": int(nb[True])},
+            "blocks_per_seq": int(blocks_per_seq),
+            "n_requests": int(n_req),
+            "prompt_len": int(plen),
+            "max_new_tokens": int(gen),
+            "max_concurrent_base": int(peak_base),
+            "max_concurrent_int8": int(peak_int8),
+            "concurrency_ratio": round(peak_int8 / max(1, peak_base), 3),
+            "peak_blocks_in_use": {"base": int(blocks_base),
+                                   "int8": int(blocks_int8)},
+            "all_completed": bool(done_base == n_req == done_int8),
+            "ppl_base": round(ppl_base, 4),
+            "ppl_int8": round(ppl_int8, 4),
+            "ppl_ratio": round(ppl_ratio, 5),
+            "ppl_gate_ok": bool(abs(ppl_ratio - 1.0) <= 0.05),
+            "greedy_parity": bool(gens_base == gens_int8),
+            "mean_matched_prefix_frac": round(float(np.mean(fracs)), 4),
+            "disabled_parity": bool(gens_base == gens_off),
+        }
+
+    def run_base_phase():
+        run_phase(10_000)               # warmup: compile all shape buckets
+        ttfts, decode_tps = run_phase(20_000)
+        return {
+            "p50_ttft_ms": round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+            "decode_tokens_per_sec": round(decode_tps, 1),
+            "n_seqs": n_seqs,
+            "prompt_len": prompt_len,
+        }
+
+    def run_ragged_wrapped():
+        run_ragged_phase(30_000, lens, target_active, decode_budget)  # warm
+        rag_ttfts, rag_tps = run_ragged_phase(50_000, lens, target_active,
+                                              decode_budget)
+        return {
             "p50_ttft_ms": round(float(np.percentile(rag_ttfts, 50))
                                  * 1e3, 2),
             "p90_ttft_ms": round(float(np.percentile(rag_ttfts, 90))
@@ -609,24 +926,36 @@ def bench_serving(on_tpu: bool):
             "target_active": target_active,
             "decode_budget": decode_budget,
             "prompt_lens": sorted(lens),
-        },
-        # serving/ subsystem numbers (metrics registry, docs/SERVING.md)
-        "frontend": frontend,
-        # shared-prefix KV reuse phase (docs/SERVING.md "Prefix caching")
-        "prefix": prefix,
-        # speculative decoding phase (docs/SERVING.md "Speculative
-        # decoding"): TPOT + tokens-per-forward, n-gram proposer on/off
-        "speculative": spec,
-        # unified-telemetry phase (docs/OBSERVABILITY.md): tracing
-        # overhead on/off vs the noise floor, greedy parity, a schema-
-        # validated Chrome-trace artifact + flight-recorder dump paths,
-        # and span coverage of measured TTFT
-        "telemetry": telemetry,
-        # fault-tolerance chaos phase (docs/SERVING.md "Fault
-        # tolerance"): kill 1 of 2 replicas mid-burst — recovery time,
-        # retry success rate (1.0 for greedy), greedy parity vs unfaulted
-        "chaos": chaos,
-    }
+        }
+
+    # phase-resumable dispatch: per-phase budgets + artifact cache +
+    # skip/degrade stamps (PhaseRunner docstring); every result carries
+    # the shared engine's KV occupancy snapshot
+    runner = PhaseRunner(stamp=lambda: engine.occupancy())
+    result = {}
+    result.update(runner.run("base", run_base_phase))
+    result["ragged"] = runner.run("ragged", run_ragged_wrapped)
+    # serving/ subsystem numbers (metrics registry, docs/SERVING.md)
+    result["frontend"] = runner.run("frontend", run_frontend_phase)
+    # shared-prefix KV reuse phase (docs/SERVING.md "Prefix caching")
+    result["prefix"] = runner.run("prefix", run_prefix_phase)
+    # speculative decoding phase (docs/SERVING.md "Speculative
+    # decoding"): TPOT + tokens-per-forward, n-gram proposer on/off
+    result["speculative"] = runner.run("speculative", run_spec_phase)
+    # unified-telemetry phase (docs/OBSERVABILITY.md): tracing overhead
+    # on/off vs the noise floor, greedy parity, a schema-validated
+    # Chrome-trace artifact + flight-recorder dump paths, TTFT coverage
+    result["telemetry"] = runner.run("telemetry", run_telemetry_phase)
+    # fault-tolerance chaos phase (docs/SERVING.md "Fault tolerance"):
+    # kill 1 of 2 replicas mid-burst — recovery time, retry success
+    # rate (1.0 for greedy), greedy parity vs unfaulted
+    result["chaos"] = runner.run("chaos", run_chaos_phase)
+    # int8 KV quantization phase (docs/SERVING.md "KV quantization"):
+    # concurrency at a fixed KV byte budget + perplexity/parity gates
+    result["kv_quant"] = runner.run("kv_quant", run_kv_quant_phase)
+    result["phase_budget_s"] = runner.budget_s
+    result["schema_problems"] = validate_serving_schema(result)
+    return result
 
 
 def git_sha():
@@ -651,6 +980,20 @@ def main():
     from deepspeed_tpu.models.transformer import CausalLM
 
     on_tpu = devices_with_retry()[0].platform == "tpu"
+
+    if os.environ.get("BENCH_SERVING_ONLY", "") not in ("", "0"):
+        # serving-phase smoke (scripts/tier1.sh TIER1_PHASE): skip the
+        # train metric, run (a subset of — BENCH_PHASES) the serving
+        # phases, one JSON line out, same driver contract
+        serving = bench_serving(on_tpu)
+        print(json.dumps({
+            "metric": "serving_smoke", "value": 1.0, "unit": "ok",
+            "vs_baseline": 1.0,
+            "detail": {"platform": jax.devices()[0].platform,
+                       "jax_version": jax.__version__,
+                       "git_sha": git_sha(), "serving": serving},
+        }, default=str), flush=True)
+        return
     if on_tpu:
         # ~536M-param Llama-style model sized for one v5e chip (fp32 master
         # + Adam moments + bf16 activations under 15.75G HBM).

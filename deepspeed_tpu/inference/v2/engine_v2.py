@@ -29,7 +29,10 @@ class RaggedInferenceEngineConfig:
                  kv_blocks: int = 512, kv_block_size: int = 16,
                  max_tracked_sequences: int = 256,
                  enable_prefix_cache: bool = False,
-                 prefix_cache_max_blocks: Optional[int] = None):
+                 prefix_cache_max_blocks: Optional[int] = None,
+                 kv_quant_enabled: bool = False,
+                 kv_quant_dtype: str = "int8",
+                 kv_quant_scale_granularity: str = "block"):
         self.max_ragged_batch_size = max_ragged_batch_size
         self.max_ragged_sequence_count = max_ragged_sequence_count
         self.max_chunk_tokens = max_chunk_tokens
@@ -40,6 +43,12 @@ class RaggedInferenceEngineConfig:
         # blocks between sequences with identical leading tokens
         self.enable_prefix_cache = enable_prefix_cache
         self.prefix_cache_max_blocks = prefix_cache_max_blocks
+        # int8 KV-cache quantization (docs/SERVING.md "KV quantization"):
+        # pools stored int8 with per-(layer, block, kv-head) scales —
+        # a fixed HBM byte budget buys ~2x the blocks (kv_quant.py)
+        self.kv_quant_enabled = kv_quant_enabled
+        self.kv_quant_dtype = kv_quant_dtype
+        self.kv_quant_scale_granularity = kv_quant_scale_granularity
 
 
 class InferenceEngineV2:
@@ -65,6 +74,7 @@ class InferenceEngineV2:
         # placed by the logical-axis TP rules, KV pool sharded over the
         # kv-head dim, attention shard_mapped inside PagedCausalLM.
         cache_sharding = None
+        scale_sharding = None
         jmesh = None
         if mesh is not None:
             from ...parallel import topology as topo_mod
@@ -83,22 +93,40 @@ class InferenceEngineV2:
                 params = jax.tree.map(jax.device_put, params, shardings)
                 cache_sharding = NamedSharding(
                     jmesh, P(None, None, "tensor", None, None))
+                # kv_quant scale planes [L, NB, KH] follow the pools'
+                # kv-head split (paged_model extends the shard_map specs)
+                scale_sharding = NamedSharding(jmesh, P(None, None, "tensor"))
             else:
                 jmesh = None
         self.params = params
 
         cfg = model.cfg
         max_blocks_per_seq = -(-cfg.max_seq_len // self.config.kv_block_size)
-        self.state_manager = DSStateManager(
-            cfg, self.config.max_tracked_sequences, self.config.kv_blocks,
-            self.config.kv_block_size, sharding=cache_sharding,
-            enable_prefix_cache=self.config.enable_prefix_cache,
-            prefix_cache_max_blocks=self.config.prefix_cache_max_blocks)
+        self._cache_sharding = cache_sharding
+        self._scale_sharding = scale_sharding
+        self.state_manager = self._build_state_manager()
         self.paged = PagedCausalLM(model, self.config.kv_block_size,
                                    max_blocks_per_seq, mesh=jmesh)
         self.batch = RaggedBatchWrapper(self.config.max_ragged_sequence_count,
                                         self.config.max_chunk_tokens,
                                         max_blocks_per_seq)
+
+    def _build_state_manager(self) -> DSStateManager:
+        """Fresh sequence registry + KV pools from the current config —
+        the constructor path and ``configure_kv_quant``'s rebuild."""
+        from .kv_quant import validate_kv_quant
+
+        if self.config.kv_quant_enabled:
+            validate_kv_quant(self.config.kv_quant_dtype,
+                              self.config.kv_quant_scale_granularity)
+        return DSStateManager(
+            self.model.cfg, self.config.max_tracked_sequences,
+            self.config.kv_blocks, self.config.kv_block_size,
+            sharding=self._cache_sharding,
+            enable_prefix_cache=self.config.enable_prefix_cache,
+            prefix_cache_max_blocks=self.config.prefix_cache_max_blocks,
+            kv_quant=self.config.kv_quant_enabled,
+            scale_sharding=self._scale_sharding)
 
     # ----------------------------------------------------------- admission
     def can_schedule(self, uids: Sequence[int],
@@ -244,6 +272,41 @@ class InferenceEngineV2:
         else:
             sm.clear_prefix_cache()
             sm.prefix_cache_enabled = False
+
+    def occupancy(self) -> Dict[str, int]:
+        """KV-pool occupancy snapshot (blocks + bytes + evictable/
+        available) — the single source the serving gauges
+        (``kv_blocks_in_use``/``kv_bytes_in_use``) and bench phase stamps
+        read; see :meth:`DSStateManager.occupancy`."""
+        return self.state_manager.occupancy()
+
+    def configure_kv_quant(self, enabled: bool, dtype: str = "int8",
+                           scale_granularity: str = "block") -> None:
+        """Toggle int8 KV-cache quantization on a built engine — the
+        serving layer's config-driven hook (``ServingConfig.kv_quant``).
+        Unlike the prefix cache this re-allocates the KV pools (the
+        representation changes), so it is only legal while no sequences
+        are tracked: call it before traffic (the ``ServingFrontend``
+        replica-build path) or after a drain."""
+        if (bool(enabled) == self.state_manager.kv_quant
+                and dtype == self.config.kv_quant_dtype
+                and scale_granularity == self.config.kv_quant_scale_granularity):
+            return
+        if self.state_manager.tracked_sequences:
+            raise RuntimeError(
+                "cannot reconfigure kv_quant with "
+                f"{len(self.state_manager.tracked_sequences)} sequences "
+                "tracked — their KV blocks hold the old representation")
+        if enabled:
+            # validate BEFORE touching config: a rejected dtype must not
+            # leave config claiming a representation the pools don't have
+            from .kv_quant import validate_kv_quant
+
+            validate_kv_quant(dtype, scale_granularity)
+        self.config.kv_quant_enabled = bool(enabled)
+        self.config.kv_quant_dtype = dtype
+        self.config.kv_quant_scale_granularity = scale_granularity
+        self.state_manager = self._build_state_manager()
 
     @property
     def free_blocks(self) -> int:

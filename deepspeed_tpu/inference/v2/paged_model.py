@@ -73,47 +73,61 @@ class PagedCausalLM:
                                       static_argnames=("verify_width",))
 
     def _attend(self, q, kc, vc, block_tables, start_pos, n_tokens, slopes,
-                window=0):
-        """Paged attention, shard_mapped over the tensor axis when TP>1."""
+                window=0, k_scale=None, v_scale=None):
+        """Paged attention, shard_mapped over the tensor axis when TP>1.
+        ``k_scale``/``v_scale`` [NB, KH]: per-(block, kv-head) dequant
+        scales for int8 pools (kv_quant.py) — sharded over the kv-head
+        axis exactly like the pools, so TP serving is preserved."""
         sm_scale = self.cfg.attn_scale
+        quant_kw = ({} if k_scale is None
+                    else {"k_scale": k_scale, "v_scale": v_scale})
         if self.tp == 1:
             return self._attn_raw(q, kc, vc, block_tables, start_pos,
                                   n_tokens, alibi_slopes=slopes,
-                                  window=window, sm_scale=sm_scale)
+                                  window=window, sm_scale=sm_scale,
+                                  **quant_kw)
         from jax.sharding import PartitionSpec as P
         from ...compat import shard_map
 
         q_spec = P(None, None, "tensor", None)        # [N, C, H, D]
         kv_spec = P(None, "tensor", None, None)       # [NB, KH, bs, D]
         rep = P()
-        s_spec = rep if slopes is None else P("tensor")
+
+        operands = [q, kc, vc, block_tables, start_pos, n_tokens]
+        in_specs = [q_spec, kv_spec, kv_spec, rep, rep, rep]
+        if slopes is not None:
+            operands.append(slopes)
+            in_specs.append(P("tensor"))
+        if k_scale is not None:
+            operands += [k_scale, v_scale]
+            in_specs += [P(None, "tensor"), P(None, "tensor")]  # [NB, KH]
 
         attn = self._attn_raw
+        has_slopes = slopes is not None
+        has_scales = k_scale is not None
 
-        def local(q, kc, vc, tbl, sp, nt, sl):
+        def local(q, kc, vc, tbl, sp, nt, *rest):
+            i = 0
+            sl = None
+            if has_slopes:
+                sl, i = rest[0], 1
+            kw = ({"k_scale": rest[i], "v_scale": rest[i + 1]}
+                  if has_scales else {})
             return attn(q, kc, vc, tbl, sp, nt, alibi_slopes=sl,
-                        window=window, sm_scale=sm_scale)
+                        window=window, sm_scale=sm_scale, **kw)
 
-        if slopes is None:
-            local_fn = lambda q, kc, vc, tbl, sp, nt: (  # noqa: E731
-                attn(q, kc, vc, tbl, sp, nt, window=window,
-                     sm_scale=sm_scale))
-            return shard_map(
-                local_fn, mesh=self.mesh,
-                in_specs=(q_spec, kv_spec, kv_spec, rep, rep, rep),
-                out_specs=q_spec, check_vma=False)(
-                    q, kc, vc, block_tables, start_pos, n_tokens)
         return shard_map(
-            local, mesh=self.mesh,
-            in_specs=(q_spec, kv_spec, kv_spec, rep, rep, rep, s_spec),
-            out_specs=q_spec, check_vma=False)(
-                q, kc, vc, block_tables, start_pos, n_tokens, slopes)
+            local, mesh=self.mesh, in_specs=tuple(in_specs),
+            out_specs=q_spec, check_vma=False)(*operands)
 
     # ------------------------------------------------------------------
     def _forward(self, params, kv_cache, tokens, start_pos, n_tokens,
                  block_tables, verify_width: int = 0):
         """tokens [N, C]; start_pos/n_tokens [N]; block_tables [N, MB];
-        kv_cache {k,v}: [L, NB, KH, bs, D].
+        kv_cache {k,v}: [L, NB, KH, bs, D] — plus {k_scale,v_scale}
+        [L, NB, KH] when the pools are int8-quantized (kv_quant.py); the
+        pytree structure selects the compiled program, so the
+        unquantized trace is untouched.
 
         Returns (last_logits [N, V], new_kv_cache) — or, with static
         ``verify_width`` W > 0, (logits [N, W, V], new_kv_cache) holding
@@ -161,6 +175,18 @@ class PagedCausalLM:
         write_blk = jnp.where(valid & (blk_ids >= 0), blk_ids, NB).reshape(-1)
         write_off = blk_off.reshape(-1)
 
+        # int8 KV quantization (kv_quant.py, docs/SERVING.md "KV
+        # quantization"): detected from the cache pytree so the disabled
+        # path below is byte-for-byte the historical program. The touched-
+        # block plan is layer-invariant — computed once, closed over by
+        # every scanned layer body.
+        quant = "k_scale" in kv_cache
+        if quant:
+            from .kv_quant import quantized_block_write, touched_block_plan
+
+            kv_plan = touched_block_plan(block_tables, start_pos, n_tokens,
+                                         C, bs, NB)
+
         def rope_q(q):
             if cfg.position != "rope":
                 return q
@@ -170,7 +196,11 @@ class PagedCausalLM:
 
         def block_for(window):
             def block(x, xs):
-                lp, kc, vc = xs   # kc/vc [NB, KH, bs, D]
+                if quant:
+                    lp, kc, vc, ks, vs = xs   # + scale planes [NB, KH]
+                else:
+                    lp, kc, vc = xs           # kc/vc [NB, KH, bs, D]
+                    ks = vs = None
                 h1 = _norm(x, lp["attn_norm_w"], lp.get("attn_norm_b"),
                            cfg.norm, cfg.norm_eps)
                 nh, kvh, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -181,27 +211,50 @@ class PagedCausalLM:
                 v = _linear(h1, lp["wv"], lp.get("wv_b"),
                             dt).reshape(N, C, kvh, hd)
 
-                # paged KV write (reference linear_blocked_kv_rotary
-                # kernel): token t lands at kc[block(t), :, slot(t), :]
-                kc = kc.at[write_blk, :, write_off, :].set(
-                    k.reshape(-1, kvh, hd), mode="drop")
-                vc = vc.at[write_blk, :, write_off, :].set(
-                    v.reshape(-1, kvh, hd), mode="drop")
+                if quant:
+                    # quantized paged KV write: read-modify-write of only
+                    # the touched blocks — dequantize, merge the new
+                    # tokens, re-quantize at the monotone per-block scale
+                    kc, ks = quantized_block_write(kc, ks,
+                                                   k.reshape(-1, kvh, hd),
+                                                   kv_plan)
+                    vc, vs = quantized_block_write(vc, vs,
+                                                   v.reshape(-1, kvh, hd),
+                                                   kv_plan)
+                else:
+                    # paged KV write (reference linear_blocked_kv_rotary
+                    # kernel): token t lands at kc[block(t), :, slot(t), :]
+                    kc = kc.at[write_blk, :, write_off, :].set(
+                        k.reshape(-1, kvh, hd), mode="drop")
+                    vc = vc.at[write_blk, :, write_off, :].set(
+                        v.reshape(-1, kvh, hd), mode="drop")
 
                 # paged read: Pallas block-table walk (reference
                 # blocked_flash; Mistral sliding window clamps the walk to
                 # the last W positions; TP shard_maps the walk over the
-                # tensor axis)
+                # tensor axis; int8 pools dequantize in-kernel via the
+                # scale operands)
                 attn = self._attend(q, kc, vc, block_tables, start_pos,
-                                    n_tokens, slopes, window=window)
+                                    n_tokens, slopes, window=window,
+                                    k_scale=ks, v_scale=vs)
                 attn_out = _linear(attn.reshape(N, C, nh * hd), lp["wo"],
                                    lp.get("wo_b"), dt)
                 x = self.model._attn_mlp_merge(x, attn_out, lp, h1)
-                return x, (kc, vc)
+                return x, ((kc, vc, ks, vs) if quant else (kc, vc))
             return block
 
-        x, (new_k, new_v) = self.model._scan_layers(
-            block_for, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+        if quant:
+            x, (new_k, new_v, new_ks, new_vs) = self.model._scan_layers(
+                block_for, x, (params["layers"], kv_cache["k"],
+                               kv_cache["v"], kv_cache["k_scale"],
+                               kv_cache["v_scale"]))
+            new_cache = {"k": new_k, "v": new_v,
+                         "k_scale": new_ks, "v_scale": new_vs}
+        else:
+            x, (new_k, new_v) = self.model._scan_layers(
+                block_for, x, (params["layers"], kv_cache["k"],
+                               kv_cache["v"]))
+            new_cache = {"k": new_k, "v": new_v}
         x = _norm(x, params["final_norm"]["w"], params["final_norm"].get("b"),
                   cfg.norm, cfg.norm_eps)
         if verify_width:
@@ -212,9 +265,9 @@ class PagedCausalLM:
             idx = jnp.clip(n_tokens[:, None] - W + jnp.arange(W)[None, :],
                            0, C - 1)                              # [N, W]
             x_v = jnp.take_along_axis(x, idx[:, :, None], axis=1)  # [N,W,H]
-            return self.model._unembed(params, x_v), {"k": new_k, "v": new_v}
+            return self.model._unembed(params, x_v), new_cache
         # logits_gather: only the last valid token per sequence
         last_idx = jnp.clip(n_tokens - 1, 0, C - 1)
         x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)[:, 0]
         logits = self.model._unembed(params, x_last[:, None, :])[:, 0]
-        return logits, {"k": new_k, "v": new_v}
+        return logits, new_cache

@@ -20,12 +20,17 @@ from typing import Dict, List, Sequence
 
 
 class BlockedAllocator:
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int, bytes_per_block: int = 0):
         if num_blocks < 1:
             raise ValueError(f"need at least one block, got {num_blocks}")
         self._num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks))
         self._refs: Dict[int, int] = {}      # allocated block -> refcount
+        # HBM bytes one block costs across layers (K+V slabs + scale
+        # entries under kv_quant — inference/v2/kv_quant.py); 0 = unknown.
+        # Lets occupancy() speak bytes, the unit admission budgets and
+        # dashboards actually care about.
+        self.bytes_per_block = int(bytes_per_block)
 
     @property
     def free_blocks(self) -> int:
@@ -34,6 +39,20 @@ class BlockedAllocator:
     @property
     def total_blocks(self) -> int:
         return self._num_blocks
+
+    def occupancy(self) -> Dict[str, int]:
+        """One consistent snapshot of pool occupancy — the single home
+        for the counts admission control, the prefix cache, serving
+        metrics (``kv_blocks_in_use``/``kv_bytes_in_use`` gauges) and the
+        bench phases previously derived ad hoc."""
+        in_use = self._num_blocks - len(self._free)
+        bpb = self.bytes_per_block
+        return {"total_blocks": self._num_blocks,
+                "free_blocks": len(self._free),
+                "in_use_blocks": in_use,
+                "bytes_per_block": bpb,
+                "bytes_in_use": in_use * bpb,
+                "bytes_total": self._num_blocks * bpb}
 
     def ref_count(self, block: int) -> int:
         """Current refcount (0 for free/unknown blocks)."""

@@ -60,12 +60,23 @@ class DSStateManager:
                  num_blocks: int = 256, block_size: int = 16,
                  dtype=None, sharding=None,
                  enable_prefix_cache: bool = False,
-                 prefix_cache_max_blocks: Optional[int] = None):
+                 prefix_cache_max_blocks: Optional[int] = None,
+                 kv_quant: bool = False, scale_sharding=None):
+        from ..kv_quant import kv_bytes_per_block
+
         self.cfg = model_cfg
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_tracked_sequences = max_tracked_sequences
-        self.allocator = BlockedAllocator(num_blocks)
+        # int8 KV quantization (docs/SERVING.md "KV quantization"): pools
+        # stored as symmetric int8 with per-(layer, block, kv-head) f32
+        # scale planes — half the HBM bytes per block vs bf16, so a fixed
+        # byte budget buys ~2x the blocks (inference/v2/kv_quant.py)
+        self.kv_quant = bool(kv_quant)
+        self.allocator = BlockedAllocator(
+            num_blocks,
+            bytes_per_block=kv_bytes_per_block(model_cfg, block_size,
+                                               self.kv_quant, dtype))
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
         # -- prefix cache ---------------------------------------------------
         self.prefix_cache_enabled = bool(enable_prefix_cache)
@@ -90,14 +101,27 @@ class DSStateManager:
         # axis (TP serving — reference v2 sharding/qkv.py:166 head split).
         shape = (model_cfg.num_layers, num_blocks, model_cfg.kv_heads,
                  block_size, model_cfg.head_dim)
-        if sharding is None:
-            zeros = jnp.zeros(shape, dt)
-        else:
+        pool_dt = jnp.int8 if self.kv_quant else dt
+
+        def _alloc(shp, adt, shard):
+            if shard is None:
+                return jnp.zeros(shp, adt)
             # allocate each device's shard directly — a full pool on one
             # device before resharding could OOM exactly when TP matters
-            zeros = jax.jit(lambda: jnp.zeros(shape, dt),
-                            out_shardings=sharding)()
+            return jax.jit(lambda: jnp.zeros(shp, adt),
+                           out_shardings=shard)()
+
+        zeros = _alloc(shape, pool_dt, sharding)
         self.kv_cache = {"k": zeros, "v": zeros}
+        if self.kv_quant:
+            # symmetric per-(layer, block, kv-head) scales, indexed by
+            # pool block id — a prefix-shared block shares its scale for
+            # free; freed blocks' stale entries are ignored (not reset) by
+            # the fresh-block write rule in kv_quant.quantized_block_write
+            sshape = shape[:3]
+            szeros = _alloc(sshape, jnp.float32, scale_sharding)
+            self.kv_cache["k_scale"] = szeros
+            self.kv_cache["v_scale"] = szeros
 
     # -- sequence registry -------------------------------------------------
     def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
@@ -229,6 +253,17 @@ class DSStateManager:
         control must count reclaimable cache residency, or a warm cache
         would wedge the scheduler on KVCacheLimitExceeded forever)."""
         return self.allocator.free_blocks + self.evictable_blocks
+
+    def occupancy(self) -> Dict[str, int]:
+        """One snapshot of KV-pool occupancy: the allocator's block/byte
+        counts plus the prefix-cache view (evictable = reclaimable cached
+        blocks, available = what an allocate can actually obtain). The
+        serving layer publishes this as ``kv_blocks_in_use`` /
+        ``kv_bytes_in_use`` gauges and every bench phase stamps it."""
+        occ = self.allocator.occupancy()
+        occ["evictable_blocks"] = self.evictable_blocks
+        occ["available_blocks"] = occ["free_blocks"] + occ["evictable_blocks"]
+        return occ
 
     def prefix_stats(self) -> Dict[str, int]:
         return dict(self._stats)

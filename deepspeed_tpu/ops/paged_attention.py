@@ -61,11 +61,19 @@ def _use_interpret() -> bool:
 # ------------------------------------------------------------------- kernel
 
 def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
-                  k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  block_size: int, chunk: int, groups: int,
-                  sm_scale: float, alibi: bool, window: int):
+                  k_ref, v_ref, *refs, block_size: int, chunk: int,
+                  groups: int, sm_scale: float, alibi: bool, window: int,
+                  quant: bool):
     """One (n, kh, b) grid step: fold table block b of sequence n into the
-    online softmax of its [G·C, D] query group."""
+    online softmax of its [G·C, D] query group. With ``quant`` the KV
+    pools are int8 and two extra (1, 1) SMEM operands carry this block's
+    per-(block, kv-head) dequantization scales (docs/SERVING.md "KV
+    quantization") — the block is dequantized in VMEM right after its DMA,
+    so HBM only ever holds int8."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    else:
+        o_ref, acc_ref, m_ref, l_ref = refs
     n = pl.program_id(0)
     kh = pl.program_id(1)
     b = pl.program_id(2)
@@ -90,6 +98,9 @@ def _paged_kernel(tables_ref, startp_ref, ntok_ref, slopes_ref, q_ref,
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # [G*C, D]
         k = k_ref[0, 0].astype(jnp.float32)                   # [bs, D]
         v = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [G*C, bs]
         # causal + context mask: q row r is chunk pos r % C at global
@@ -148,11 +159,12 @@ def _clamp_tables(block_tables, ctx_len, block_size, start_pos=None,
 
 def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
                   alibi_slopes=None, window: int = 0, sm_scale=None,
-                  interpret: bool):
+                  k_scale=None, v_scale=None, interpret: bool):
     N, C, H, D = q.shape
     NB, KH, bs, _ = k_pool.shape
     G = H // KH
     MB = block_tables.shape[1]
+    quant = k_scale is not None
     sm_scale = 1.0 / math.sqrt(D) if sm_scale is None else float(sm_scale)
 
     # [N, C, H, D] -> [N, KH, G*C, D]: row r = g*C + ci
@@ -169,20 +181,33 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
 
     kernel = functools.partial(_paged_kernel, block_size=bs, chunk=C,
                                groups=G, sm_scale=sm_scale, alibi=alibi,
-                               window=window)
+                               window=window, quant=quant)
+    in_specs = [
+        pl.BlockSpec((1, 1, G * C, D),
+                     lambda n, kh, b, tbl, sp, nt, sl: (n, kh, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D),
+                     lambda n, kh, b, tbl, sp, nt, sl:
+                     (tbl[n, b], kh, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D),
+                     lambda n, kh, b, tbl, sp, nt, sl:
+                     (tbl[n, b], kh, 0, 0)),
+    ]
+    operands = [qh, k_pool, v_pool]
+    if quant:
+        # per-(block, kv-head) dequant scales: one (1, 1) SMEM scalar per
+        # grid step, the index map walking the block table exactly like
+        # the KV slabs (guide: scalars are 2-D blocks in SMEM)
+        scale_spec = pl.BlockSpec((1, 1),
+                                  lambda n, kh, b, tbl, sp, nt, sl:
+                                  (tbl[n, b], kh),
+                                  memory_space=pltpu.TPUMemorySpace.SMEM)
+        in_specs += [scale_spec, scale_spec]
+        operands += [jnp.asarray(k_scale, jnp.float32),
+                     jnp.asarray(v_scale, jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(N, KH, MB),
-        in_specs=[
-            pl.BlockSpec((1, 1, G * C, D),
-                         lambda n, kh, b, tbl, sp, nt, sl: (n, kh, 0, 0)),
-            pl.BlockSpec((1, 1, bs, D),
-                         lambda n, kh, b, tbl, sp, nt, sl:
-                         (tbl[n, b], kh, 0, 0)),
-            pl.BlockSpec((1, 1, bs, D),
-                         lambda n, kh, b, tbl, sp, nt, sl:
-                         (tbl[n, b], kh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, G * C, D),
                                lambda n, kh, b, tbl, sp, nt, sl:
                                (n, kh, 0, 0)),
@@ -192,14 +217,15 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
             pltpu.VMEM((G * C, LANES), jnp.float32),
         ],
     )
+    out_dt = q.dtype
     o = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((N, KH, G * C, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((N, KH, G * C, D), out_dt),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(tables, startp, ntok, slopes, qh, k_pool, v_pool)
+    )(tables, startp, ntok, slopes, *operands)
     # [N, KH, G*C, D] -> [N, C, H, D]
     return (o.reshape(N, KH, G, C, D).transpose(0, 3, 1, 2, 4)
             .reshape(N, C, H, D))
@@ -208,9 +234,13 @@ def _paged_pallas(q, k_pool, v_pool, block_tables, start_pos, n_tokens, *,
 # ----------------------------------------------------------- XLA reference
 
 def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
-                        alibi_slopes=None, window: int = 0, sm_scale=None):
+                        alibi_slopes=None, window: int = 0, sm_scale=None,
+                        k_scale=None, v_scale=None):
     """Dense-gather formulation (the pre-Pallas path): gather the table into
-    [N, MB*bs, KH, D] and mask. Numerically the kernel's reference."""
+    [N, MB*bs, KH, D] and mask. Numerically the kernel's reference.
+    ``k_scale``/``v_scale`` [NB, KH]: per-(block, kv-head) dequantization
+    scales for int8 pools (docs/SERVING.md "KV quantization") — gathered
+    through the same block table and applied to the gathered context."""
     N, C, H, D = q.shape
     NB, KH, bs, _ = k_pool.shape
     G = H // KH
@@ -222,6 +252,11 @@ def paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
     # pool [NB, KH, bs, D] -> per-seq [N, MB, KH, bs, D] -> [N, KH, MB*bs, D]
     k_ctx = k_pool[tbl]
     v_ctx = v_pool[tbl]
+    if k_scale is not None:
+        k_ctx = (k_ctx.astype(jnp.float32)
+                 * k_scale[tbl][:, :, :, None, None]).astype(q.dtype)
+        v_ctx = (v_ctx.astype(jnp.float32)
+                 * v_scale[tbl][:, :, :, None, None]).astype(q.dtype)
     k_ctx = k_ctx.transpose(0, 2, 1, 3, 4).reshape(N, KH, MB * bs, D)
     v_ctx = v_ctx.transpose(0, 2, 1, 3, 4).reshape(N, KH, MB * bs, D)
 
@@ -264,7 +299,8 @@ def _pallas_ok(q, k_pool) -> bool:
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
-                    alibi_slopes=None, window: int = 0, sm_scale=None):
+                    alibi_slopes=None, window: int = 0, sm_scale=None,
+                    k_scale=None, v_scale=None):
     """Block-table paged attention.
 
     q [N, C, H, D]; k/v pool [NB, KH, bs, D]; block_tables [N, MB]
@@ -276,13 +312,19 @@ def paged_attention(q, k_pool, v_pool, block_tables, start_pos, n_tokens,
     ``window`` > 0: sliding-window attention (Mistral serving — reference
     inference/v2/model_implementations/mistral/model.py:202); KV blocks
     wholly before the window are skipped for compute and DMA.
+    ``k_scale``/``v_scale`` [NB, KH]: per-(block, kv-head) dequantization
+    scales for int8 KV pools (docs/SERVING.md "KV quantization") —
+    dequantization happens inside the kernel (VMEM) / after the gather
+    (XLA path), so HBM only ever holds the int8 pool.
     Rows beyond n_tokens are garbage (masked out downstream).
     """
     if _pallas_ok(q, k_pool):
         return _paged_pallas(q, k_pool, v_pool, block_tables, start_pos,
                              n_tokens, alibi_slopes=alibi_slopes,
                              window=window, sm_scale=sm_scale,
+                             k_scale=k_scale, v_scale=v_scale,
                              interpret=_use_interpret())
     return paged_attention_xla(q, k_pool, v_pool, block_tables, start_pos,
                                n_tokens, alibi_slopes=alibi_slopes,
-                               window=window, sm_scale=sm_scale)
+                               window=window, sm_scale=sm_scale,
+                               k_scale=k_scale, v_scale=v_scale)

@@ -13,6 +13,12 @@ try:
     from jax.experimental import pallas as pl                    # noqa: F401
     from jax.experimental.pallas import tpu as pltpu             # noqa: F401
     HAS_PALLAS = True
+    # jax < 0.5 names the TPU compiler-params dataclass TPUCompilerParams;
+    # newer jax renamed it CompilerParams. Alias the modern name so the
+    # kernels write current-jax code and still run on the floor version.
+    if not hasattr(pltpu, "CompilerParams") \
+            and hasattr(pltpu, "TPUCompilerParams"):
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
 except Exception:  # pragma: no cover
     pl = pltpu = None
     HAS_PALLAS = False

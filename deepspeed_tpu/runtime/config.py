@@ -20,8 +20,8 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
-from ..serving.config import (PrefixCacheConfig, ServingConfig,
-                              SpeculativeConfig)
+from ..serving.config import (KVQuantConfig, PrefixCacheConfig,
+                              ServingConfig, SpeculativeConfig)
 from ..telemetry.config import TelemetryConfig
 from ..utils.logging import logger
 
@@ -348,6 +348,9 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # speculative decoding for the v2 ragged engine (docs/SERVING.md
     # "Speculative decoding"); also reachable as ``serving.speculative``
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
+    # int8 KV-cache quantization for the v2 ragged engine (docs/SERVING.md
+    # "KV quantization"); also reachable as ``serving.kv_quant``
+    kv_quant: KVQuantConfig = Field(default_factory=KVQuantConfig)
     # unified telemetry (docs/OBSERVABILITY.md): training step spans here;
     # serving request tracing via ``serving.telemetry``
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)

@@ -12,7 +12,8 @@ Light names import eagerly; ``ServingFrontend``/``Replica``/
 """
 
 from .config import (FaultsConfig, FaultToleranceConfig,  # noqa: F401
-                     PrefixCacheConfig, ServingConfig, SpeculativeConfig)
+                     KVQuantConfig, PrefixCacheConfig, ServingConfig,
+                     SpeculativeConfig)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, serving_metrics)
@@ -40,7 +41,8 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["ServingConfig", "PrefixCacheConfig", "SpeculativeConfig",
+__all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
+           "SpeculativeConfig",
            "FaultToleranceConfig", "FaultsConfig", "FaultInjector",
            "InjectedFault", "ReplicaSupervisor",
            "MetricsRegistry",

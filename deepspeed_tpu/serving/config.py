@@ -35,6 +35,32 @@ class PrefixCacheConfig(DSConfigModel):
                                                  or None)
 
 
+class KVQuantConfig(DSConfigModel):
+    """``kv_quant: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "KV quantization"): int8 KV-cache quantization in the v2 ragged
+    engine — pools stored as symmetric int8 with per-(layer, block,
+    kv-head) scale planes, halving HBM bytes per block so a fixed byte
+    budget serves ~2x the concurrent sequences. Mounted on both
+    :class:`ServingConfig` and ``DeepSpeedTpuConfig``; disabled (the
+    default) keeps the bf16/fp32 pools byte for byte."""
+
+    enabled: bool = False
+    # quantized representation; only "int8" is implemented today ("fp8"
+    # reserved — inference/v2/kv_quant.py validates)
+    dtype: str = "int8"
+    # scale granularity; only "block" (per layer x block x kv-head) is
+    # implemented — the granularity EQuARX-style low-bit XLA paths need
+    # to stay accurate (PAPERS.md: arxiv 2506.17615)
+    scale_granularity: str = "block"
+
+    def apply(self, engine_config) -> None:
+        """Stamp these settings onto a ``RaggedInferenceEngineConfig``
+        (the engine-factory hook for config-driven serving)."""
+        engine_config.kv_quant_enabled = self.enabled
+        engine_config.kv_quant_dtype = self.dtype
+        engine_config.kv_quant_scale_granularity = self.scale_granularity
+
+
 class SpeculativeConfig(DSConfigModel):
     """``speculative: {...}`` block (docs/CONFIG.md, docs/SERVING.md
     "Speculative decoding"): greedy-lossless speculative decoding in the
@@ -155,6 +181,9 @@ class ServingConfig(DSConfigModel):
     # prefix-cache KV block reuse (engine-level; ``from_engine_factory``
     # callers apply it via ``PrefixCacheConfig.apply``)
     prefix_cache: PrefixCacheConfig = Field(default_factory=PrefixCacheConfig)
+    # int8 KV-cache quantization (engine-level; ``ServingFrontend``
+    # applies it per replica engine before traffic)
+    kv_quant: KVQuantConfig = Field(default_factory=KVQuantConfig)
     # speculative decoding (scheduler-level; applied per replica)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     # unified telemetry: request tracing + flight recorder

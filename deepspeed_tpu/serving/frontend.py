@@ -96,6 +96,17 @@ class ServingFrontend:
         the constructor path AND the supervisor's restart path, so a
         restarted replica is indistinguishable from a first-boot one
         (prefix cache applied, proposer built, telemetry attached)."""
+        if self.config.kv_quant.enabled:
+            # config-driven int8 KV quantization: applied BEFORE any
+            # traffic reaches the engine (configure_kv_quant re-allocates
+            # the pools, which is only legal with no tracked sequences —
+            # true both at first boot and on the supervisor's fresh-engine
+            # restart path). Engines the caller quantized directly are
+            # left alone when the block is off.
+            configure = getattr(engine, "configure_kv_quant", None)
+            if configure is not None:
+                configure(True, self.config.kv_quant.dtype,
+                          self.config.kv_quant.scale_granularity)
         if self.config.prefix_cache.enabled:
             # config-driven prefix caching: flip it on every engine that
             # supports it (enabling on a built engine is safe — matching
@@ -242,7 +253,31 @@ class ServingFrontend:
         return True
 
     # ------------------------------------------------------------- metrics
+    def _refresh_kv_gauges(self) -> None:
+        """Sum KV-pool occupancy over the fleet into the
+        ``kv_blocks_in_use`` / ``kv_bytes_in_use`` gauges (docs/SERVING.md
+        "KV quantization" / OBSERVABILITY.md). One consistent read per
+        replica from ``engine.occupancy()`` — the single snapshot that
+        replaced the ad-hoc block counts (BlockedAllocator.occupancy)."""
+        blocks = total_bytes = 0
+        found = False
+        for rep in self.router.replicas:
+            occ_fn = getattr(getattr(rep, "engine", None), "occupancy", None)
+            if occ_fn is None:
+                continue
+            try:
+                occ = occ_fn()
+            except Exception:
+                continue
+            found = True
+            blocks += occ.get("in_use_blocks", 0)
+            total_bytes += occ.get("bytes_in_use", 0)
+        if found:
+            self.metrics.gauge("kv_blocks_in_use").set(blocks)
+            self.metrics.gauge("kv_bytes_in_use").set(total_bytes)
+
     def metrics_snapshot(self) -> dict:
+        self._refresh_kv_gauges()
         snap = self.metrics.snapshot()
         submitted = snap.get("requests_submitted", 0.0) or 0.0
         snap["shed_rate"] = (snap.get("requests_shed", 0.0) / submitted
@@ -252,6 +287,7 @@ class ServingFrontend:
     def publish_metrics(self, monitor, step: int = 0) -> None:
         """Fan the registry out through a monitor/ backend (MonitorMaster,
         CSVMonitor, ...)."""
+        self._refresh_kv_gauges()
         self.metrics.publish(monitor, step)
 
     def render_prometheus(self) -> str:

@@ -286,7 +286,11 @@ def serving_metrics() -> MetricsRegistry:
               # capacity_alarm: 1 while any slot is parked — page on it;
               # brownout_active: 1 while the admission queue is shedding
               # lowest-urgency work under degraded capacity
-              "replicas_parked", "capacity_alarm", "brownout_active"):
+              "replicas_parked", "capacity_alarm", "brownout_active",
+              # KV-pool occupancy summed over the fleet from
+              # ``engine.occupancy()`` (docs/SERVING.md "KV
+              # quantization"): bytes shrink ~2x per block under kv_quant
+              "kv_blocks_in_use", "kv_bytes_in_use"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
