@@ -3,11 +3,12 @@
 from .elasticity import (ElasticityConfigError, ElasticityError,
                          ElasticityIncompatibleWorldSize,
                          compute_elastic_config, elasticity_enabled,
-                         get_compatible_chips_v01, get_compatible_chips_v02)
+                         get_compatible_chips_v01, get_compatible_chips_v02,
+                         validate_elastic_config)
 
 __all__ = [
     "ElasticityError", "ElasticityConfigError",
     "ElasticityIncompatibleWorldSize", "compute_elastic_config",
     "elasticity_enabled", "get_compatible_chips_v01",
-    "get_compatible_chips_v02",
+    "get_compatible_chips_v02", "validate_elastic_config",
 ]

@@ -21,6 +21,7 @@ role; no torch-elastic agent is needed in the restart model).
 from __future__ import annotations
 
 import math
+import numbers
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -40,6 +41,7 @@ class ElasticityIncompatibleWorldSize(ElasticityError):
 
 
 LATEST_VERSION = 0.2
+SUPPORTED_VERSIONS = (0.1, LATEST_VERSION)
 
 
 def highly_composite_numbers(limit: int) -> List[int]:
@@ -194,6 +196,83 @@ def elasticity_enabled(ds_config: Dict[str, Any]) -> bool:
     return bool(ds_config.get("elasticity", {}).get("enabled", False))
 
 
+def _as_int(value) -> Optional[int]:
+    """Integral value as int, else None. Accepts 2000, 2000.0, and numpy
+    scalars alike — JSON/YAML float literals for whole numbers and
+    array-derived configs must not break what the batch arithmetic always
+    handled — but never bools or 2.5."""
+    if isinstance(value, bool) or type(value).__name__ == "bool_":
+        return None
+    if isinstance(value, numbers.Integral):
+        return int(value)
+    if isinstance(value, numbers.Real):
+        f = float(value)
+        if math.isfinite(f) and f == int(f):
+            return int(f)
+    return None
+
+
+def validate_elastic_config(ec: Dict[str, Any]) -> None:
+    """Reject an inconsistent ``elasticity`` block with a descriptive
+    error BEFORE any batch math runs (reference elasticity/config.py
+    field assertions). Called by :func:`compute_elastic_config`, which
+    the engine invokes at ``initialize()`` time — a bad elastic config
+    fails the job at construction, not mid-run on a resize."""
+    raw_micro = ec.get("micro_batch_sizes", [2, 4, 6])
+    micro = ([_as_int(m) for m in raw_micro]
+             if isinstance(raw_micro, (list, tuple)) else [])
+    if not micro or any(m is None or m <= 0 for m in micro):
+        raise ElasticityConfigError(
+            "elasticity.micro_batch_sizes must be a non-empty list of "
+            f"positive ints, got {raw_micro!r}")
+    max_batch = _as_int(ec.get("max_train_batch_size", 2000))
+    if max_batch is None or max_batch < max(micro):
+        raise ElasticityConfigError(
+            f"elasticity.max_train_batch_size "
+            f"({ec.get('max_train_batch_size')!r}) must be an int >= the "
+            f"largest micro batch ({max(micro)}) — no global batch could "
+            "otherwise hold one micro batch")
+    min_g = _as_int(ec.get("min_gpus", 1))
+    max_g = _as_int(ec.get("max_gpus", 10000))
+    if min_g is None or min_g < 1:
+        raise ElasticityConfigError(
+            f"elasticity.min_gpus ({ec.get('min_gpus')!r}) must be an "
+            "int >= 1")
+    if max_g is None or max_g < min_g:
+        raise ElasticityConfigError(
+            f"elasticity.max_gpus ({ec.get('max_gpus')!r}) must be an "
+            f"int >= min_gpus ({min_g})")
+    try:
+        version = float(ec.get("version", LATEST_VERSION))
+    except (TypeError, ValueError):
+        raise ElasticityConfigError(
+            f"elasticity.version ({ec.get('version')!r}) is not a number")
+    if version not in SUPPORTED_VERSIONS:
+        supported = ", ".join(str(v) for v in SUPPORTED_VERSIONS)
+        raise ElasticityConfigError(
+            f"elasticity.version {version} is unknown "
+            f"(supported: {supported})")
+    mp = _as_int(ec.get("model_parallel_size", 1))
+    if mp is None or mp < 1:
+        raise ElasticityConfigError(
+            f"elasticity.model_parallel_size "
+            f"({ec.get('model_parallel_size')!r}) must be an int >= 1")
+    if mp > 1 and version != 0.2:
+        raise ElasticityConfigError(
+            f"elasticity v{version} does not support model parallelism "
+            f"(model_parallel_size={mp} needs version 0.2)")
+    gpn = _as_int(ec.get("num_gpus_per_node", 1))
+    if gpn is None or gpn < 1:
+        raise ElasticityConfigError(
+            f"elasticity.num_gpus_per_node "
+            f"({ec.get('num_gpus_per_node')!r}) must be an int >= 1")
+    if version == 0.2 and gpn % mp:
+        raise ElasticityConfigError(
+            f"elasticity.num_gpus_per_node ({gpn}) must be divisible by "
+            f"model_parallel_size ({mp}) — hosts are the scaling unit in "
+            "v0.2 and a host must hold whole model replicas")
+
+
 def compute_elastic_config(ds_config: Dict[str, Any],
                            world_size: int = 0,
                            return_microbatch: bool = False):
@@ -209,18 +288,11 @@ def compute_elastic_config(ds_config: Dict[str, Any],
     ec = ds_config["elasticity"]
     if not ec.get("enabled", False):
         raise ElasticityConfigError("elasticity.enabled is false")
+    validate_elastic_config(ec)
     version = float(ec.get("version", 0.2))
-    if version > LATEST_VERSION:
-        raise ElasticityConfigError(
-            f"elasticity version {version} > latest supported "
-            f"{LATEST_VERSION}")
     micro_batches = ec.get("micro_batch_sizes", [2, 4, 6])
     max_batch = ec.get("max_train_batch_size", 2000)
     mp_size = int(ec.get("model_parallel_size", 1))
-    if mp_size > 1 and version != 0.2:
-        raise ElasticityConfigError(
-            f"elasticity v{version} does not support model parallelism "
-            f"(model_parallel_size={mp_size} needs version 0.2)")
 
     if world_size == 0 and os.environ.get("WORLD_SIZE", "").isnumeric():
         world_size = int(os.environ["WORLD_SIZE"])

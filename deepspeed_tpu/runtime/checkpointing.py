@@ -224,12 +224,33 @@ def _load_tree(template, shardings, in_dir: str):
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None,
-                    save_latest: bool = True, async_save: bool = False) -> str:
+                    save_latest: bool = True, async_save: bool = False,
+                    urgent: bool = False) -> str:
     """Sharded multi-host save: every process writes the shards it owns
     (no single-host gather — at the 70B target a consolidated save would
     push ~260 GB through one host); with ``async_save`` the disk writes run
-    on a background thread and :func:`wait_pending_save` joins them."""
-    wait_pending_save(engine)   # join any prior async save before reusing
+    on a background thread and :func:`wait_pending_save` joins them.
+
+    ``urgent=True`` is the SIGTERM-grace-window path (docs/TRAINING.md
+    "Fault tolerance"): any in-flight async write is joined first (its
+    failure is logged, not raised — a broken *previous* save must not
+    abort the preemption save), the write completes synchronously, and
+    the measured wall time lands on ``engine.last_urgent_save_s`` so the
+    supervisor can judge it against the grace budget."""
+    import time
+
+    t_urgent = time.perf_counter() if urgent else None
+    if urgent:
+        async_save = False
+    try:
+        wait_pending_save(engine)   # join any prior async save before reusing
+    except Exception as e:
+        if not urgent:
+            raise
+        # the failed save's pending commit was already dropped, so its
+        # 'latest' can never publish; this save proceeds on a clean slate
+        logger.warning(f"urgent save: prior async save failed ({e!r}); "
+                       "continuing with the urgent checkpoint")
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -257,6 +278,10 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     manifest = {
         "tag": str(tag),
         "global_step": int(state.global_step),
+        # the host step counter counts overflow/anomaly-SKIPPED steps the
+        # device counter excludes; both must round-trip or a resume after
+        # any skipped step replays one extra step (loss-curve fork)
+        "host_global_steps": int(engine.global_steps),
         "skipped_steps": int(state.skipped_steps),
         "micro_steps": engine.micro_steps,
         "opt_step": int(state.opt_state.step),
@@ -287,8 +312,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     engine._pending_ckpt_commit = commit
     if not async_save:
         wait_pending_save(engine)
-    logger.info(f"Saved checkpoint {ckpt_dir}"
-                + (" (async writes in flight)" if async_save else ""))
+    if urgent:
+        engine.last_urgent_save_s = time.perf_counter() - t_urgent
+        logger.info(f"Urgent checkpoint {ckpt_dir} committed in "
+                    f"{engine.last_urgent_save_s:.2f}s")
+    else:
+        logger.info(f"Saved checkpoint {ckpt_dir}"
+                    + (" (async writes in flight)" if async_save else ""))
     return ckpt_dir
 
 
@@ -351,7 +381,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 hysteresis=jnp.asarray(manifest["hysteresis"], jnp.int32)),
             global_step=jnp.asarray(manifest["global_step"], jnp.int32),
             skipped_steps=jnp.asarray(manifest["skipped_steps"], jnp.int32))
-        engine.global_steps = manifest["global_step"]
+        # restore the HOST counter from its own field (older manifests
+        # lack it; the device counter is then the best available value)
+        engine.global_steps = manifest.get("host_global_steps",
+                                           manifest["global_step"])
         engine.micro_steps = manifest.get("micro_steps", 0)
         engine.lr_scheduler.load_state_dict(manifest["lr_scheduler"])
 

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Union
 from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
+from .resilience import ResilienceConfig
 from ..serving.config import (KVQuantConfig, PrefixCacheConfig,
                               ServingConfig, SpeculativeConfig)
 from ..telemetry.config import TelemetryConfig
@@ -354,6 +355,10 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # unified telemetry (docs/OBSERVABILITY.md): training step spans here;
     # serving request tracing via ``serving.telemetry``
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    # training fault tolerance (docs/TRAINING.md "Fault tolerance"):
+    # preemption urgent-save + auto-resume, step watchdog, anomaly
+    # rollback, training chaos injection (runtime/resilience.py)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 1234

@@ -36,6 +36,26 @@ class RepeatingLoader:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
 
+    # resume pass-through (docs/TRAINING.md "Fault tolerance"): the
+    # wrapped loader owns the position; loading state drops the live
+    # iterator so the next __next__ starts at the restored point.
+    # Loaders without state raise NotImplementedError — the contract the
+    # supervisor catches — not AttributeError from blind delegation.
+    def state_dict(self):
+        if not hasattr(self.loader, "state_dict"):
+            raise NotImplementedError(
+                f"wrapped loader {type(self.loader).__name__} has no "
+                "state_dict — its position is not resumable")
+        return self.loader.state_dict()
+
+    def load_state_dict(self, sd):
+        if not hasattr(self.loader, "load_state_dict"):
+            raise NotImplementedError(
+                f"wrapped loader {type(self.loader).__name__} has no "
+                "load_state_dict — its position is not resumable")
+        self.loader.load_state_dict(sd)
+        self.data_iter = iter(self.loader)
+
 
 class DeepSpeedTpuDataLoader:
     """Batches an indexable or iterable dataset.
@@ -59,6 +79,12 @@ class DeepSpeedTpuDataLoader:
         self.shuffle = shuffle
         self.drop_last = drop_last
         self.epoch = 0
+        # resume bookkeeping (state_dict/load_state_dict): batches yielded
+        # in the CURRENT epoch, and a one-shot fast-forward count consumed
+        # by the next __iter__ after load_state_dict — plain re-iteration
+        # (no load) restarts the epoch exactly as before
+        self._batches_yielded = 0
+        self._resume_batches = 0
         # optional index-batch source (e.g. the curriculum
         # DeepSpeedDataSampler, runtime/data_pipeline/data_sampler.py) —
         # reference deepspeed_io(data_sampler=...) contract
@@ -96,6 +122,57 @@ class DeepSpeedTpuDataLoader:
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
+
+    # -- resume (docs/TRAINING.md "Fault tolerance") -----------------------
+    def state_dict(self):
+        """Mid-epoch-resumable position: epoch + step-in-epoch. The
+        shuffle RNG needs no extra state — the permutation is
+        ``default_rng(seed + epoch)``, recreated per epoch, so the epoch
+        number fully determines it. ``seed``/``batch_size``/``shuffle``
+        travel along as a consistency stamp checked on load."""
+        if self.data_sampler is not None or self._len_dataset() is None:
+            raise NotImplementedError(
+                "dataloader state_dict needs an indexable dataset without "
+                "a data_sampler (sampler/iterable sources own their own "
+                "position)")
+        return {"epoch": int(self.epoch),
+                "batches_yielded": int(self._batches_yielded),
+                "seed": int(self.seed), "shuffle": bool(self.shuffle),
+                "batch_size": int(self.batch_size),
+                "drop_last": bool(self.drop_last),
+                # stream identity: a position counted over the shuffled
+                # order of N examples sliced order[shard_id::num_shards]
+                # is meaningless for any other N or slicing — resuming
+                # across a changed process count or a grown/shrunk
+                # dataset must fail loudly, not silently fork the stream
+                "num_shards": int(self.num_shards),
+                "shard_id": int(self.shard_id),
+                "dataset_len": int(self._len_dataset())}
+
+    def load_state_dict(self, sd):
+        if self.data_sampler is not None or self._len_dataset() is None:
+            # same guard as state_dict: a sampler/iterable loader would
+            # silently DISCARD the position (__iter__'s sampler branch
+            # never consults _resume_batches) — fail loudly instead
+            raise NotImplementedError(
+                "dataloader load_state_dict needs an indexable dataset "
+                "without a data_sampler (sampler/iterable sources own "
+                "their own position)")
+        checks = {key: getattr(self, key)
+                  for key in ("seed", "batch_size", "shuffle", "drop_last",
+                              "num_shards", "shard_id")}
+        checks["dataset_len"] = self._len_dataset()
+        for key, have in checks.items():
+            if key in sd and sd[key] != have:
+                raise ValueError(
+                    f"dataloader state mismatch on {key}: checkpoint has "
+                    f"{sd[key]!r}, this loader has {have!r} — "
+                    "resume determinism would silently break")
+        self.epoch = int(sd["epoch"])
+        self._batches_yielded = int(sd.get("batches_yielded", 0))
+        # consumed once by the next __iter__: skip the already-seen
+        # batches of this epoch without gathering them
+        self._resume_batches = self._batches_yielded
 
     def _gather(self, indices):
         if isinstance(self.dataset, dict):
@@ -136,9 +213,20 @@ class DeepSpeedTpuDataLoader:
             rng.shuffle(order)
         order = order[self.shard_id::self.num_shards]
         nb = len(order) // self.batch_size
+        skip, self._resume_batches = self._resume_batches, 0
+        self._batches_yielded = min(skip, nb + 1)
         for b in range(nb):
+            if b < skip:        # resume fast-forward: no gather, no yield
+                continue
             idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            # count BEFORE the yield: the statement after a yield only
+            # runs when the consumer pulls the NEXT item, so counting
+            # afterwards would understate the position by one whenever a
+            # checkpoint lands right after a consumed batch
+            self._batches_yielded = b + 1
             yield self._gather(idx)
-        if not self.drop_last and len(order) % self.batch_size:
+        if not self.drop_last and len(order) % self.batch_size and skip <= nb:
+            self._batches_yielded = nb + 1
             yield self._gather(order[nb * self.batch_size:])
         self.epoch += 1
+        self._batches_yielded = 0

@@ -1105,6 +1105,13 @@ class DeepSpeedTpuEngine:
                 print(report)
         return jnp.mean(jnp.stack(losses))
 
+    def reset_data_iterator(self):
+        """Drop the persistent no-arg ``train_batch`` iterator so the next
+        call rebuilds it from ``training_dataloader``'s current position —
+        the hook the resilience supervisor uses after restoring dataloader
+        state from a checkpoint (runtime/resilience.py)."""
+        self._data_iter = None
+
     def _apply_curriculum(self, batch):
         """Seqlen curriculum: truncate the token batch to the scheduled
         difficulty (reference engine curriculum path; difficulty_step
@@ -1239,11 +1246,12 @@ class DeepSpeedTpuEngine:
     # ---------------------------------------------------------- checkpointing
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True, exclude_frozen_parameters=False,
-                        async_save=False):
+                        async_save=False, urgent=False):
         from .checkpointing import save_checkpoint as _save
 
         return _save(self, save_dir, tag=tag, client_state=client_state or {},
-                     save_latest=save_latest, async_save=async_save)
+                     save_latest=save_latest, async_save=async_save,
+                     urgent=urgent)
 
     def wait_pending_checkpoint(self):
         """Join an async_save's background writes (+ cross-host barrier)."""

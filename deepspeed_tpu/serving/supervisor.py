@@ -31,10 +31,10 @@ from __future__ import annotations
 import random
 import threading
 import time
-from collections import deque
 from typing import Callable, List, Optional
 
 from ..utils.logging import logger
+from ..utils.restart import RestartPolicy
 from .config import FaultToleranceConfig
 from .replica import ReplicaState
 
@@ -42,9 +42,9 @@ from .replica import ReplicaState
 class _Slot:
     """Supervision state for one replica position in the router."""
 
-    def __init__(self, index: int):
+    def __init__(self, index: int, policy: RestartPolicy):
         self.index = index
-        self.crash_times: "deque[float]" = deque()
+        self.policy = policy            # shared backoff/breaker discipline
         self.restart_at: Optional[float] = None
         self.backoff_s = 0.0
         self.restarting = False
@@ -66,7 +66,13 @@ class ReplicaSupervisor:
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.recorder = recorder
         self.rng = random.Random(self.config.seed)
-        self._slots = [_Slot(i) for i in range(len(router.replicas))]
+        cfg = self.config
+        self._slots = [
+            _Slot(i, RestartPolicy(
+                cfg.restart_backoff_s, cfg.restart_backoff_max_s,
+                cfg.restart_backoff_jitter, cfg.max_restarts_in_window,
+                cfg.restart_window_s, self.rng))
+            for i in range(len(router.replicas))]
         self._lock = threading.Lock()
         # per-restart records: {"replica", "t_dead", "t_restarted",
         # "backoff_s", "attempt"} — the bench chaos phase's
@@ -131,19 +137,11 @@ class ReplicaSupervisor:
 
     # ------------------------------------------------------------- crashes
     def _on_crash(self, slot: _Slot, now: float) -> None:
-        cfg = self.config
         with self._lock:
-            slot.crash_times.append(now)
-            while slot.crash_times and \
-                    now - slot.crash_times[0] > cfg.restart_window_s:
-                slot.crash_times.popleft()
-            n = len(slot.crash_times)
-            if n >= max(1, cfg.max_restarts_in_window):
+            n, backoff = slot.policy.record_failure(now)
+            if backoff is None:         # circuit breaker tripped
                 self._park_locked(slot, n)
                 return
-            backoff = min(cfg.restart_backoff_s * (2 ** (n - 1)),
-                          cfg.restart_backoff_max_s)
-            backoff *= 1.0 + cfg.restart_backoff_jitter * self.rng.random()
             slot.restart_at = now + backoff
             slot.backoff_s = backoff
         logger.warning(f"serving replica {slot.index} dead (crash {n} in "
@@ -194,7 +192,8 @@ class ReplicaSupervisor:
             slot.restarting = True
             slot.restart_at = None
         old = self.router.replicas[slot.index]
-        t_dead = slot.crash_times[-1] if slot.crash_times else now
+        t_dead = slot.policy.last_failure_time()
+        t_dead = t_dead if t_dead is not None else now
         try:
             if self.recorder is not None and self.tracer.enabled:
                 # dump the evidence (spans in flight at death, metric
@@ -212,9 +211,9 @@ class ReplicaSupervisor:
                 engine = self._salvage_engine(old)
             if engine is None:
                 with self._lock:
-                    self._park_locked(slot, len(slot.crash_times))
+                    self._park_locked(slot, slot.policy.count())
                 return
-            attempt = len(slot.crash_times)
+            attempt = slot.policy.count()
             span = self.tracer.begin(
                 "replica_restart", trace_id=f"replica-{slot.index}",
                 attrs={"attempt": attempt,

@@ -9,28 +9,37 @@
 #
 # Env knobs:
 #   TIER1_LOG      log path (default /tmp/_t1.log)
-#   TIER1_TIMEOUT  whole-run timeout in seconds (default 870)
+#   TIER1_TIMEOUT  whole-run timeout in seconds (default 1200; raised
+#                  from 870 when the train chaos suite joined tier-1)
 #   TIER1_ARGS     extra pytest args (e.g. "-k spec")
 #   TIER1_PHASE    run ONE named serving bench phase as a smoke instead
 #                  of the test suite (e.g. TIER1_PHASE=kv_quant) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
+#   TIER1_CHAOS_TRAIN=1  smoke ONLY the training chaos suite
+#                  (tests/test_train_resilience.py — preemption/crash/
+#                  wedge/anomaly recovery; docs/TRAINING.md) instead of
+#                  the full suite; same dots counting and exit code.
 
 set -o pipefail
 cd "$(dirname "$0")/.."
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 if [ -n "${TIER1_PHASE:-}" ]; then
-    timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
+    timeout -k 10 "${TIER1_TIMEOUT:-1200}" env JAX_PLATFORMS=cpu \
         BENCH_SERVING_ONLY=1 BENCH_PHASES="$TIER1_PHASE" \
-        BENCH_TIMEOUT_S="${TIER1_TIMEOUT:-870}" \
+        BENCH_TIMEOUT_S="${TIER1_TIMEOUT:-1200}" \
         python bench.py 2>&1 | tee "$LOG"
     rc=${PIPESTATUS[0]}
     echo "DOTS_PASSED=0"   # smoke mode: no pytest dots, exit code is truth
     exit "$rc"
 fi
-timeout -k 10 "${TIER1_TIMEOUT:-870}" env JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -q -m 'not slow' \
+TARGET="tests/"
+if [ -n "${TIER1_CHAOS_TRAIN:-}" ] && [ "${TIER1_CHAOS_TRAIN}" != "0" ]; then
+    TARGET="tests/test_train_resilience.py"
+fi
+timeout -k 10 "${TIER1_TIMEOUT:-1200}" env JAX_PLATFORMS=cpu \
+    python -m pytest "$TARGET" -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}

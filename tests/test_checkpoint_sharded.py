@@ -172,6 +172,59 @@ def test_checkpoint_writer_surfaces_ioerror_on_finalize():
     assert not w2._thread.is_alive()
 
 
+def test_urgent_save_joins_async_and_publishes_fresh_latest(tmp_path):
+    """SIGTERM-grace-window save (docs/TRAINING.md): an urgent save racing
+    an in-flight async save joins it first, completes synchronously, and
+    'latest' ends on the URGENT tag with a complete manifest — never torn,
+    never stale. Wall time is measured onto engine.last_urgent_save_s."""
+    import json
+
+    engine = make_engine()
+    train(engine, 2)
+    engine.save_checkpoint(str(tmp_path), tag="async_tag", async_save=True)
+    # race: urgent save while async writes may still be in flight
+    engine.save_checkpoint(str(tmp_path), tag="urgent_tag", urgent=True)
+    assert engine.last_urgent_save_s > 0       # measured wall-time bound
+    assert (tmp_path / "latest").read_text().strip() == "urgent_tag"
+    for tag in ("async_tag", "urgent_tag"):    # both fully committed
+        manifest = json.loads((tmp_path / tag / "manifest.json").read_text())
+        assert manifest["tag"] == tag
+    engine2 = make_engine()
+    engine2.load_checkpoint(str(tmp_path))     # resolves via 'latest'
+    for a, b in zip(jax.tree.leaves(engine.state.params),
+                    jax.tree.leaves(engine2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_urgent_save_survives_failed_async_join(tmp_path, monkeypatch):
+    """A broken PREVIOUS async save must not abort the preemption save:
+    the urgent path logs the join failure, drops the failed tag's commit,
+    and still publishes its own complete checkpoint as 'latest'."""
+    engine = make_engine()
+    train(engine, 2)
+    real_save = np.save
+
+    def torn_only_save(fname, arr, *a, **kw):
+        # fail ONLY the async tag's shard writes (path-selective, not
+        # time-selective: the background writer may drain the queue at
+        # any point relative to this test's statements)
+        if "torn" in str(fname):
+            raise IOError(f"injected write failure: {fname}")
+        return real_save(fname, arr, *a, **kw)
+
+    monkeypatch.setattr(np, "save", torn_only_save)
+    engine.save_checkpoint(str(tmp_path), tag="torn", async_save=True)
+    # non-urgent surfaces the error; urgent must survive it
+    engine.save_checkpoint(str(tmp_path), tag="urgent_tag", urgent=True)
+    assert (tmp_path / "latest").read_text().strip() == "urgent_tag"
+    assert not (tmp_path / "torn" / "manifest.json").exists()
+    engine2 = make_engine()
+    engine2.load_checkpoint(str(tmp_path))
+    for a, b in zip(jax.tree.leaves(engine.state.params),
+                    jax.tree.leaves(engine2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_zero_to_fp32_offline_reconstruction(tmp_path):
     """zero_to_fp32 CLI role: rebuild full fp32 weights from shard files
     with no engine/mesh (reference utils/zero_to_fp32.py)."""

@@ -15,7 +15,8 @@ from deepspeed_tpu.elasticity import (ElasticityConfigError,
                                       ElasticityIncompatibleWorldSize,
                                       compute_elastic_config,
                                       get_compatible_chips_v01,
-                                      get_compatible_chips_v02)
+                                      get_compatible_chips_v02,
+                                      validate_elastic_config)
 from deepspeed_tpu.models import build_model
 
 
@@ -66,6 +67,68 @@ def test_compute_elastic_config_v01_world_check():
     assert batch == 1680
     with pytest.raises(ElasticityIncompatibleWorldSize):
         compute_elastic_config(cfg, world_size=11)
+
+
+@pytest.mark.parametrize("bad, match", [
+    ({"micro_batch_sizes": []}, "micro_batch_sizes"),
+    ({"micro_batch_sizes": [0, 2]}, "micro_batch_sizes"),
+    ({"micro_batch_sizes": [2, "four"]}, "micro_batch_sizes"),
+    ({"max_train_batch_size": 2, "micro_batch_sizes": [4, 8]},
+     "max_train_batch_size"),
+    ({"min_gpus": 0}, "min_gpus"),
+    ({"min_gpus": 8, "max_gpus": 4}, "max_gpus"),
+    ({"version": 0.3}, "version"),
+    ({"version": "latest"}, "version"),
+    ({"model_parallel_size": 0}, "model_parallel_size"),
+    ({"model_parallel_size": 2, "version": 0.1}, "model parallelism"),
+    ({"num_gpus_per_node": 0}, "num_gpus_per_node"),
+    ({"num_gpus_per_node": 3, "model_parallel_size": 2},
+     "divisible by"),
+])
+def test_validate_rejects_inconsistent_configs(bad, match):
+    """Satellite: inconsistent elasticity configs fail fast with a
+    descriptive error instead of blowing up mid-run on a resize."""
+    with pytest.raises(ElasticityConfigError, match=match):
+        validate_elastic_config(bad)
+
+
+def test_validate_accepts_defaults_and_good_configs():
+    validate_elastic_config({})
+    validate_elastic_config({"micro_batch_sizes": [2, 4],
+                             "max_train_batch_size": 64, "version": 0.1,
+                             "min_gpus": 1, "max_gpus": 16})
+    # integral floats (JSON/YAML 2e3-style literals) keep working — the
+    # batch arithmetic always handled them; only non-integral rejects
+    validate_elastic_config({"micro_batch_sizes": [2.0, 4],
+                             "max_train_batch_size": 2000.0,
+                             "min_gpus": 1.0, "max_gpus": 16.0})
+    # numpy scalars from array-derived configs keep working too
+    validate_elastic_config({"micro_batch_sizes": list(np.array([2, 4])),
+                             "max_train_batch_size": np.int64(2000),
+                             "min_gpus": np.float64(1.0)})
+    with pytest.raises(ElasticityConfigError, match="max_train_batch_size"):
+        validate_elastic_config({"max_train_batch_size": 100.5})
+
+
+def test_compute_elastic_config_validates_up_front():
+    cfg = {"elasticity": {"enabled": True, "micro_batch_sizes": [],
+                          "max_train_batch_size": 100}}
+    with pytest.raises(ElasticityConfigError, match="micro_batch_sizes"):
+        compute_elastic_config(cfg, world_size=8)
+
+
+def test_initialize_rejects_bad_elastic_config(devices8):
+    """The engine surfaces elasticity config errors at initialize() time
+    (satellite acceptance: descriptive error, not a mid-run failure)."""
+    cfg = elastic_engine_config()
+    cfg["elasticity"]["micro_batch_sizes"] = [2, -4]
+    with pytest.raises(ElasticityConfigError, match="micro_batch_sizes"):
+        deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
+    cfg = elastic_engine_config()
+    cfg["elasticity"]["num_gpus_per_node"] = 3
+    cfg["elasticity"]["model_parallel_size"] = 2
+    with pytest.raises(ElasticityConfigError, match="divisible by"):
+        deepspeed_tpu.initialize(model=build_model("tiny"), config=cfg)
 
 
 def elastic_engine_config():

@@ -42,7 +42,9 @@ MODULES = [
     "deepspeed_tpu.runtime.activation_checkpointing",
     "deepspeed_tpu.runtime.checkpointing",
     "deepspeed_tpu.runtime.data_pipeline",
+    "deepspeed_tpu.runtime.dataloader",
     "deepspeed_tpu.runtime.engine",
+    "deepspeed_tpu.runtime.resilience",
     "deepspeed_tpu.runtime.hybrid_engine",
     "deepspeed_tpu.runtime.pipe",
     "deepspeed_tpu.runtime.zero_infinity",
@@ -55,6 +57,7 @@ MODULES = [
     "deepspeed_tpu.telemetry",
     "deepspeed_tpu.telemetry.flight_recorder",
     "deepspeed_tpu.utils.comms_logging",
+    "deepspeed_tpu.utils.restart",
     "deepspeed_tpu.utils.zero_to_fp32",
 ]
 

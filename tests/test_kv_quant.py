@@ -462,8 +462,17 @@ def test_bench_schema_validator():
                          "ppl_gate_ok": True, "greedy_parity": True,
                          "mean_matched_prefix_frac": 1.0,
                          "disabled_parity": True, "kv_occupancy": occ}}
-    for name in bench._STAMPED_PHASES[:-1]:
+    for name in bench._STAMPED_PHASES:
+        if name in ("kv_quant", "train_chaos"):
+            continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
+    good["train_chaos"] = {"recovery_time_s": 0.12, "steps_lost": 1,
+                           "resume_parity": True,
+                           "sigterm_resume_parity": True,
+                           "injectors_off_parity": True, "restarts": 1,
+                           "n_steps": 8, "crash_at_step": 5,
+                           "urgent_save_s": 0.01,
+                           "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
     # skipped phases are exempt from field checks
     skipped = dict(good)
@@ -479,6 +488,24 @@ def test_bench_schema_validator():
     bad2["prefix"] = {"n_requests": 1}
     assert any("prefix.kv_occupancy" in p
                for p in bench.validate_serving_schema(bad2))
+    # train_chaos typed checks: wrong types and missing fields are named,
+    # a bool where an int is expected is rejected, a skip stamp is exempt
+    bad3 = dict(good)
+    bad3["train_chaos"] = {"recovery_time_s": "fast", "steps_lost": True,
+                           "kv_occupancy": dict(occ)}
+    problems3 = bench.validate_serving_schema(bad3)
+    assert any("train_chaos.recovery_time_s" in p for p in problems3)
+    assert any("train_chaos.steps_lost" in p for p in problems3)
+    assert any("train_chaos.resume_parity: missing" in p for p in problems3)
+    skipped2 = dict(good)
+    skipped2["train_chaos"] = {"phase_skipped": "not selected"}
+    assert bench.validate_serving_schema(skipped2) == []
+    # the shared typed-phase checker applies the bool guard to kv_quant
+    # too: a bool where an int is expected is named, not silently passed
+    bad4 = dict(good)
+    bad4["kv_quant"] = dict(good["kv_quant"], max_concurrent_base=True)
+    assert any("kv_quant.max_concurrent_base" in p
+               for p in bench.validate_serving_schema(bad4))
 
 
 def test_phase_runner_skip_and_budget(tmp_path, monkeypatch):
