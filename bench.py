@@ -250,7 +250,18 @@ _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("mean_matched_prefix_frac", (int, float)),
                   ("disabled_parity", bool))
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
-                   "telemetry", "chaos", "train_chaos", "kv_quant")
+                   "telemetry", "chaos", "train_chaos", "kv_quant",
+                   "disagg")
+# Typed shape of the disagg phase (docs/SERVING.md "Disaggregated
+# serving"): the TTFT/TPOT comparison, handoff counts and parity bits
+# the acceptance gates read.
+_DISAGG_KEYS = (("handoffs_completed", int),
+                ("handoff_fallbacks", int),
+                ("tpot_improved", bool),
+                ("handoff_parity", bool),
+                ("disabled_parity", bool),
+                ("replicas", int),
+                ("decode_reserve_tokens", int))
 # Typed shape of the train_chaos phase (docs/TRAINING.md "Fault
 # tolerance"): recovery/steps-lost/parity numbers the robustness gates
 # read. ``recovery_time_s`` may be absent only on a skipped phase.
@@ -295,6 +306,11 @@ def validate_serving_schema(serving: dict):
         problems.append("train_chaos: missing or not an object")
     elif "phase_skipped" not in tc:
         _check_typed_phase("train_chaos", tc, _TRAIN_CHAOS_KEYS, problems)
+    dg = serving.get("disagg")
+    if not isinstance(dg, dict):
+        problems.append("disagg: missing or not an object")
+    elif "phase_skipped" not in dg:
+        _check_typed_phase("disagg", dg, _DISAGG_KEYS, problems)
     for name in _STAMPED_PHASES:
         ph = serving.get(name)
         if not isinstance(ph, dict):
@@ -928,6 +944,126 @@ def bench_serving(on_tpu: bool):
             "disabled_parity": bool(gens_base == gens_off),
         }
 
+    def run_disagg_phase():
+        """Disaggregated prefill/decode serving (docs/SERVING.md
+        "Disaggregated serving") under mixed traffic: a few LONG
+        batch-class prompts ride alongside latency-critical interactive
+        requests. Three runs at equal replica count: (a) the PR 7 stack
+        (no disaggregation block), (b) the same fleet with the block
+        present but disabled — ASSERTED byte-for-byte (a), and (c) the
+        fleet split 2 prefill + 2 decode with KV handoff. Reports p95
+        interactive TTFT/TPOT mixed vs disagg, handoff counts, and the
+        parity bits; handoff resume must be greedy byte-lossless vs the
+        mixed run (asserted, with handoffs > 0 so it isn't vacuous)."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+
+        n_rep = 4
+        if on_tpu:
+            n_int, n_batch = 12, 6
+            int_plen, batch_plen = 64, 1024
+            int_new, batch_new = 24, 8
+            reserve = 64
+        else:
+            n_int, n_batch = 6, 3
+            int_plen, batch_plen = 8, 48
+            int_new, batch_new = 6, 4
+            reserve = 8
+        int_prompts = [rng.integers(0, cfg.vocab_size,
+                                    size=int_plen).tolist()
+                       for _ in range(n_int)]
+        batch_prompts = [rng.integers(0, cfg.vocab_size,
+                                      size=batch_plen).tolist()
+                         for _ in range(n_batch)]
+
+        def engine_factory(i):
+            return InferenceEngineV2(engine.model, params=engine.params,
+                                     config=type(vcfg)(**vars(vcfg)))
+
+        def run(disagg_block):
+            extra = ({"disaggregation": disagg_block}
+                     if disagg_block is not None else {})
+            scfg = ServingConfig(max_queue_depth=64, **extra)
+            fe = ServingFrontend([engine_factory(i) for i in range(n_rep)],
+                                 scfg, engine_factory=engine_factory)
+            try:
+                # warmup: compile every replica's shape buckets outside
+                # the clock (disagg also warms the handoff path)
+                warm = [fe.submit(int_prompts[0], max_new_tokens=2)
+                        for _ in range(n_rep)]
+                fe.wait_all(warm, timeout=600)
+                # batch first: the long prefills are already queued when
+                # the interactive burst lands — the contention the role
+                # split is supposed to absorb
+                bh = [fe.submit(p, max_new_tokens=batch_new,
+                                request_class="batch")
+                      for p in batch_prompts]
+                ih = [fe.submit(p, max_new_tokens=int_new,
+                                request_class="interactive")
+                      for p in int_prompts]
+                completed = fe.wait_all(bh + ih, timeout=600)
+                ttfts, gaps = [], []
+                int_gens, batch_gens = [], []
+                for h in ih:
+                    evs = h.drain()
+                    int_gens.append([ev.token for ev in evs])
+                    if evs:
+                        ttfts.append(evs[0].t - h._req.arrival_t)
+                        gaps.extend(b.t - a.t
+                                    for a, b in zip(evs, evs[1:]))
+                for h in bh:
+                    batch_gens.append([ev.token for ev in h.drain()])
+                states = [h.state for h in bh + ih]
+                snap = fe.metrics_snapshot()
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+            assert completed and all(s == RequestState.FINISHED
+                                     for s in states), states
+            pct = lambda xs, q: (round(float(np.percentile(xs, q)) * 1e3, 3)  # noqa: E731
+                                 if xs else -1.0)
+            return {"gens": (int_gens, batch_gens),
+                    "p95_ttft_ms": pct(ttfts, 95),
+                    "p95_tpot_ms": pct(gaps, 95),
+                    "snap": snap}
+
+        mixed = run(None)
+        disabled = run({"enabled": False,
+                        "roles": ["prefill", "prefill", "decode", "decode"]})
+        disagg = run({"enabled": True,
+                      "roles": ["prefill", "prefill", "decode", "decode"],
+                      "decode_reserve_tokens": reserve,
+                      "handoff": {"enabled": True, "max_staged": 16}})
+        snap = disagg["snap"]
+        # disabled = byte-for-byte PR 7; handoff = byte-lossless resume
+        assert disabled["gens"] == mixed["gens"], \
+            "disaggregation.enabled=false diverged from the PR 7 stack"
+        assert snap["handoffs_completed"] > 0, \
+            "disagg run completed no handoffs — parity would be vacuous"
+        assert disagg["gens"] == mixed["gens"], \
+            "KV handoff broke greedy byte-parity"
+        return {
+            "replicas": n_rep,
+            "roles": ["prefill", "prefill", "decode", "decode"],
+            "n_interactive": n_int, "n_batch": n_batch,
+            "interactive_prompt_len": int_plen,
+            "batch_prompt_len": batch_plen,
+            "decode_reserve_tokens": reserve,
+            "mixed": {"p95_interactive_ttft_ms": mixed["p95_ttft_ms"],
+                      "p95_interactive_tpot_ms": mixed["p95_tpot_ms"]},
+            "disagg": {"p95_interactive_ttft_ms": disagg["p95_ttft_ms"],
+                       "p95_interactive_tpot_ms": disagg["p95_tpot_ms"]},
+            "tpot_improved": bool(0 <= disagg["p95_tpot_ms"]
+                                  < mixed["p95_tpot_ms"]),
+            "handoffs_completed": int(snap["handoffs_completed"]),
+            "handoff_fallbacks": int(snap["handoff_fallbacks"]),
+            "interactive_shed": int(
+                snap.get("requests_shed_class_interactive", 0)),
+            "batch_shed": int(snap.get("requests_shed_class_batch", 0)),
+            "handoff_parity": bool(disagg["gens"] == mixed["gens"]),
+            "disabled_parity": bool(disabled["gens"] == mixed["gens"]),
+        }
+
     def run_train_chaos_phase():
         """Training fault-tolerance chaos phase (docs/TRAINING.md "Fault
         tolerance"): a supervised tiny train run is killed at step k —
@@ -1102,6 +1238,11 @@ def bench_serving(on_tpu: bool):
     # int8 KV quantization phase (docs/SERVING.md "KV quantization"):
     # concurrency at a fixed KV byte budget + perplexity/parity gates
     result["kv_quant"] = runner.run("kv_quant", run_kv_quant_phase)
+    # disaggregated prefill/decode phase (docs/SERVING.md "Disaggregated
+    # serving"): mixed long-prefill + interactive traffic, 2 prefill +
+    # 2 decode vs 4 mixed — p95 interactive TTFT/TPOT on/off, handoff
+    # count, byte-parity (handoff AND disabled-path, both asserted)
+    result["disagg"] = runner.run("disagg", run_disagg_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

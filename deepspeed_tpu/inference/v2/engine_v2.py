@@ -242,6 +242,25 @@ class InferenceEngineV2:
         if seq is not None:
             self.state_manager.record_tokens(seq, tokens)
 
+    # ----------------------------------------------------------- KV handoff
+    def export_sequence(self, uid: int) -> Optional[Dict[str, object]]:
+        """Host-RAM snapshot of a sequence's KV blocks (pool slabs +
+        kv_quant scale planes + metadata) for disaggregated
+        prefill→decode handoff — see
+        :meth:`DSStateManager.export_sequence`. The sequence stays
+        tracked; the caller :meth:`flush`\\ es once the payload is
+        staged."""
+        return self.state_manager.export_sequence(uid)
+
+    def import_sequence(self, uid: int, payload: Dict[str, object],
+                        tokens: Sequence[int]) -> None:
+        """Adopt an exported sequence's KV into this engine's pool and
+        resume decoding from it byte-losslessly — see
+        :meth:`DSStateManager.import_sequence`. Raises (leaving this
+        engine untouched) on representation mismatch or KV pressure; the
+        serving layer falls back to re-prefilling."""
+        self.state_manager.import_sequence(uid, payload, tokens)
+
     def match_prefix(self, uid: int, prompt_tokens: Sequence[int]) -> int:
         """Prefix-cache lookup for a new sequence: share every cached
         leading full KV block of ``prompt_tokens`` and return the matched

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .blocked_allocator import BlockedAllocator
 
@@ -213,6 +214,105 @@ class DSStateManager:
         if dropped:
             self._release_blocks(dropped)
         return len(dropped)
+
+    # -- KV handoff (disaggregated prefill/decode) --------------------------
+    def export_sequence(self, uid: int) -> Optional[Dict[str, object]]:
+        """Host-RAM snapshot of a sequence's KV state for cross-engine
+        handoff (docs/SERVING.md "Disaggregated serving"): every pool
+        slab the sequence's block table references — K and V, plus the
+        ``k_scale``/``v_scale`` planes under kv_quant — copied
+        device→host (async transfer started for all slabs before any is
+        materialized, so the copies overlap), with the metadata
+        :meth:`import_sequence` validates against. Whole blocks are
+        copied verbatim (stale slots past ``seen_tokens`` included), so
+        an import reproduces the pool content byte-for-byte — attention
+        masks those positions on both sides. Shared prefix blocks export
+        like private ones (content copy; the source's refcounts are
+        untouched). Returns ``None`` for unknown/empty sequences. The
+        source sequence keeps its state — the caller flushes after the
+        payload is staged."""
+        seq = self._seqs.get(uid)
+        if seq is None or not seq.kv_blocks:
+            return None
+        ids = jnp.asarray(seq.kv_blocks, dtype=jnp.int32)
+        arrs = {name: jnp.take(pool, ids, axis=1)
+                for name, pool in self.kv_cache.items()}
+        for a in arrs.values():
+            try:
+                a.copy_to_host_async()
+            except Exception:   # backend without async host copy
+                pass
+        return {"seen_tokens": seq.seen_tokens,
+                "block_size": self.block_size,
+                "kv_quant": self.kv_quant,
+                "n_blocks": len(seq.kv_blocks),
+                "slabs": {name: np.asarray(a) for name, a in arrs.items()}}
+
+    def import_sequence(self, uid: int, payload: Dict[str, object],
+                        tokens: Sequence[int]) -> None:
+        """Adopt an exported sequence: allocate fresh blocks, scatter the
+        payload's slabs (and scale planes) into this pool at the new
+        ids, and seed the descriptor at the source's ``seen_tokens`` —
+        the destination decodes from here exactly as the source would
+        have (byte-lossless: int8/f32/bf16 slabs round-trip host copies
+        exactly).
+
+        ``tokens`` are the actual tokens the imported KV encodes (length
+        must equal ``seen_tokens``): they replay ``record_tokens`` so
+        the destination's prefix-cache hash chain covers the imported
+        blocks — full blocks register in the index and later prompts
+        sharing the prefix hit, exactly as if the prefill had run here.
+
+        Raises on representation mismatch (block size / kv_quant — a
+        heterogeneous fleet must recompute instead), on a uid that
+        already has state, and on insufficient capacity (after LRU
+        prefix-cache eviction). Failure leaves the manager untouched —
+        the caller falls back to re-prefilling."""
+        slabs = payload["slabs"]
+        if int(payload["block_size"]) != self.block_size:
+            raise ValueError(
+                f"KV import block_size mismatch: payload "
+                f"{payload['block_size']} vs pool {self.block_size}")
+        if bool(payload["kv_quant"]) != self.kv_quant:
+            raise ValueError(
+                f"KV import representation mismatch: payload kv_quant="
+                f"{payload['kv_quant']} vs pool kv_quant={self.kv_quant}")
+        if set(slabs) != set(self.kv_cache):
+            raise ValueError(f"KV import slab keys {sorted(slabs)} != "
+                             f"pool keys {sorted(self.kv_cache)}")
+        seen = int(payload["seen_tokens"])
+        if len(tokens) != seen:
+            raise ValueError(f"KV import needs the {seen} tokens the KV "
+                             f"encodes, got {len(tokens)}")
+        existing = self._seqs.get(uid)
+        if existing is not None and (existing.seen_tokens
+                                     or existing.kv_blocks):
+            raise ValueError(f"cannot import into sequence {uid}: it "
+                             "already has KV state")
+        n = int(payload["n_blocks"])
+        short = n - self.allocator.free_blocks
+        if short > 0 and self.prefix_cache_enabled:
+            self._evict(short)
+        if n > self.allocator.free_blocks:
+            raise RuntimeError(
+                f"cannot import {n} KV blocks "
+                f"({self.allocator.free_blocks} free)")
+        seq = self.get_or_create_sequence(uid)
+        blocks = self.allocator.allocate(n)
+        try:
+            ids = jnp.asarray(blocks, dtype=jnp.int32)
+            for name, pool in self.kv_cache.items():
+                self.kv_cache[name] = pool.at[:, ids].set(
+                    jnp.asarray(slabs[name], dtype=pool.dtype))
+            seq.kv_blocks.extend(blocks)
+            seq.seen_tokens = seen
+            # prefix-index coherence: rebuild the hash chain over the
+            # imported tokens (no-op when the cache is disabled)
+            self.record_tokens(seq, tokens)
+        except Exception:
+            self._seqs.pop(uid, None)
+            self.allocator.release(blocks)
+            raise
 
     @property
     def tracked_sequences(self) -> List[int]:

@@ -69,8 +69,20 @@ class ContinuousBatchingScheduler:
                  sample_fn: Optional[Callable] = None,
                  proposer: Optional[DraftProposer] = None,
                  max_draft_tokens: int = 4,
-                 tracer=None, trace_label: str = "scheduler"):
+                 tracer=None, trace_label: str = "scheduler",
+                 prefill_only: bool = False,
+                 decode_reserve_tokens: int = 0):
         self.engine = engine
+        # disaggregated serving roles (docs/SERVING.md "Disaggregated
+        # serving"): a prefill-only scheduler never decodes — a request
+        # whose prompt completes is finished with reason "prefilled" and
+        # its KV left RESIDENT for the handoff export; a decode-role
+        # scheduler reserves part of each step's token budget so queued
+        # prompt chunks can never blow up the forward a decode rides in.
+        # Defaults (False / 0) keep the historical scheduler byte for
+        # byte.
+        self.prefill_only = bool(prefill_only)
+        self.decode_reserve_tokens = int(decode_reserve_tokens)
         # telemetry: per-forward spans under ``trace_label``'s trace and
         # per-request prefill/decode stage spans (docs/OBSERVABILITY.md).
         # The default NOOP tracer keeps the historical hot path: one
@@ -130,6 +142,33 @@ class ContinuousBatchingScheduler:
                 "prefill", trace_id=trace_id,
                 attrs={"prompt_tokens": len(req.prompt_tokens)})}
         self.pending.append(req)
+
+    def submit_prefilled(self, uid: int, prompt_tokens: List[int],
+                         last_logits, max_new_tokens: int = 64,
+                         eos_token_id: Optional[int] = None,
+                         on_token: Optional[Callable[[int, int], None]] = None,
+                         on_finish: Optional[Callable[["Request", str],
+                                                      None]] = None,
+                         trace_id: Optional[str] = None) -> Request:
+        """Resume a sequence whose prompt KV was imported from a
+        prefill-role replica (``engine.import_sequence`` must have run
+        first): the request enters ``running`` directly with the prompt
+        marked fed and the source's final-position logits, so the first
+        decode step samples exactly the token the source would have —
+        byte-lossless under greedy decoding (docs/SERVING.md
+        "Disaggregated serving")."""
+        req = Request(uid, list(prompt_tokens), max_new_tokens,
+                      eos_token_id, on_token, on_finish)
+        req.prompt_fed = len(req.prompt_tokens)
+        req.prefix_matched = 0       # no lookup: the KV arrived whole
+        req.last_logits = np.asarray(last_logits)
+        if trace_id is not None and self.tracer.enabled:
+            # no prefill stage here (it ran on the source replica); the
+            # decode span opens at the first emitted token as usual
+            req.trace_id = trace_id
+            req.spans = {}
+        self.running[uid] = req
+        return req
 
     def cancel(self, uid: int) -> bool:
         """Abort a request wherever it is; frees its KV blocks immediately
@@ -210,6 +249,8 @@ class ContinuousBatchingScheduler:
         # plus up to max_draft_tokens proposer drafts when speculating
         # (the chunk is then verified like a K+1-token prefill chunk)
         for uid, req in list(self.running.items()):
+            if self.prefill_only:
+                break     # prefill-role: decode rows never pack here
             if req.prompt_remaining > 0 or budget <= 0:
                 continue  # still prefilling (below) / out of budget (defer)
             tok = self.sample_fn(req.last_logits)
@@ -232,15 +273,27 @@ class ContinuousBatchingScheduler:
                 # ceiling) — degrade to plain decode rather than defer
                 plan.append((req, [tok], True))
                 budget -= 1
-        # (b) prompt chunks: running-but-prefilling first, then pending
+        # (b) prompt chunks: running-but-prefilling first, then pending.
+        # A decode-role scheduler holds back the UNUSED part of its
+        # decode reservation from prompt chunks — the forward a decode
+        # row rides in stays small even under a queued-prompt burst.
+        # Clamped so at least one prompt token can always be scheduled
+        # (an over-sized reservation must degrade prefill, not wedge it).
+        reserve = 0
+        if self.decode_reserve_tokens > 0:
+            decode_used = self._budget - budget
+            reserve = max(0, self.decode_reserve_tokens - decode_used)
+            reserve = min(reserve, max(0, budget - 1))
+        prompt_budget = budget - reserve
         for req in candidates + new_candidates:
             scheduled = False
-            if budget > 0 and len(uids) < self._max_seqs:
-                take = min(req.prompt_remaining, budget, self._chunk)
+            if prompt_budget > 0 and len(uids) < self._max_seqs:
+                take = min(req.prompt_remaining, prompt_budget, self._chunk)
                 chunk = req.prompt_tokens[req.prompt_fed:req.prompt_fed + take]
                 if admit(req, chunk):
                     plan.append((req, chunk, False))
                     budget -= take
+                    prompt_budget -= take
                     scheduled = True
             if not scheduled and req.uid not in self.running:
                 self.pending.appendleft(req)   # new request deferred
@@ -366,6 +419,24 @@ class ContinuousBatchingScheduler:
                 self.running[req.uid] = req
             if req.prompt_remaining > 0:
                 continue  # mid-prefill: sample only once the prompt is done
+            if self.prefill_only:
+                # prompt complete on a prefill-role scheduler: stop here.
+                # The KV is deliberately NOT flushed — the serving layer
+                # exports it for the decode-role handoff and flushes once
+                # the payload is staged (docs/SERVING.md "Disaggregated
+                # serving"); last_logits carries the final-position
+                # logits the destination samples its first token from.
+                req.done = True
+                req.finish_reason = "prefilled"
+                self._end_request_spans(req, "prefilled")
+                self.finished[req.uid] = req
+                self.running.pop(req.uid, None)
+                if self.proposer is not None:
+                    self.proposer.release(req.uid)
+                done_now.append(req.uid)
+                if req.on_finish is not None:
+                    req.on_finish(req, "prefilled")
+                continue
             ended = (req.eos_token_id is not None and req.generated
                      and req.generated[-1] == req.eos_token_id)
             if len(req.generated) >= req.max_new_tokens or ended:

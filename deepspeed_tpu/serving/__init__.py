@@ -11,10 +11,12 @@ Light names import eagerly; ``ServingFrontend``/``Replica``/
 ``ReplicaRouter`` load lazily because they pull in the JAX engine stack.
 """
 
-from .config import (FaultsConfig, FaultToleranceConfig,  # noqa: F401
+from .config import (ClassPolicy, DisaggregationConfig,  # noqa: F401
+                     FaultsConfig, FaultToleranceConfig, HandoffConfig,
                      KVQuantConfig, PrefixCacheConfig, ServingConfig,
                      SpeculativeConfig)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
+from .handoff import HandoffStager  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, serving_metrics)
 from .queue import AdmissionQueue  # noqa: F401
@@ -42,7 +44,8 @@ def __getattr__(name):
 
 
 __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
-           "SpeculativeConfig",
+           "SpeculativeConfig", "ClassPolicy", "DisaggregationConfig",
+           "HandoffConfig", "HandoffStager",
            "FaultToleranceConfig", "FaultsConfig", "FaultInjector",
            "InjectedFault", "ReplicaSupervisor",
            "MetricsRegistry",

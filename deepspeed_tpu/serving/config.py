@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from pydantic import Field
+from pydantic import Field, field_validator
 
 from ..runtime.config_utils import DSConfigModel
 from ..telemetry.config import TelemetryConfig
@@ -102,6 +102,70 @@ class SpeculativeConfig(DSConfigModel):
                          "(expected 'ngram' or 'draft_model')")
 
 
+class ClassPolicy(DSConfigModel):
+    """One entry of the ``classes: {...}`` map (docs/CONFIG.md,
+    docs/SERVING.md "Disaggregated serving"): per-request-class SLO
+    defaults. ``submit(request_class=...)`` resolves priority/deadline
+    from the class when the caller passes neither; ``shed_rank`` orders
+    brownout victim selection — HIGHER ranks shed first (batch before
+    interactive), ties falling back to (priority, deadline, FIFO)."""
+
+    priority: Optional[int] = None       # None → ServingConfig.default_priority
+    deadline_ms: Optional[float] = None  # None → default_deadline_ms
+    shed_rank: int = 0
+
+
+class HandoffConfig(DSConfigModel):
+    """``disaggregation.handoff`` block: KV block handoff from
+    prefill-role to decode-role replicas through a host-RAM staging
+    buffer (serving/handoff.py). Disabled is only legal with no
+    prefill-role replicas — a prefill-only replica with nowhere to send
+    its KV could never finish a request."""
+
+    enabled: bool = True
+    # staged exports held in host RAM at once; a full buffer degrades
+    # that handoff to the recompute fallback (the request re-prefills on
+    # a decode-capable replica) instead of blocking the prefill replica
+    max_staged: int = 8
+
+
+class DisaggregationConfig(DSConfigModel):
+    """``disaggregation: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Disaggregated serving"): split the replica pool into prefill-heavy
+    / decode-heavy / mixed roles with KV handoff between them. Prefill
+    replicas run prompt-chunk-only steps and export each finished
+    prompt's KV blocks; decode replicas import them and generate, with
+    ``decode_reserve_tokens`` of every step's token budget held back
+    from prompt chunks so queued prompts can never inflate decode TPOT.
+    Disabled (the default) keeps the single-role scheduler and the
+    unweighted least-outstanding-tokens router byte for byte."""
+
+    enabled: bool = False
+    # per-replica roles ("prefill" | "decode" | "mixed"), indexed by
+    # replica id; [] = every replica mixed. When given, the length must
+    # match the fleet size and at least one replica must be
+    # decode-capable (decode/mixed) — the frontend validates.
+    roles: List[str] = Field(default_factory=list)
+    # decode-role schedulers hold back this many tokens of each step's
+    # ragged budget from prompt chunks (size it below
+    # max_ragged_batch_size - max_chunk_tokens; progress is guaranteed
+    # regardless — at least one prompt token always schedules)
+    decode_reserve_tokens: int = 0
+    # router cost model: a pending prefill token costs far less wall
+    # clock than an owed decode token (one chunked forward vs one
+    # forward EACH), so the two are weighted separately — the fix for
+    # "2000 prompt tokens == 2000 decode steps" herding interactive
+    # traffic onto prefill-loaded replicas
+    prefill_token_cost: float = 1.0
+    decode_token_cost: float = 8.0
+    handoff: HandoffConfig = Field(default_factory=HandoffConfig)
+
+    def role_of(self, replica_id: int) -> str:
+        if not self.enabled or replica_id >= len(self.roles):
+            return "mixed"
+        return self.roles[replica_id]
+
+
 class FaultToleranceConfig(DSConfigModel):
     """``fault_tolerance: {...}`` block (docs/CONFIG.md, docs/SERVING.md
     "Fault tolerance"): replica supervision (restart DEAD replicas with
@@ -169,6 +233,25 @@ class ServingConfig(DSConfigModel):
     default_priority: int = 1           # Priority.NORMAL
     default_deadline_ms: Optional[float] = None   # None = no SLO deadline
     default_max_new_tokens: int = 64
+    # request classes (docs/SERVING.md "Disaggregated serving"):
+    # submit(request_class=...) resolves per-class priority/deadline
+    # defaults and the brownout shed order from here. The stock map:
+    # interactive (the default class — ServingConfig defaults, shed
+    # last) and batch (Priority.LOW, shed first under brownout). A
+    # user-supplied map is MERGED over the stock entries (validator
+    # below), so adding a custom class never silently deletes the
+    # defaults ``default_class`` points at.
+    classes: Dict[str, ClassPolicy] = Field(default_factory=lambda: {
+        "interactive": ClassPolicy(),
+        "batch": ClassPolicy(priority=2, shed_rank=1)})
+    default_class: str = "interactive"
+
+    @field_validator("classes", mode="after")
+    @classmethod
+    def _merge_stock_classes(cls, v):
+        v.setdefault("interactive", ClassPolicy())
+        v.setdefault("batch", ClassPolicy(priority=2, shed_rank=1))
+        return v
     # replicas
     num_replicas: int = 1               # fleet size (from_engine_factory)
     # a busy replica with no completed iteration for this long is DEAD.
@@ -189,6 +272,11 @@ class ServingConfig(DSConfigModel):
     # unified telemetry: request tracing + flight recorder
     # (docs/OBSERVABILITY.md); disabled = the no-op tracer
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    # disaggregated prefill/decode serving: role-split replica pool with
+    # KV handoff and the weighted router cost model (docs/SERVING.md
+    # "Disaggregated serving"); disabled = the single-role stack
+    disaggregation: DisaggregationConfig = Field(
+        default_factory=DisaggregationConfig)
     # replica supervision + request failover + brownout
     # (docs/SERVING.md "Fault tolerance"); disabled = historical behavior
     fault_tolerance: FaultToleranceConfig = Field(

@@ -21,6 +21,7 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 from ..telemetry import FlightRecorder
+from ..utils.logging import logger
 from .config import ServingConfig
 from .metrics import MetricsRegistry, serving_metrics
 from .queue import AdmissionQueue
@@ -72,11 +73,26 @@ class ServingFrontend:
         # deterministic fault injection (test-only; serving/faults.py) —
         # None when the ``faults:`` block is off: no hooks, no proxies
         self.injector = self.config.faults.build_injector()
+        # disaggregated prefill/decode serving (docs/SERVING.md
+        # "Disaggregated serving"): role-split replicas + host-RAM KV
+        # handoff staging. None when disabled — no role enforcement, no
+        # handoff hooks, the historical single-role stack byte for byte.
+        dis = self.config.disaggregation
+        self._disagg = dis if dis.enabled else None
+        self._stager = None
+        if self._disagg is not None:
+            self._validate_disaggregation(len(engines))
+            if dis.handoff.enabled:
+                from .handoff import HandoffStager
+
+                self._stager = HandoffStager(dis.handoff.max_staged,
+                                             self.metrics)
         replicas = [self._build_replica(i, eng)
                     for i, eng in enumerate(engines)]
         self.router = ReplicaRouter(replicas, self.admission, self.metrics,
                                     tracer=self.tracer,
-                                    recorder=self.recorder)
+                                    recorder=self.recorder,
+                                    disaggregation=self._disagg)
         self.supervisor = None
         if ft.enabled:
             from .supervisor import ReplicaSupervisor
@@ -90,6 +106,35 @@ class ServingFrontend:
         self.router.start()
         if self.supervisor is not None:
             self.supervisor.start()
+
+    def _validate_disaggregation(self, n_engines: int) -> None:
+        """Reject role maps that cannot serve (docs/SERVING.md
+        "Disaggregated serving"): unknown roles, a role list that does
+        not match the fleet, a fleet with no decode-capable replica
+        (prefill-only replicas can never emit a token), and prefill
+        roles without the handoff path (their finished prompts would
+        have nowhere to go)."""
+        dis = self.config.disaggregation
+        roles = list(dis.roles)
+        bad = [r for r in roles if r not in ("prefill", "decode", "mixed")]
+        if bad:
+            raise ValueError(f"disaggregation.roles has unknown roles "
+                             f"{bad} (expected prefill/decode/mixed)")
+        if roles and len(roles) != n_engines:
+            raise ValueError(
+                f"disaggregation.roles lists {len(roles)} roles for "
+                f"{n_engines} replicas — one role per replica")
+        if roles and not any(r in ("decode", "mixed") for r in roles):
+            raise ValueError("disaggregation.roles needs at least one "
+                             "decode-capable (decode/mixed) replica")
+        if "prefill" in roles and not dis.handoff.enabled:
+            raise ValueError("disaggregation with prefill-role replicas "
+                             "requires handoff.enabled")
+
+    def _role_of(self, replica_id: int) -> str:
+        if self._disagg is None:
+            return "mixed"
+        return self._disagg.role_of(replica_id)
 
     def _build_replica(self, replica_id: int, engine) -> Replica:
         """One replica over ``engine`` with this frontend's full wiring —
@@ -118,12 +163,19 @@ class ServingFrontend:
                           self.config.prefix_cache.max_cached_blocks
                           or None)
         ft = self.config.fault_tolerance
+        role = self._role_of(replica_id)
         return Replica(replica_id, engine, self.metrics, self._sample_fn,
                        wedge_timeout_s=self.config.wedge_timeout_s,
                        speculative=self._spec, tracer=self.tracer,
                        recorder=self._replica_recorder,
                        faults=self.injector,
-                       on_failover=self._failover if ft.enabled else None)
+                       on_failover=self._failover if ft.enabled else None,
+                       role=role,
+                       decode_reserve_tokens=(
+                           self._disagg.decode_reserve_tokens
+                           if self._disagg is not None else 0),
+                       on_handoff=(self._handoff if role == "prefill"
+                                   else None))
 
     @classmethod
     def from_engine_factory(cls, engine_factory: Callable[[int], object],
@@ -145,26 +197,43 @@ class ServingFrontend:
                max_new_tokens: Optional[int] = None,
                priority: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               eos_token_id: Optional[int] = None) -> RequestHandle:
+               eos_token_id: Optional[int] = None,
+               request_class: Optional[str] = None) -> RequestHandle:
         """Admit a request. Raises :class:`Rejected` when shed (full queue,
         draining frontend, or a prompt no replica could ever schedule).
         ``priority``/``deadline_ms``/``max_new_tokens`` default from the
-        config (``default_priority`` etc.)."""
+        config (``default_priority`` etc.). ``request_class`` selects an
+        entry of ``config.classes`` (default ``config.default_class``):
+        its policy fills priority/deadline when the caller passes
+        neither, labels the per-class TTFT/TPOT/queue metrics, and
+        orders brownout shedding (docs/SERVING.md "Disaggregated
+        serving")."""
+        cfg = self.config
+        cls = request_class if request_class is not None else cfg.default_class
+        policy = cfg.classes.get(cls)
+        if policy is None:
+            # caller bug, not traffic: reject BEFORE requests_submitted
+            # so the submitted/admitted/shed balance stays honest
+            raise ValueError(f"unknown request class {cls!r} "
+                             f"(configured: {sorted(cfg.classes)})")
         self.metrics.counter("requests_submitted").inc()
         if self._closed:
             self.metrics.counter("requests_shed").inc()
             raise Rejected("draining", "frontend is shut down")
-        cfg = self.config
         if priority is None:
-            priority = cfg.default_priority
+            priority = (policy.priority if policy.priority is not None
+                        else cfg.default_priority)
         if deadline_ms is None:
-            deadline_ms = cfg.default_deadline_ms
+            deadline_ms = (policy.deadline_ms
+                           if policy.deadline_ms is not None
+                           else cfg.default_deadline_ms)
         req = ServingRequest(
             prompt_tokens,
             max_new_tokens if max_new_tokens is not None
             else cfg.default_max_new_tokens,
             priority, deadline_ms / 1e3 if deadline_ms is not None else None,
-            eos_token_id)
+            eos_token_id,
+            request_class=cls, shed_rank=policy.shed_rank)
         if self.tracer.enabled:
             # root of this request's trace + the first stage (queue wait).
             # Rejection paths below close both via req.finish.
@@ -174,7 +243,8 @@ class ServingFrontend:
                 attrs={"uid": req.uid,
                        "prompt_tokens": len(req.prompt_tokens),
                        "max_new_tokens": req.max_new_tokens,
-                       "priority": req.priority})}
+                       "priority": req.priority,
+                       "class": req.request_class})}
             req.begin_span(self.tracer, "queue")
         max_len = min(r.engine.model.cfg.max_seq_len
                       for r in self.router.replicas)
@@ -186,6 +256,76 @@ class ServingFrontend:
                            f"tokens > max_seq_len {max_len}")
         self.admission.offer(req, block=cfg.shed_policy == "block")
         return RequestHandle(req, self)
+
+    # ------------------------------------------------------------ handoff
+    def _handoff(self, req: ServingRequest, sreq, engine,
+                 replica_id: int) -> None:
+        """Prefill-role completion hand-back (docs/SERVING.md
+        "Disaggregated serving"). Runs on the prefill replica's worker
+        thread (race-free engine access): export the finished prompt's
+        KV blocks to host RAM, flush them from the source engine, stage
+        the payload on the request, and re-queue it for a decode-role
+        replica. Export failure or a full staging buffer degrades to the
+        recompute fallback — the request re-prefills on a decode-capable
+        replica (the PR 5 resume path), never crashes. Cancel, deadline,
+        and shutdown races settle here before any staging."""
+        if (self._closed or req.cancel_requested.is_set()
+                or req.expired()):
+            try:
+                engine.flush(req.uid)
+            except Exception:
+                pass
+            if req.cancel_requested.is_set():
+                req.finish(RequestState.CANCELLED, FinishReason.CANCELLED)
+                self.metrics.counter("requests_cancelled").inc()
+            elif req.expired():
+                req.finish(RequestState.EXPIRED, FinishReason.DEADLINE)
+                self.metrics.counter("requests_expired").inc()
+            else:
+                req.finish(RequestState.REJECTED, "draining")
+                self.metrics.counter("requests_shed").inc()
+            return
+        payload = None
+        try:
+            payload = engine.export_sequence(req.uid)
+        except Exception as e:
+            logger.warning(f"serving replica {replica_id}: KV export for "
+                           f"request {req.uid} failed ({e!r}); falling "
+                           "back to re-prefill on a decode-capable replica")
+        finally:
+            try:
+                engine.flush(req.uid)
+            except Exception:
+                pass
+        # the "handoff" span covers staging + queue wait + import; it is
+        # ended by the decode replica at import (or by req.finish)
+        req.begin_span(self.tracer, "handoff",
+                       attrs={"from_replica": replica_id,
+                              "blocks": (payload or {}).get("n_blocks", 0)})
+        if payload is not None:
+            # last_logits rides the payload: the decode replica samples
+            # its first token from the source's final prompt position —
+            # the byte-losslessness hinge
+            payload["last_logits"] = sreq.last_logits
+        if payload is not None and self._stager is not None \
+                and self._stager.try_stage(req, payload):
+            self.metrics.counter("handoffs_started").inc()
+            req.handoff_t = time.monotonic()
+        else:
+            # every degraded handoff counts — export failure AND a full
+            # staging buffer — or a fleet whose exports always fail
+            # would be indistinguishable from one that never handed off
+            self.metrics.counter("handoff_fallbacks").inc()
+            # recompute fallback: must not land on a prefill-only
+            # replica (it would just hand off again — or loop forever
+            # when handoff keeps failing)
+            req.no_prefill = True
+        req.state = RequestState.QUEUED
+        req.replica_id = None
+        if not self.admission.requeue(req):
+            # queue closed mid-handoff: shutdown — terminal, slot freed
+            req.finish(RequestState.REJECTED, "draining")
+            self.metrics.counter("requests_shed").inc()
 
     # ----------------------------------------------------------- failover
     def _failover(self, req: ServingRequest) -> bool:
@@ -260,6 +400,7 @@ class ServingFrontend:
         replica from ``engine.occupancy()`` — the single snapshot that
         replaced the ad-hoc block counts (BlockedAllocator.occupancy)."""
         blocks = total_bytes = 0
+        role_blocks: dict = {}
         found = False
         for rep in self.router.replicas:
             occ_fn = getattr(getattr(rep, "engine", None), "occupancy", None)
@@ -272,9 +413,18 @@ class ServingFrontend:
             found = True
             blocks += occ.get("in_use_blocks", 0)
             total_bytes += occ.get("bytes_in_use", 0)
+            role = getattr(rep, "role", "mixed")
+            role_blocks[role] = (role_blocks.get(role, 0)
+                                 + occ.get("in_use_blocks", 0))
         if found:
             self.metrics.gauge("kv_blocks_in_use").set(blocks)
             self.metrics.gauge("kv_bytes_in_use").set(total_bytes)
+            # per-role split (docs/SERVING.md "Disaggregated serving"):
+            # handoff pressure — decode pools filling while prefill
+            # pools stay light — is visible in flight-recorder metric
+            # snapshots via these gauges
+            for role, n in role_blocks.items():
+                self.metrics.gauge(f"kv_blocks_in_use_role_{role}").set(n)
 
     def metrics_snapshot(self) -> dict:
         self._refresh_kv_gauges()

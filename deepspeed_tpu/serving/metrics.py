@@ -279,9 +279,29 @@ def serving_metrics() -> MetricsRegistry:
               # resumed elsewhere); restarts = supervisor replaced a DEAD
               # replica; brownout = shed by the degraded-capacity queue
               "requests_failed_over", "replica_restarts",
-              "requests_shed_brownout"):
+              "requests_shed_brownout",
+              # disaggregated serving (docs/SERVING.md "Disaggregated
+              # serving"): started = prompts exported+staged by
+              # prefill-role replicas; completed = imports that resumed
+              # on a decode-role replica; fallbacks = handoffs that
+              # degraded to re-prefill (export/import failure or a full
+              # staging buffer). Per-class shed counters for the stock
+              # classes (others appear on first use).
+              "handoffs_started", "handoffs_completed",
+              "handoff_fallbacks",
+              "requests_shed_class_interactive",
+              "requests_shed_class_batch"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
+              # phase-split router load + per-class queue depths + KV
+              # handoff staging occupancy + per-role KV pool split
+              # (docs/SERVING.md "Disaggregated serving")
+              "outstanding_prefill_tokens", "outstanding_decode_tokens",
+              "queue_depth_class_interactive", "queue_depth_class_batch",
+              "handoff_staged",
+              "kv_blocks_in_use_role_prefill",
+              "kv_blocks_in_use_role_decode",
+              "kv_blocks_in_use_role_mixed",
               # replicas_parked: circuit-broken slots (no more restarts);
               # capacity_alarm: 1 while any slot is parked — page on it;
               # brownout_active: 1 while the admission queue is shedding
@@ -292,7 +312,12 @@ def serving_metrics() -> MetricsRegistry:
               # quantization"): bytes shrink ~2x per block under kv_quant
               "kv_blocks_in_use", "kv_bytes_in_use"):
         reg.gauge(g)
-    for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s"):
+    for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
+              # per-class latency splits + staging→import handoff time
+              # (docs/SERVING.md "Disaggregated serving")
+              "ttft_s_class_interactive", "ttft_s_class_batch",
+              "tpot_s_class_interactive", "tpot_s_class_batch",
+              "handoff_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
     reg.histogram("queue_depth_hist", DEFAULT_DEPTH_BUCKETS)
     return reg

@@ -42,6 +42,10 @@ class AdmissionQueue:
         self._brownout = False
         self._lock = threading.Condition()
         self._heap: List[tuple] = []      # (order_key, ServingRequest)
+        # per-request-class depth (docs/SERVING.md "Disaggregated
+        # serving"): published as queue_depth_class_<cls> gauges; shed
+        # events count per class too (requests_shed_class_<cls>)
+        self._class_depth: dict = {}
         # earliest deadline among queued entries: the expired sweep only
         # scans the heap once this watermark has actually passed, so the
         # per-pop cost stays O(log n) on deadline-free / fresh traffic
@@ -57,6 +61,24 @@ class AdmissionQueue:
             depth = len(self._heap)
             self.metrics.gauge("queue_depth").set(depth)
             self.metrics.histogram("queue_depth_hist").observe(depth)
+            for cls, n in self._class_depth.items():
+                self.metrics.gauge(f"queue_depth_class_{cls}").set(n)
+
+    def _dec_class(self, req: ServingRequest) -> None:
+        """One request left the heap (any path); caller holds the lock
+        and calls _note_depth afterwards."""
+        cls = req.request_class
+        n = self._class_depth.get(cls, 0) - 1
+        self._class_depth[cls] = max(0, n)
+
+    def _count_shed(self, req: ServingRequest, reason: str) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.counter("requests_shed").inc()
+        self.metrics.counter(
+            f"requests_shed_class_{req.request_class}").inc()
+        if reason == FinishReason.BROWNOUT:
+            self.metrics.counter("requests_shed_brownout").inc()
 
     def offer(self, req: ServingRequest, block: bool = False,
               timeout: Optional[float] = None) -> None:
@@ -92,6 +114,8 @@ class AdmissionQueue:
 
     def _push_locked(self, req: ServingRequest) -> None:
         heapq.heappush(self._heap, (req.order_key, req))
+        self._class_depth[req.request_class] = \
+            self._class_depth.get(req.request_class, 0) + 1
         if req.deadline_t is not None:
             self._earliest_deadline = min(self._earliest_deadline,
                                           req.deadline_t)
@@ -111,10 +135,7 @@ class AdmissionQueue:
         return True
 
     def _shed(self, req: ServingRequest, reason: str) -> None:
-        if self.metrics is not None:
-            self.metrics.counter("requests_shed").inc()
-            if reason == FinishReason.BROWNOUT:
-                self.metrics.counter("requests_shed_brownout").inc()
+        self._count_shed(req, reason)
         req.finish(RequestState.REJECTED, reason)
         raise Rejected(reason, f"queue depth {len(self._heap)}"
                                f"/{self.max_depth}")
@@ -155,24 +176,28 @@ class AdmissionQueue:
                 if shed:
                     self._note_depth()
         for req in shed:
-            if self.metrics is not None:
-                self.metrics.counter("requests_shed").inc()
-                self.metrics.counter("requests_shed_brownout").inc()
+            self._count_shed(req, FinishReason.BROWNOUT)
             req.finish(RequestState.REJECTED, FinishReason.BROWNOUT)
 
     def _worst_sheddable_index(self) -> Optional[int]:
-        """Index of the LOWEST-urgency entry eligible for brownout
-        shedding (max order_key: lowest priority class, then longest/
-        absent deadline). Failover-requeued requests (attempts > 1) are
-        never victims — they already streamed on a replica that died,
-        and conserving admitted work is the failover contract. Caller
-        holds the lock."""
+        """Index of the entry brownout sheds first: max ``shed_key`` —
+        highest class shed rank first (batch before interactive,
+        regardless of priority — docs/SERVING.md "Disaggregated
+        serving"), then lowest urgency within the class (max order_key:
+        lowest priority, then longest/absent deadline). Failover-requeued
+        requests (attempts > 1) are never victims — they already
+        streamed on a replica that died, and conserving admitted work is
+        the failover contract — and neither are staged KV-handoff
+        requests (their prefill work is done and paid for). Caller holds
+        the lock."""
         best = None
-        for j, (key, r) in enumerate(self._heap):
-            if r.attempts > 1:
+        best_key = None
+        for j, (_, r) in enumerate(self._heap):
+            if r.attempts > 1 or r.staged_kv is not None:
                 continue
-            if best is None or key > self._heap[best][0]:
-                best = j
+            key = r.shed_key
+            if best is None or key > best_key:
+                best, best_key = j, key
         return best
 
     def _pop_index_locked(self, i: int) -> ServingRequest:
@@ -180,22 +205,22 @@ class AdmissionQueue:
         self._heap[i] = self._heap[-1]
         self._heap.pop()
         heapq.heapify(self._heap)
+        self._dec_class(req)
         return req
 
     def _evict_worst_for(self, req: ServingRequest) -> bool:
         """Brownout room-making: evict the least urgent sheddable queued
-        request if ``req`` outranks it. Caller holds the lock."""
+        request if ``req`` outranks it (class shed rank first, then
+        urgency). Caller holds the lock."""
         worst_i = self._worst_sheddable_index()
         if worst_i is None:
             # over-depth purely with retried work: admit rather than
             # touch it (requeue is depth-exempt for the same reason)
             return True
-        if req.order_key >= self._heap[worst_i][0]:
+        if req.shed_key >= self._heap[worst_i][1].shed_key:
             return False
         victim = self._pop_index_locked(worst_i)
-        if self.metrics is not None:
-            self.metrics.counter("requests_shed").inc()
-            self.metrics.counter("requests_shed_brownout").inc()
+        self._count_shed(victim, FinishReason.BROWNOUT)
         victim.finish(RequestState.REJECTED, FinishReason.BROWNOUT)
         return True
 
@@ -227,6 +252,8 @@ class AdmissionQueue:
             return
         self._heap = keep
         heapq.heapify(self._heap)
+        for _, req in expired + cancelled:
+            self._dec_class(req)
         self._note_depth()
         self._lock.notify_all()           # room freed: wake blocked offers
         for _, req in expired:
@@ -238,16 +265,46 @@ class AdmissionQueue:
             if self.metrics is not None:
                 self.metrics.counter("requests_cancelled").inc()
 
-    def pop(self, timeout: Optional[float] = None) -> Optional[ServingRequest]:
+    def _pop_best_locked(self, accept) -> Optional[ServingRequest]:
+        """Remove and return the highest-urgency entry ``accept``
+        (callable or None) allows, or None when nothing qualifies.
+        ``accept=None`` is the historical heappop, byte for byte; with a
+        predicate the scan is O(n) over the bounded heap — the
+        disaggregated router's dispatchability filter (docs/SERVING.md
+        "Disaggregated serving"), which keeps a request no replica can
+        currently run from head-of-line-blocking work that idle replicas
+        of the other role could take. Caller holds the lock."""
+        if accept is None:
+            if not self._heap:
+                return None
+            _, req = heapq.heappop(self._heap)
+        else:
+            best = None
+            for j, (key, r) in enumerate(self._heap):
+                if (best is None or key < self._heap[best][0]) and accept(r):
+                    best = j
+            if best is None:
+                return None
+            return self._pop_index_locked(best)
+        self._dec_class(req)
+        return req
+
+    def pop(self, timeout: Optional[float] = None,
+            accept=None) -> Optional[ServingRequest]:
         """Highest-urgency admitted request, skipping (and expiring) any
-        whose deadline already passed. None on timeout / closed-and-empty."""
+        whose deadline already passed. None on timeout / closed-and-empty.
+        ``accept(req) -> bool`` restricts the pop to currently
+        dispatchable requests (rejected entries stay queued, urgency
+        order intact); None = pop anything, the historical behavior."""
         deadline = (time.monotonic() + timeout) if timeout is not None else None
         with self._lock:
             while True:
                 now = time.monotonic()
                 self._sweep_expired_locked(now)
                 while self._heap:
-                    _, req = heapq.heappop(self._heap)
+                    req = self._pop_best_locked(accept)
+                    if req is None:
+                        break         # nothing dispatchable: wait below
                     self._lock.notify_all()   # room freed: wake blocked offers
                     if req.cancel_requested.is_set():
                         self._note_depth()
@@ -300,6 +357,7 @@ class AdmissionQueue:
             self._closed = True
             out = [req for _, req in self._heap]
             self._heap.clear()
+            self._class_depth = {cls: 0 for cls in self._class_depth}
             self._note_depth()
             self._lock.notify_all()
         return out

@@ -52,10 +52,19 @@ class Replica:
                  wedge_timeout_s: float = 300.0,
                  idle_wait_s: float = 0.005,
                  speculative=None, tracer=None, recorder=None,
-                 faults=None, on_failover: Optional[Callable] = None):
+                 faults=None, on_failover: Optional[Callable] = None,
+                 role: str = "mixed", decode_reserve_tokens: int = 0,
+                 on_handoff: Optional[Callable] = None):
         from ..telemetry import NOOP_TRACER
 
         self.replica_id = replica_id
+        # disaggregated serving role (docs/SERVING.md "Disaggregated
+        # serving"): "prefill" runs prompt-chunk-only steps and hands
+        # each finished prompt's KV to ``on_handoff``; "decode" reserves
+        # part of every step's token budget for decode rows; "mixed"
+        # (the default) is the historical do-everything replica.
+        self.role = role
+        self._on_handoff = on_handoff
         # fault injection (test-only, serving/faults.py): the engine is
         # proxied ONLY when a put-level fault targets this replica; the
         # step hook below fires crash/wedge events. None = no hooks.
@@ -87,15 +96,21 @@ class Replica:
                 f"serving replica {replica_id}: speculative decoding "
                 "configured but a custom sample_fn is set — speculation "
                 "disabled (lossless verification requires greedy sampling)")
+        # a prefill-role replica never decodes, so a draft proposer
+        # would be dead weight (draft-model mode loads a checkpoint)
         proposer = (speculative.build_proposer()
                     if speculative is not None and sample_fn is None
+                    and role != "prefill"
                     else None)
         max_drafts = (speculative.max_draft_tokens
                       if speculative is not None else 4)
         self.scheduler = ContinuousBatchingScheduler(
             engine, sample_fn, proposer=proposer,
             max_draft_tokens=max_drafts, tracer=self.tracer,
-            trace_label=f"replica-{replica_id}")
+            trace_label=f"replica-{replica_id}",
+            prefill_only=role == "prefill",
+            decode_reserve_tokens=(decode_reserve_tokens
+                                   if role == "decode" else 0))
         self.wedge_timeout_s = wedge_timeout_s
         self.idle_wait_s = idle_wait_s
         self.state = ReplicaState.HEALTHY
@@ -108,6 +123,15 @@ class Replica:
         self._failed_uids: set = set()
         self._lock = threading.Lock()
         self._outstanding = 0             # token-weighted load estimate
+        # phase-split load (docs/SERVING.md "Disaggregated serving"):
+        # prefill tokens still to process vs decode tokens still owed.
+        # The disaggregated router weighs these separately (a pending
+        # 2000-token prefill is a few chunked forwards; 2000 owed decode
+        # tokens are 2000 forwards); the legacy ``_outstanding`` above
+        # is kept untouched so the disabled path routes byte-for-byte
+        # as before.
+        self._out_prefill = 0
+        self._out_decode = 0
         self._stop = threading.Event()
         # monotonic time of the last completed loop iteration; a worker
         # stuck inside engine.put stops updating it — that's the wedge
@@ -133,6 +157,33 @@ class Replica:
             return self._outstanding
 
     @property
+    def outstanding_prefill_tokens(self) -> int:
+        with self._lock:
+            return self._out_prefill
+
+    @property
+    def outstanding_decode_tokens(self) -> int:
+        with self._lock:
+            return self._out_decode
+
+    def _charge_locked(self, req: ServingRequest) -> None:
+        """Add a request's phase-split load; caller holds the lock. A
+        staged KV-handoff request costs no prefill (the import replaces
+        it); everything else re-prefills its resume prompt."""
+        pre = 0 if req.staged_kv is not None else len(req.resume_prompt())
+        req._charged_prefill = pre
+        self._out_prefill += pre
+        self._out_decode += req.remaining_new_tokens
+
+    def _discharge_locked(self, req: ServingRequest) -> None:
+        """Remove whatever phase-split load the request still holds;
+        caller holds the lock."""
+        self._out_prefill = max(0, self._out_prefill - req._charged_prefill)
+        req._charged_prefill = 0
+        self._out_decode = max(0, self._out_decode
+                               - req.remaining_new_tokens)
+
+    @property
     def accepting(self) -> bool:
         return self.state == ReplicaState.HEALTHY
 
@@ -154,6 +205,7 @@ class Replica:
             return False
         with self._lock:
             self._outstanding += req.outstanding_tokens
+            self._charge_locked(req)
         req.replica_id = self.replica_id
         # trace stages: routing ends at the hand-off; "admit" covers the
         # inbox wait until the worker loop submits to the scheduler
@@ -232,6 +284,7 @@ class Replica:
             self._failed_uids.add(req.uid)
             self._outstanding = max(0, self._outstanding
                                     - req.outstanding_tokens)
+            self._discharge_locked(req)
         self._active.pop(req.uid, None)
         if (reason == FinishReason.ERROR and self._on_failover is not None
                 and self._on_failover(req)):
@@ -264,6 +317,46 @@ class Replica:
             req.state = RequestState.RUNNING
             self._active[req.uid] = req
             req.end_span("admit")
+            # KV handoff import (docs/SERVING.md "Disaggregated
+            # serving"): a staged request's prompt KV was exported by a
+            # prefill-role replica — adopt the blocks and resume at the
+            # first decode token. Any import failure (representation
+            # mismatch, KV pressure, engine fault) degrades to the
+            # recompute path below: re-prefill instead of crash.
+            payload = req.take_staged()
+            if payload is not None:
+                try:
+                    self.engine.import_sequence(req.uid, payload,
+                                                tokens=req.prompt_tokens)
+                except Exception as e:
+                    logger.warning(
+                        f"serving replica {self.replica_id}: KV handoff "
+                        f"import for request {req.uid} failed ({e!r}); "
+                        "falling back to re-prefill")
+                    if self.metrics is not None:
+                        self.metrics.counter("handoff_fallbacks").inc()
+                    payload = None
+                    with self._lock:
+                        # the assign-time charge was 0 (staged = no
+                        # prefill expected); the recompute path DOES
+                        # prefill the whole prompt here — re-charge so
+                        # the weighted router cost sees the real load
+                        req._charged_prefill = len(req.resume_prompt())
+                        self._out_prefill += req._charged_prefill
+            req.end_span("handoff")
+            if payload is not None:
+                req.handoffs += 1
+                if self.metrics is not None:
+                    self.metrics.counter("handoffs_completed").inc()
+                    if req.handoff_t is not None:
+                        self.metrics.histogram("handoff_s").observe(
+                            time.monotonic() - req.handoff_t)
+                self.scheduler.submit_prefilled(
+                    req.uid, req.prompt_tokens, payload["last_logits"],
+                    req.remaining_new_tokens, req.eos_token_id,
+                    on_token=self._on_token, on_finish=self._on_finish,
+                    trace_id=req.trace_id)
+                continue
             # resume semantics (a retried request re-prefills prompt +
             # already-delivered tokens and owes only the remaining
             # budget); for a first attempt these are exactly the
@@ -291,14 +384,24 @@ class Replica:
             prev_t = req.last_token_t
             req.push_token(token)
             self._outstanding = max(0, self._outstanding - 1)
+            if req._charged_prefill:
+                # first token of this assignment: the prefill is done
+                self._out_prefill = max(0, self._out_prefill
+                                        - req._charged_prefill)
+                req._charged_prefill = 0
+            self._out_decode = max(0, self._out_decode - 1)
         if self.metrics is not None:
             self.metrics.counter("tokens_generated").inc()
             if prev_t is None:      # first token of this request
-                self.metrics.histogram("ttft_s").observe(
-                    req.first_token_t - req.arrival_t)
+                dt = req.first_token_t - req.arrival_t
+                self.metrics.histogram("ttft_s").observe(dt)
+                self.metrics.histogram(
+                    f"ttft_s_class_{req.request_class}").observe(dt)
             else:
-                self.metrics.histogram("tpot_s").observe(
-                    req.last_token_t - prev_t)
+                dt = req.last_token_t - prev_t
+                self.metrics.histogram("tpot_s").observe(dt)
+                self.metrics.histogram(
+                    f"tpot_s_class_{req.request_class}").observe(dt)
 
     def _on_finish(self, sreq, reason: str) -> None:
         with self._lock:
@@ -309,6 +412,28 @@ class Replica:
                 return
             self._outstanding = max(0, self._outstanding
                                     - req.outstanding_tokens)
+            self._discharge_locked(req)
+        if reason == "prefilled":
+            # prefill-role completion (docs/SERVING.md "Disaggregated
+            # serving"): the prompt's KV is resident in this engine —
+            # hand the request to the frontend, which exports/stages the
+            # blocks, flushes them here, and re-queues the request for a
+            # decode-role replica. Runs on the worker thread, so the
+            # engine access is race-free.
+            if self._on_handoff is not None:
+                self._on_handoff(req, sreq, self.engine, self.replica_id)
+                return
+            # defensive: a prefill-only scheduler with no handoff sink
+            # is a config error the frontend should have rejected — free
+            # the KV and fail the request rather than hang its stream
+            try:
+                self.engine.flush(req.uid)
+            except Exception:
+                pass
+            req.finish(RequestState.FAILED, FinishReason.ERROR)
+            if self.metrics is not None:
+                self.metrics.counter("requests_failed").inc()
+            return
         if reason == FinishReason.CANCELLED:
             req.finish(RequestState.CANCELLED, reason)
             if self.metrics is not None:

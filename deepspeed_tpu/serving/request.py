@@ -83,13 +83,19 @@ class ServingRequest:
 
     def __init__(self, prompt_tokens: List[int], max_new_tokens: int,
                  priority: int, deadline_s: Optional[float],
-                 eos_token_id: Optional[int]):
+                 eos_token_id: Optional[int], *,
+                 request_class: str = "interactive", shed_rank: int = 0):
         with ServingRequest._seq_lock:
             ServingRequest._seq += 1
             self.uid = ServingRequest._seq
         self.prompt_tokens = list(prompt_tokens)
         self.max_new_tokens = int(max_new_tokens)
         self.priority = int(priority)
+        # request class (docs/SERVING.md "Disaggregated serving"):
+        # labels per-class metrics and orders brownout victim selection
+        # (higher shed_rank sheds first — batch before interactive)
+        self.request_class = str(request_class)
+        self.shed_rank = int(shed_rank)
         self.eos_token_id = eos_token_id
         self.arrival_t = time.monotonic()
         # absolute monotonic deadline; None = no SLO
@@ -110,6 +116,24 @@ class ServingRequest:
         # greedy decoding); ``attempts`` counts replica assignments
         self.generated_tokens: List[int] = []
         self.attempts = 1
+        # disaggregated serving (docs/SERVING.md "Disaggregated
+        # serving"): a prefill-role replica stages the finished prompt's
+        # exported KV here for the decode-role replica to import;
+        # ``_staged_release`` frees the staging-buffer slot (idempotent,
+        # called from take_staged AND finish so a cancelled/expired/shed
+        # staged request can never pin the buffer). ``no_prefill`` marks
+        # a request whose handoff fell back to recompute: it must run
+        # its full path on a decode-capable replica (a prefill-only
+        # replica would just hand it off again). ``handoffs`` counts
+        # completed prefill→decode transfers for the trace.
+        self.staged_kv: Optional[dict] = None
+        self._staged_release = None
+        self.no_prefill = False
+        self.handoff_t: Optional[float] = None
+        self.handoffs = 0
+        # per-attempt prefill charge the owning replica's load split
+        # accounting holds (serving/replica.py)
+        self._charged_prefill = 0
         self._events: "queue.Queue[StreamEvent]" = queue.Queue()
         self._done = threading.Event()
         # telemetry (docs/OBSERVABILITY.md): the frontend sets both when
@@ -138,6 +162,28 @@ class ServingRequest:
         budget (the router's least-outstanding-tokens load signal)."""
         return max(0, len(self.prompt_tokens) + self.max_new_tokens
                    - self.n_generated)
+
+    @property
+    def shed_key(self):
+        """Brownout victim order (docs/SERVING.md "Disaggregated
+        serving"): class shed rank FIRST (batch sheds before interactive
+        regardless of priority), then lowest urgency within the class —
+        the maximum over queued sheddable entries is the victim."""
+        return (self.shed_rank,) + tuple(self.order_key)
+
+    def take_staged(self) -> Optional[dict]:
+        """Consume the staged KV handoff payload (one-shot): returns it
+        and frees the staging-buffer slot. None when nothing is staged —
+        the caller takes the re-prefill path."""
+        payload, self.staged_kv = self.staged_kv, None
+        self._release_staged()
+        return payload
+
+    def _release_staged(self) -> None:
+        self.staged_kv = None
+        rel, self._staged_release = self._staged_release, None
+        if rel is not None:
+            rel()
 
     # --------------------------------------------------------- failover
     @property
@@ -186,6 +232,9 @@ class ServingRequest:
     def finish(self, state: RequestState, reason: str) -> None:
         if self._done.is_set():
             return
+        # a terminal request can never consume its staged KV handoff —
+        # drop the payload and free the staging slot
+        self._release_staged()
         self.state = state
         self.finish_reason = reason
         self.finished_t = time.monotonic()
@@ -198,6 +247,8 @@ class ServingRequest:
                 root.set("state", state.value).set("finish_reason", reason)
                 root.set("generated", self.n_generated)
                 root.set("attempts", self.attempts)
+                if self.handoffs:
+                    root.set("handoffs", self.handoffs)
             for sp in self.spans.values():
                 sp.end()
         self._events.put(DoneEvent(self.uid, reason, self.finished_t))

@@ -29,15 +29,31 @@ from .replica import Replica, ReplicaState
 from .request import FinishReason, RequestState, ServingRequest
 
 
+#: replica roles able to run each phase of a request (docs/SERVING.md
+#: "Disaggregated serving"): decode-role replicas CAN prefill (their
+#: scheduler merely reserves decode budget), so they are the spillover
+#: for prefill-phase work when no prefill-capable replica accepts;
+#: prefill-role replicas can never decode, so decode-phase work (a
+#: staged KV handoff, or a recompute fallback) must land decode-capable.
+PREFILL_CAPABLE = ("prefill", "mixed")
+DECODE_CAPABLE = ("decode", "mixed")
+
+
 class ReplicaRouter:
     def __init__(self, replicas: List[Replica], admission: AdmissionQueue,
                  metrics: Optional[MetricsRegistry] = None,
                  poll_interval_s: float = 0.05,
-                 tracer=None, recorder=None):
+                 tracer=None, recorder=None, disaggregation=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         from ..telemetry import NOOP_TRACER
 
+        # DisaggregationConfig when the pool is role-split (docs/
+        # SERVING.md "Disaggregated serving"): selection becomes
+        # phase-aware and the load signal becomes the weighted
+        # prefill-cost vs decode-cost model. None = the historical
+        # unweighted least-outstanding-tokens router, byte for byte.
+        self.disaggregation = disaggregation
         self.replicas = list(replicas)
         self.admission = admission
         self.metrics = metrics
@@ -65,28 +81,108 @@ class ReplicaRouter:
             if r.check_health() == ReplicaState.HEALTHY:
                 out.append(r)
         if self.metrics is not None:
+            live = [r for r in self.replicas
+                    if r.state not in (ReplicaState.DEAD,
+                                       ReplicaState.STOPPED)]
             self.metrics.gauge("replicas_healthy").set(len(out))
             self.metrics.gauge("outstanding_tokens").set(
-                sum(r.outstanding_tokens for r in self.replicas
-                    if r.state not in (ReplicaState.DEAD,
-                                       ReplicaState.STOPPED)))
+                sum(r.outstanding_tokens for r in live))
+            self.metrics.gauge("outstanding_prefill_tokens").set(
+                sum(r.outstanding_prefill_tokens for r in live))
+            self.metrics.gauge("outstanding_decode_tokens").set(
+                sum(r.outstanding_decode_tokens for r in live))
         # brownout feed: the queue shrinks and sheds lowest-urgency work
         # when this fraction drops below its threshold (no-op otherwise)
         self.admission.set_healthy_fraction(len(out) / len(self.replicas))
         return out
 
-    def pick(self) -> Optional[Replica]:
-        """Least-outstanding-tokens over accepting replicas with a free
-        concurrency slot."""
+    @staticmethod
+    def _needs_decode_role(req) -> bool:
+        """Decode-phase work: a staged KV handoff (the prefill already
+        ran elsewhere) or a recompute fallback that must not loop
+        through another prefill-only replica."""
+        return req.staged_kv is not None or req.no_prefill
+
+    def _cost(self, r: Replica):
+        """Replica load for selection. Disaggregated: the weighted
+        prefill-remaining vs decode-remaining model — a pending
+        2000-token prefill is a handful of chunked forwards while 2000
+        owed decode tokens are 2000 forwards, so weighing them equally
+        (the historical signal) herds latency-critical work onto
+        prefill-loaded replicas. Disabled: the historical unweighted
+        sum, byte for byte."""
+        dis = self.disaggregation
+        if dis is None:
+            return (r.outstanding_tokens, r.replica_id)
+        return (r.outstanding_prefill_tokens * dis.prefill_token_cost
+                + r.outstanding_decode_tokens * dis.decode_token_cost,
+                r.replica_id)
+
+    def pick(self, req=None) -> Optional[Replica]:
+        """Least-loaded over accepting replicas with a free concurrency
+        slot. Role-split pools (docs/SERVING.md "Disaggregated serving")
+        also filter by the request's phase: decode-phase work only lands
+        decode-capable; prefill-phase work prefers prefill-capable and
+        spills to decode-role replicas only when no prefill-capable
+        replica is accepting at all (they run the request end to end —
+        availability beats specialization)."""
         candidates = [r for r in self.healthy_replicas()
                       if r.accepting and r.has_capacity]
+        if self.disaggregation is not None and req is not None:
+            if self._needs_decode_role(req):
+                candidates = [r for r in candidates
+                              if r.role in DECODE_CAPABLE]
+            else:
+                preferred = [r for r in candidates
+                             if r.role in PREFILL_CAPABLE]
+                if preferred or any(r.accepting and r.role in PREFILL_CAPABLE
+                                    for r in self.replicas):
+                    # prefill-capable capacity exists (maybe busy): wait
+                    # for it rather than full-running on a decode replica
+                    candidates = preferred
         if not candidates:
             return None
-        return min(candidates, key=lambda r: (r.outstanding_tokens,
-                                              r.replica_id))
+        return min(candidates, key=self._cost)
 
     def _any_accepting(self) -> bool:
         return any(r.accepting for r in self.replicas)
+
+    def _any_accepting_for(self, req) -> bool:
+        """Phase-aware liveness: decode-phase work is only dispatchable
+        to decode-capable replicas — a fleet where just prefill-role
+        slots survive cannot finish it."""
+        if self.disaggregation is None or not self._needs_decode_role(req):
+            return self._any_accepting()
+        return any(r.accepting and r.role in DECODE_CAPABLE
+                   for r in self.replicas)
+
+    def _dispatchable_filter(self):
+        """Pop-time predicate for the admission queue (role-split pools
+        only; None otherwise = the historical pop). Snapshot which
+        phases currently have a free slot, so the single dispatcher
+        thread never pops a request it cannot place — a staged decode
+        request at the head of the queue must not head-of-line-block
+        fresh prompts that idle prefill replicas could take (and vice
+        versa). Capacity can shift between snapshot and dispatch;
+        _dispatch's poll loop absorbs that rare race."""
+        if self.disaggregation is None:
+            return None
+        free = [r for r in self.replicas
+                if r.accepting and r.has_capacity
+                and r.state == ReplicaState.HEALTHY]
+        can_decode = any(r.role in DECODE_CAPABLE for r in free)
+        prefill_free = any(r.role in PREFILL_CAPABLE for r in free)
+        prefill_accepting = any(r.accepting and r.role in PREFILL_CAPABLE
+                                for r in self.replicas)
+        # fresh work: a free prefill-capable slot, or the spillover case
+        # (no prefill-capable replica accepting at all → decode-role
+        # replicas run the request end to end)
+        can_prefill = prefill_free or (not prefill_accepting and can_decode)
+
+        def accept(req):
+            return (can_decode if self._needs_decode_role(req)
+                    else can_prefill)
+        return accept
 
     def drain_replica(self, replica_id: int) -> None:
         for r in self.replicas:
@@ -109,7 +205,7 @@ class ReplicaRouter:
         # slot); ended by Replica.assign, or by req.finish on failure
         req.begin_span(self.tracer, "route")
         while not self._stop.is_set():
-            if not self._any_accepting():
+            if not self._any_accepting_for(req):
                 sup = self.supervisor
                 if sup is None or not sup.recovery_pending():
                     logger.warning(f"serving request {req.uid}: no healthy "
@@ -125,7 +221,7 @@ class ReplicaRouter:
                     self.metrics.counter("requests_expired").inc()
                 req.finish(RequestState.EXPIRED, FinishReason.DEADLINE)
                 return
-            replica = self.pick()
+            replica = self.pick(req)
             if replica is not None and replica.assign(req):
                 return
             # healthy fleet but every slot busy (or lost a drain race):
@@ -166,7 +262,8 @@ class ReplicaRouter:
                 self._fail_undispatchable()
                 self._stop.wait(self.poll_interval_s)
                 continue
-            req = self.admission.pop(timeout=self.poll_interval_s)
+            req = self.admission.pop(timeout=self.poll_interval_s,
+                                     accept=self._dispatchable_filter())
             if req is None:
                 self.healthy_replicas()   # keep health/gauges fresh when idle
                 continue

@@ -53,6 +53,7 @@ MODULES = [
     "deepspeed_tpu.sequence.ring_attention",
     "deepspeed_tpu.serving",
     "deepspeed_tpu.serving.faults",
+    "deepspeed_tpu.serving.handoff",
     "deepspeed_tpu.serving.supervisor",
     "deepspeed_tpu.telemetry",
     "deepspeed_tpu.telemetry.flight_recorder",

@@ -463,7 +463,7 @@ def test_bench_schema_validator():
                          "mean_matched_prefix_frac": 1.0,
                          "disabled_parity": True, "kv_occupancy": occ}}
     for name in bench._STAMPED_PHASES:
-        if name in ("kv_quant", "train_chaos"):
+        if name in ("kv_quant", "train_chaos", "disagg"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
     good["train_chaos"] = {"recovery_time_s": 0.12, "steps_lost": 1,
@@ -473,7 +473,19 @@ def test_bench_schema_validator():
                            "n_steps": 8, "crash_at_step": 5,
                            "urgent_save_s": 0.01,
                            "kv_occupancy": dict(occ)}
+    good["disagg"] = {"handoffs_completed": 13, "handoff_fallbacks": 0,
+                      "tpot_improved": True, "handoff_parity": True,
+                      "disabled_parity": True, "replicas": 4,
+                      "decode_reserve_tokens": 8,
+                      "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
+    # disagg typed checks: missing and mistyped fields are named
+    bad_dg = dict(good)
+    bad_dg["disagg"] = {"handoffs_completed": True, "handoff_parity": 1}
+    problems_dg = bench.validate_serving_schema(bad_dg)
+    assert any("disagg.handoffs_completed" in p for p in problems_dg)
+    assert any("disagg.handoff_parity" in p for p in problems_dg)
+    assert any("disagg.disabled_parity: missing" in p for p in problems_dg)
     # skipped phases are exempt from field checks
     skipped = dict(good)
     skipped["chaos"] = {"phase_skipped": "phase budget 240s exceeded"}
