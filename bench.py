@@ -251,7 +251,7 @@ _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("disabled_parity", bool))
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
-                   "disagg")
+                   "disagg", "slo")
 # Typed shape of the disagg phase (docs/SERVING.md "Disaggregated
 # serving"): the TTFT/TPOT comparison, handoff counts and parity bits
 # the acceptance gates read.
@@ -262,6 +262,25 @@ _DISAGG_KEYS = (("handoffs_completed", int),
                 ("disabled_parity", bool),
                 ("replicas", int),
                 ("decode_reserve_tokens", int))
+# Typed shape of the slo phase (docs/OBSERVABILITY.md "SLOs and
+# burn-rate alerts"): the alert fire/resolve transitions, the
+# window-vs-cumulative quantile agreement, the overhead-vs-noise-floor
+# numbers, and the journal/alert schema-validation bits the
+# observability gates read.
+_SLO_KEYS = (("alert_fired", bool),
+             ("alert_resolved", bool),
+             ("fire_to_resolve_s", (int, float)),
+             ("alerts_firing_peak", int),
+             ("alerts_firing_final", int),
+             ("window_p95_ttft_ms", (int, float)),
+             ("cum_p95_ttft_ms", (int, float)),
+             ("window_agrees", bool),
+             ("noise_floor_pct", (int, float)),
+             ("overhead_slo_pct", (int, float)),
+             ("overhead_ok", bool),
+             ("journal_events", int),
+             ("journal_schema_ok", bool),
+             ("disabled_parity", bool))
 # Typed shape of the train_chaos phase (docs/TRAINING.md "Fault
 # tolerance"): recovery/steps-lost/parity numbers the robustness gates
 # read. ``recovery_time_s`` may be absent only on a skipped phase.
@@ -311,6 +330,16 @@ def validate_serving_schema(serving: dict):
         problems.append("disagg: missing or not an object")
     elif "phase_skipped" not in dg:
         _check_typed_phase("disagg", dg, _DISAGG_KEYS, problems)
+    sl = serving.get("slo")
+    if not isinstance(sl, dict):
+        problems.append("slo: missing or not an object")
+    elif "phase_skipped" not in sl:
+        _check_typed_phase("slo", sl, _SLO_KEYS, problems)
+        # the journal/alert stream itself must validate on the CPU run —
+        # the tier-1 serving-schema gate covers the event schema too
+        if sl.get("journal_schema_ok") is False:
+            problems.append("slo.journal_schema_ok: journal events "
+                            "failed schema validation")
     for name in _STAMPED_PHASES:
         ph = serving.get(name)
         if not isinstance(ph, dict):
@@ -1064,6 +1093,195 @@ def bench_serving(on_tpu: bool):
             "disabled_parity": bool(disabled["gens"] == mixed["gens"]),
         }
 
+    def run_slo_phase():
+        """SLO observability phase (docs/OBSERVABILITY.md "SLOs and
+        burn-rate alerts"): class-mixed traffic against a frontend with
+        per-class SLO targets. Five checks: (1) an injected latency
+        fault (slow_forward) trips the interactive TTFT burn-rate alert
+        and the alert RESOLVES after the fault clears — both transitions
+        must land in the ops journal and in the ``alerts_firing`` gauge;
+        (2) the windowed p95 agrees with the cumulative p95 on steady
+        traffic within bucket resolution (same interpolation, same
+        buckets — only the data may differ); (3) slo-on overhead vs the
+        two-run noise floor (the PR 4 telemetry criterion applied to the
+        windowed/alerting layer); (4) everything-default-off greedy
+        streams byte-identical to a config with the slo block absent;
+        (5) the journal passes schema validation (the tier-1
+        serving-schema gate reads ``journal_schema_ok``)."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+        from deepspeed_tpu.serving.metrics import DEFAULT_LATENCY_BUCKETS
+        from deepspeed_tpu.telemetry import validate_events
+
+        if on_tpu:
+            plen, max_new, n_steady = 64, 8, 24
+            target_ttft_ms, slow_s, n_slow_puts = 250.0, 0.3, 60
+            fast_w, slow_w, bucket = 2.0, 6.0, 0.5
+            fault_budget_s = 60.0
+        else:
+            plen, max_new, n_steady = 16, 4, 16
+            target_ttft_ms, slow_s, n_slow_puts = 100.0, 0.12, 40
+            fast_w, slow_w, bucket = 1.0, 3.0, 0.25
+            fault_budget_s = 40.0
+        slo_prompts = [rng.integers(0, cfg.vocab_size, size=plen).tolist()
+                       for _ in range(n_steady)]
+
+        def engine_factory(i):
+            return InferenceEngineV2(engine.model, params=engine.params,
+                                     config=type(vcfg)(**vars(vcfg)))
+
+        def slo_block(enabled=True):
+            if not enabled:
+                return {"enabled": False}
+            return {"enabled": True,
+                    "classes": {"interactive":
+                                {"ttft_p95_ms": target_ttft_ms,
+                                 "availability": 0.99}},
+                    "fast_window_s": fast_w, "slow_window_s": slow_w,
+                    "window_bucket_s": bucket, "eval_interval_s": bucket,
+                    "burn_rate_threshold": 4.0, "min_window_count": 2}
+
+        def build(slo=None, faults=None):
+            extra = {}
+            if slo is not None:
+                extra["slo"] = slo
+            if faults is not None:
+                extra["faults"] = faults
+            return ServingFrontend([engine_factory(0)],
+                                   ServingConfig(max_queue_depth=64,
+                                                 **extra))
+
+        def steady(fe):
+            """Warmup (compile outside the clock), then the steady
+            class-mixed burst; returns (gens, wall_s)."""
+            fe.wait_all([fe.submit(slo_prompts[0], max_new_tokens=2)],
+                        timeout=600)
+            t0 = time.perf_counter()
+            handles = [fe.submit(p, max_new_tokens=max_new,
+                                 request_class=("batch" if i % 4 == 3
+                                                else "interactive"))
+                       for i, p in enumerate(slo_prompts)]
+            assert fe.wait_all(handles, timeout=600)
+            wall = time.perf_counter() - t0
+            return [[ev.token for ev in h.drain()] for h in handles], wall
+
+        # ---- steady runs, interleaved off/on/off/on: window agreement
+        # plus overhead vs the noise floor (PR 4 criterion). Interleaving
+        # and min-of-two on BOTH sides keeps one cache-cold or contended
+        # run from reading as "slo overhead" on a noisy CPU box.
+        fe_off1 = build()
+        gens_plain, wall_off1 = steady(fe_off1)
+        fe_off1.shutdown(drain=False, timeout=5)
+
+        fe_on = build(slo=slo_block(True))
+        gens_on, wall_on1 = steady(fe_on)
+        fe_on.windowed.tick()
+        win_p95 = fe_on.windowed.window_percentile("ttft_s", 95, 1e9)
+        cum_p95 = fe_on.metrics.histogram("ttft_s").percentile(95)
+        # agreement at bucket resolution: both estimates interpolate the
+        # same grid, so they may differ by at most one bucket width
+        # (the window can exclude pre-first-tick observations)
+        bounds = list(DEFAULT_LATENCY_BUCKETS)
+        hi_i = next((i for i, b in enumerate(bounds)
+                     if b >= max(win_p95 or 0.0, cum_p95)), len(bounds) - 1)
+        width = bounds[hi_i] - (bounds[hi_i - 1] if hi_i else 0.0)
+        window_agrees = (win_p95 is not None
+                         and abs(win_p95 - cum_p95) <= width + 1e-9)
+        fe_on.shutdown(drain=False, timeout=5)
+
+        fe_off2 = build()
+        _, wall_off2 = steady(fe_off2)
+        fe_off2.shutdown(drain=False, timeout=5)
+        fe_on2 = build(slo=slo_block(True))
+        _, wall_on2 = steady(fe_on2)
+        fe_on2.shutdown(drain=False, timeout=5)
+
+        base = min(wall_off1, wall_off2)
+        wall_on = min(wall_on1, wall_on2)
+        noise_pct = abs(wall_off1 - wall_off2) / base * 100
+        overhead_pct = (wall_on - base) / base * 100
+
+        # ---- default-off byte parity (slo block present but disabled) --
+        fe_dis = build(slo=slo_block(False))
+        gens_dis, _ = steady(fe_dis)
+        fe_dis.shutdown(drain=False, timeout=5)
+        disabled_parity = gens_dis == gens_plain
+
+        # ---- injected latency fault: alert fires, then resolves --------
+        faults = {"enabled": True, "schedule": [
+            {"kind": "slow_forward", "replica": 0, "at_put": 8,
+             "count": n_slow_puts, "duration_s": slow_s}]}
+        fe = build(slo=slo_block(True), faults=faults)
+        try:
+            fe.wait_all([fe.submit(slo_prompts[0], max_new_tokens=2)],
+                        timeout=600)
+            peak_firing = 0
+            t_fire = t_resolve = None
+            deadline = time.monotonic() + fault_budget_s
+            i = 0
+            while time.monotonic() < deadline:
+                h = fe.submit(slo_prompts[i % n_steady],
+                              max_new_tokens=max_new,
+                              request_class="interactive")
+                h.result(timeout=120)
+                i += 1
+                peak_firing = max(peak_firing, len(fe.alerts.firing()))
+                fired_evs = fe.journal.events(kinds=("alert_firing",))
+                resolved_evs = fe.journal.events(kinds=("alert_resolved",))
+                if fired_evs and t_fire is None:
+                    t_fire = fired_evs[0]["t"]
+                if resolved_evs and t_resolve is None:
+                    t_resolve = resolved_evs[0]["t"]
+                if t_fire is not None and t_resolve is not None:
+                    break
+            events = fe.journal.events()
+            journal_problems = validate_events(events)
+            final_firing = int(
+                fe.metrics.snapshot().get("alerts_firing", 0.0))
+            health = fe.health_report(window_s=slow_w)
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+        alert_fired = t_fire is not None
+        alert_resolved = t_resolve is not None
+        assert alert_fired, \
+            "injected latency fault never tripped the burn-rate alert"
+        assert alert_resolved, \
+            "burn-rate alert never resolved after the fault cleared"
+        assert disabled_parity, \
+            "slo.enabled=false diverged from the slo-block-absent stack"
+        return {
+            "n_requests": n_steady,
+            "target_ttft_ms": target_ttft_ms,
+            "fast_window_s": fast_w, "slow_window_s": slow_w,
+            "injected_put_latency_ms": slow_s * 1e3,
+            "alert_fired": bool(alert_fired),
+            "alert_resolved": bool(alert_resolved),
+            "fire_to_resolve_s": (round(t_resolve - t_fire, 3)
+                                  if alert_fired and alert_resolved
+                                  else -1.0),
+            "alerts_firing_peak": int(peak_firing),
+            "alerts_firing_final": final_firing,
+            "requests_driven_under_fault": int(i),
+            "window_p95_ttft_ms": round((win_p95 or 0.0) * 1e3, 3),
+            "cum_p95_ttft_ms": round(cum_p95 * 1e3, 3),
+            "window_agrees": bool(window_agrees),
+            "wall_off_s": round(wall_off1, 4),
+            "wall_off_rerun_s": round(wall_off2, 4),
+            "wall_slo_on_s": round(wall_on1, 4),
+            "wall_slo_on_rerun_s": round(wall_on2, 4),
+            "noise_floor_pct": round(noise_pct, 2),
+            "overhead_slo_pct": round(overhead_pct, 2),
+            # the PR 4 shape: the claim is "under 2%", judged against
+            # what this machine can even measure (the noise floor)
+            "overhead_ok": bool(overhead_pct < max(2.0, noise_pct)),
+            "journal_events": len(events),
+            "journal_schema_ok": not journal_problems,
+            "journal_problems": journal_problems[:5],
+            "health_report_alerts": health["slo"] is not None,
+            "disabled_parity": bool(disabled_parity),
+        }
+
     def run_train_chaos_phase():
         """Training fault-tolerance chaos phase (docs/TRAINING.md "Fault
         tolerance"): a supervised tiny train run is killed at step k —
@@ -1243,6 +1461,12 @@ def bench_serving(on_tpu: bool):
     # 2 decode vs 4 mixed — p95 interactive TTFT/TPOT on/off, handoff
     # count, byte-parity (handoff AND disabled-path, both asserted)
     result["disagg"] = runner.run("disagg", run_disagg_phase)
+    # SLO observability phase (docs/OBSERVABILITY.md "SLOs and burn-rate
+    # alerts"): injected latency fault trips the interactive burn-rate
+    # alert and resolves after it clears (both transitions journaled),
+    # window-vs-cumulative p95 agreement, overhead vs the noise floor,
+    # disabled-path byte parity, journal schema validation
+    result["slo"] = runner.run("slo", run_slo_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

@@ -355,7 +355,7 @@ class TrainingSupervisor:
 
     def __init__(self, engine=None, engine_factory: Optional[Callable] = None,
                  config: Optional[ResilienceConfig] = None,
-                 save_dir: Optional[str] = None):
+                 save_dir: Optional[str] = None, journal=None):
         if engine is None and engine_factory is None:
             raise ValueError("TrainingSupervisor needs an engine or an "
                              "engine_factory")
@@ -371,6 +371,16 @@ class TrainingSupervisor:
             raise ValueError("resilience needs a save_dir (config "
                              "resilience.save_dir or the save_dir argument)")
         self.injector = self.config.faults.build_injector()
+        # ops journal (docs/OBSERVABILITY.md "The ops event journal"):
+        # restarts, parks, preemption saves, anomaly rollbacks,
+        # checkpoint publications and wedges land in the SAME
+        # schema-validated stream the serving stack uses, so a
+        # train+serve host has one merged incident timeline
+        if journal is None:
+            from ..telemetry.journal import OpsJournal
+
+            journal = OpsJournal(capacity=512, source="training")
+        self.journal = journal
         self.rng = random.Random(self.config.seed)
         self.stats: Dict[str, Any] = {
             "train_restarts": 0, "steps_lost": 0, "anomaly_rollbacks": 0,
@@ -558,6 +568,8 @@ class TrainingSupervisor:
                     # worker still holds `box` and may scribble on it.
                     self._gen += 1
                     self.stats["wedges"] += 1
+                    self.journal.emit("train_wedge",
+                                      step=int(engine.global_steps))
                     self._dump_flight_recorder(engine, "train_wedge")
                     return {"outcome": "wedge", "error": None,
                             "step_at_exit": engine.global_steps}
@@ -630,6 +642,10 @@ class TrainingSupervisor:
         engine.save_checkpoint(self.save_dir,
                                client_state=self._client_state(engine),
                                urgent=urgent)
+        # journaled AFTER the save returns: the event records a
+        # checkpoint that actually published (atomic 'latest' swap)
+        self.journal.emit("checkpoint_saved",
+                          step=int(engine.global_steps), urgent=urgent)
 
     def _restore_latest(self) -> bool:
         """Load ``latest`` (if any) into the current engine and restore
@@ -682,6 +698,10 @@ class TrainingSupervisor:
         dt = float(dt) if dt is not None else time.monotonic() - t0
         self.stats["urgent_save_s"] = dt
         self.stats["preemptions"] += 1
+        self.journal.emit("train_preempt_save",
+                          step=int(engine.global_steps),
+                          save_s=round(dt, 4),
+                          within_grace=dt <= cfg.preempt_grace_s)
         if dt > cfg.preempt_grace_s:
             logger.error(f"urgent checkpoint took {dt:.2f}s — exceeds the "
                          f"{cfg.preempt_grace_s:.0f}s preemption grace "
@@ -705,6 +725,8 @@ class TrainingSupervisor:
         n, backoff = self._restart_policy.record_failure(now)
         if backoff is None:             # circuit breaker tripped
             self.stats["parked"] = True
+            self.journal.emit("train_parked", failures=n,
+                              reason="circuit_breaker")
             logger.error(f"train supervisor PARKED after {n} failures in "
                          f"{cfg.restart_window_s:.0f}s window — not "
                          "restarting a run that keeps dying")
@@ -716,6 +738,8 @@ class TrainingSupervisor:
             # a wedged thread owns the old engine; and with no checkpoint
             # a restart must rebuild virgin state — both need the factory
             self.stats["parked"] = True
+            self.journal.emit("train_parked", failures=n,
+                              reason="no_engine_factory")
             logger.error(
                 "train supervisor PARKED: recovery needs an engine_factory "
                 f"({'wedged step' if needs_fresh_engine else 'no checkpoint yet'})")
@@ -736,7 +760,14 @@ class TrainingSupervisor:
             # counted HERE, after the restore: a parked anomaly storm
             # never rolled anything back and must not report one
             self.stats["anomaly_rollbacks"] += 1
+            self.journal.emit("train_anomaly_rollback",
+                              step=step_at_exit,
+                              resumed_step=int(self._engine.global_steps))
         recovery_s = time.monotonic() - t0
+        self.journal.emit("train_restart", reason=reason, attempt=n,
+                          steps_lost=steps_lost,
+                          resumed_step=int(self._engine.global_steps),
+                          recovery_s=round(recovery_s, 4))
         self.restart_log.append({
             "reason": reason, "attempt": n,
             "from_step": step_at_exit,
@@ -829,6 +860,48 @@ class TrainingSupervisor:
         else:
             self._preempt.set()
         self._preempt.wait(5.0)
+
+    # --------------------------------------------------------- health report
+    def health_report(self, recent_events: int = 20) -> Dict[str, Any]:
+        """One queryable training-health answer (docs/OBSERVABILITY.md
+        "The health report"), the training counterpart of
+        ``ServingFrontend.health_report()``: progress, the resilience
+        counters, the restart log tail, the open anomaly streak, and the
+        recent ops-journal events — merged into a single dict."""
+        report = {
+            "wall_time": time.time(),
+            "global_step": int(self._engine.global_steps),
+            "parked": bool(self.stats["parked"]),
+            "preempt_pending": self._preempt.is_set(),
+            "anomaly_streak": int(self._anomaly_streak),
+            "counters": {k: self.stats[k] for k in
+                         ("train_restarts", "steps_lost",
+                          "anomaly_rollbacks", "preemptions", "wedges")},
+            "urgent_save_s": self.stats["urgent_save_s"],
+            "restart_log": list(self.restart_log[-5:]),
+            "events": self.journal.events(limit=recent_events),
+        }
+        return report
+
+    def health_report_text(self, recent_events: int = 10) -> str:
+        """The training health report rendered for a terminal."""
+        r = self.health_report(recent_events=recent_events)
+        c = r["counters"]
+        lines = [
+            "== training health ==",
+            f"step={r['global_step']}"
+            + ("  PARKED" if r["parked"] else "")
+            + ("  PREEMPT-PENDING" if r["preempt_pending"] else "")
+            + (f"  anomaly_streak={r['anomaly_streak']}"
+               if r["anomaly_streak"] else ""),
+            f"restarts={c['train_restarts']} steps_lost={c['steps_lost']} "
+            f"rollbacks={c['anomaly_rollbacks']} "
+            f"preemptions={c['preemptions']} wedges={c['wedges']}",
+        ]
+        if r["events"]:
+            lines.append("recent events:")
+            lines.append(self.journal.render_text(limit=recent_events))
+        return "\n".join(lines)
 
     # ---------------------------------------------------------------- status
     def _status(self, status: str) -> Dict[str, Any]:

@@ -11,6 +11,10 @@ Light names import eagerly; ``ServingFrontend``/``Replica``/
 ``ReplicaRouter`` load lazily because they pull in the JAX engine stack.
 """
 
+from ..telemetry.journal import OpsJournal  # noqa: F401
+from ..telemetry.slo import (AlertEngine, SLOClassTarget,  # noqa: F401
+                             SLOConfig)
+from ..telemetry.windowed import WindowedMetrics  # noqa: F401
 from .config import (ClassPolicy, DisaggregationConfig,  # noqa: F401
                      FaultsConfig, FaultToleranceConfig, HandoffConfig,
                      KVQuantConfig, PrefixCacheConfig, ServingConfig,
@@ -53,4 +57,6 @@ __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
            "Gauge", "Histogram", "AdmissionQueue", "Priority", "Rejected",
            "RequestHandle", "RequestState", "ServingRequest", "TokenEvent",
            "DoneEvent", "FinishReason", "ServingFrontend", "Replica",
-           "ReplicaState", "ReplicaRouter"]
+           "ReplicaState", "ReplicaRouter",
+           "SLOConfig", "SLOClassTarget", "AlertEngine", "OpsJournal",
+           "WindowedMetrics"]

@@ -13,6 +13,7 @@ from pydantic import Field, field_validator
 
 from ..runtime.config_utils import DSConfigModel
 from ..telemetry.config import TelemetryConfig
+from ..telemetry.slo import SLOConfig
 
 
 class PrefixCacheConfig(DSConfigModel):
@@ -272,6 +273,12 @@ class ServingConfig(DSConfigModel):
     # unified telemetry: request tracing + flight recorder
     # (docs/OBSERVABILITY.md); disabled = the no-op tracer
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)
+    # SLO observability (docs/OBSERVABILITY.md "SLOs and burn-rate
+    # alerts"): per-class SLO targets + multi-window burn-rate alerting
+    # evaluated on the router tick. Disabled (the default) builds no
+    # alert engine; windowed metrics and the ops journal exist either
+    # way (passive, bounded).
+    slo: SLOConfig = Field(default_factory=SLOConfig)
     # disaggregated prefill/decode serving: role-split replica pool with
     # KV handoff and the weighted router cost model (docs/SERVING.md
     # "Disaggregated serving"); disabled = the single-role stack

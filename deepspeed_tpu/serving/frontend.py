@@ -20,7 +20,10 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional, Sequence
 
-from ..telemetry import FlightRecorder
+from ..telemetry import FlightRecorder  # noqa: F401  (re-export surface)
+from ..telemetry.journal import OpsJournal
+from ..telemetry.slo import AlertEngine
+from ..telemetry.windowed import WindowedMetrics
 from ..utils.logging import logger
 from .config import ServingConfig
 from .metrics import MetricsRegistry, serving_metrics
@@ -45,7 +48,11 @@ class ServingFrontend:
         if not engines:
             raise ValueError("ServingFrontend needs at least one engine")
         self.config = config or ServingConfig()
-        self.metrics = metrics or serving_metrics()
+        # the registry pre-declares every per-class series for the
+        # CONFIGURED classes, so custom classes expose zero-valued
+        # Prometheus series before first traffic too
+        self.metrics = metrics or serving_metrics(
+            sorted(self.config.classes))
         # telemetry (docs/OBSERVABILITY.md): one tracer for the whole
         # frontend — request stage spans begin here at submit, the
         # router/replicas/scheduler continue the chain — plus a flight
@@ -54,6 +61,26 @@ class ServingFrontend:
         self.tracer = self.config.telemetry.build_tracer()
         self.recorder = self.config.telemetry.build_recorder(
             self.tracer, metrics=self.metrics)
+        # SLO observability (docs/OBSERVABILITY.md "SLOs and burn-rate
+        # alerts"). The journal and the windowed-metrics ring are always
+        # on: both are passive bounded buffers (an incident record you
+        # have to remember to enable is one you won't have), and neither
+        # touches the request hot path — the windowed ring is fed by the
+        # router's ~1/s tick. The AlertEngine exists only under
+        # ``slo.enabled``.
+        slo = self.config.slo
+        self.journal = OpsJournal(capacity=slo.journal_capacity,
+                                  source="serving",
+                                  path=slo.journal_path)
+        self.windowed = WindowedMetrics(self.metrics,
+                                        bucket_s=slo.window_bucket_s,
+                                        history_s=slo.window_history_s)
+        self.alerts = None
+        if slo.enabled:
+            self.alerts = AlertEngine(slo, self.windowed,
+                                      metrics=self.metrics,
+                                      journal=self.journal,
+                                      recorder=self.recorder)
         if self.config.ttft_buckets_s:
             self.metrics.histogram("ttft_s", self.config.ttft_buckets_s,
                                    reset=True)
@@ -61,7 +88,8 @@ class ServingFrontend:
         self.admission = AdmissionQueue(
             self.config.max_queue_depth, self.metrics,
             brownout_threshold=(ft.brownout_threshold if ft.enabled
-                                else 0.0))
+                                else 0.0),
+            journal=self.journal)
         # speculative decoding is applied per replica: each Replica builds
         # its own proposer from the block (draft state is per-engine)
         self._sample_fn = sample_fn
@@ -89,10 +117,14 @@ class ServingFrontend:
                                              self.metrics)
         replicas = [self._build_replica(i, eng)
                     for i, eng in enumerate(engines)]
+        # ~1/s observability tick on the router loop: windowed-metrics
+        # snapshots always; SLO alert evaluation when enabled
+        tick_hooks = [self._observability_tick]
         self.router = ReplicaRouter(replicas, self.admission, self.metrics,
                                     tracer=self.tracer,
                                     recorder=self.recorder,
-                                    disaggregation=self._disagg)
+                                    disaggregation=self._disagg,
+                                    tick_hooks=tick_hooks)
         self.supervisor = None
         if ft.enabled:
             from .supervisor import ReplicaSupervisor
@@ -100,7 +132,7 @@ class ServingFrontend:
             self.supervisor = ReplicaSupervisor(
                 self.router, self._build_replica, engine_factory,
                 config=ft, metrics=self.metrics, tracer=self.tracer,
-                recorder=self.recorder)
+                recorder=self.recorder, journal=self.journal)
             self.router.supervisor = self.supervisor
         self._closed = False
         self.router.start()
@@ -175,7 +207,8 @@ class ServingFrontend:
                            self._disagg.decode_reserve_tokens
                            if self._disagg is not None else 0),
                        on_handoff=(self._handoff if role == "prefill"
-                                   else None))
+                                   else None),
+                       journal=self.journal)
 
     @classmethod
     def from_engine_factory(cls, engine_factory: Callable[[int], object],
@@ -217,8 +250,13 @@ class ServingFrontend:
             raise ValueError(f"unknown request class {cls!r} "
                              f"(configured: {sorted(cfg.classes)})")
         self.metrics.counter("requests_submitted").inc()
+        # per-class submit counter: the denominator of the SLO engine's
+        # windowed availability burn rate (docs/OBSERVABILITY.md "SLOs
+        # and burn-rate alerts")
+        self.metrics.counter(f"requests_submitted_class_{cls}").inc()
         if self._closed:
             self.metrics.counter("requests_shed").inc()
+            self.metrics.counter(f"requests_shed_class_{cls}").inc()
             raise Rejected("draining", "frontend is shut down")
         if priority is None:
             priority = (policy.priority if policy.priority is not None
@@ -250,6 +288,7 @@ class ServingFrontend:
                       for r in self.router.replicas)
         if len(req.prompt_tokens) + req.max_new_tokens > max_len:
             self.metrics.counter("requests_shed").inc()
+            self.metrics.counter(f"requests_shed_class_{cls}").inc()
             req.finish(RequestState.REJECTED, "too_long")
             raise Rejected("too_long",
                            f"{len(req.prompt_tokens)}+{req.max_new_tokens} "
@@ -310,12 +349,19 @@ class ServingFrontend:
         if payload is not None and self._stager is not None \
                 and self._stager.try_stage(req, payload):
             self.metrics.counter("handoffs_started").inc()
+            self.journal.emit("handoff_staged", uid=req.uid,
+                              from_replica=replica_id,
+                              blocks=payload.get("n_blocks", 0))
             req.handoff_t = time.monotonic()
         else:
             # every degraded handoff counts — export failure AND a full
             # staging buffer — or a fleet whose exports always fail
             # would be indistinguishable from one that never handed off
             self.metrics.counter("handoff_fallbacks").inc()
+            self.journal.emit(
+                "handoff_fallback", uid=req.uid,
+                where=("export" if payload is None else "staging_full"),
+                from_replica=replica_id)
             # recompute fallback: must not land on a prefill-only
             # replica (it would just hand off again — or loop forever
             # when handoff keeps failing)
@@ -365,6 +411,8 @@ class ServingFrontend:
         if not self.admission.requeue(req):
             return False          # queue closed mid-failover: shutdown
         self.metrics.counter("requests_failed_over").inc()
+        self.journal.emit("request_failover", uid=req.uid,
+                          attempt=req.attempts)
         return True
 
     # ---------------------------------------------------------- lifecycle
@@ -393,6 +441,15 @@ class ServingFrontend:
         return True
 
     # ------------------------------------------------------------- metrics
+    def _observability_tick(self) -> None:
+        """Router-tick hook (~1/s): feed the windowed-metrics ring and,
+        with ``slo.enabled``, run the burn-rate alert state machines.
+        Both are cadence-gated internally; the router exception-isolates
+        the call."""
+        self.windowed.maybe_tick()
+        if self.alerts is not None:
+            self.alerts.maybe_evaluate()
+
     def _refresh_kv_gauges(self) -> None:
         """Sum KV-pool occupancy over the fleet into the
         ``kv_blocks_in_use`` / ``kv_bytes_in_use`` gauges (docs/SERVING.md
@@ -444,6 +501,106 @@ class ServingFrontend:
         """Prometheus text exposition of the serving registry — hand this
         to whatever scrapes/serves /metrics (docs/OBSERVABILITY.md)."""
         return self.metrics.render_prometheus()
+
+    # --------------------------------------------------------- health report
+    def health_report(self, window_s: float = 60.0,
+                      recent_events: int = 20) -> dict:
+        """One queryable fleet-health answer (docs/OBSERVABILITY.md
+        "The health report"): SLO status + active alerts, windowed
+        latency summaries per class, replica states, queue depths
+        (total and per class), KV occupancy, headline counters, and the
+        recent ops-journal tail — merged into a single dict. Works with
+        every feature off (the SLO block is then ``None`` and the window
+        summaries cover whatever history the passive ring holds)."""
+        self._refresh_kv_gauges()
+        # forced tick: the report reads up-to-the-moment. Safe at any
+        # poll rate — faster-than-cadence ticks refresh the ring head
+        # instead of appending, so a fast dashboard can't shrink the
+        # window history (windowed.tick docstring).
+        self.windowed.tick()
+        snap = self.metrics.snapshot()
+        classes = sorted(self.config.classes)
+        hist_names = (["ttft_s", "tpot_s", "queue_wait_s"]
+                      + [f"ttft_s_class_{c}" for c in classes]
+                      + [f"tpot_s_class_{c}" for c in classes])
+        report = {
+            "wall_time": time.time(),
+            "replicas": [{"id": r.replica_id, "state": r.state.value,
+                          "role": getattr(r, "role", "mixed"),
+                          "outstanding_tokens": r.outstanding_tokens}
+                         for r in self.router.replicas],
+            "replicas_healthy": snap.get("replicas_healthy", 0.0),
+            "replicas_parked": snap.get("replicas_parked", 0.0),
+            "queue": {
+                "depth": snap.get("queue_depth", 0.0),
+                "per_class": {c: snap.get(f"queue_depth_class_{c}", 0.0)
+                              for c in classes},
+                "brownout_active": bool(snap.get("brownout_active", 0.0)),
+            },
+            "occupancy": {
+                "kv_blocks_in_use": snap.get("kv_blocks_in_use", 0.0),
+                "kv_bytes_in_use": snap.get("kv_bytes_in_use", 0.0),
+                "handoff_staged": snap.get("handoff_staged", 0.0),
+                "outstanding_tokens": snap.get("outstanding_tokens", 0.0),
+            },
+            "counters": {k: snap.get(k, 0.0) for k in (
+                "requests_submitted", "requests_completed",
+                "requests_shed", "requests_expired", "requests_failed",
+                "requests_failed_over", "replica_restarts",
+                "handoffs_completed", "handoff_fallbacks")},
+            "window_s": window_s,
+            "window": self.windowed.summary(hist_names, window_s),
+            "slo": (self.alerts.status() if self.alerts is not None
+                    else None),
+            "alerts_firing": (self.alerts.firing()
+                              if self.alerts is not None else []),
+            "events": self.journal.events(limit=recent_events),
+        }
+        return report
+
+    def health_report_text(self, window_s: float = 60.0,
+                           recent_events: int = 10) -> str:
+        """The health report rendered for a terminal/incident channel."""
+        r = self.health_report(window_s=window_s,
+                               recent_events=recent_events)
+        lines = [
+            "== serving health ==",
+            "replicas: " + " ".join(
+                f"{rep['id']}:{rep['state']}({rep['role']})"
+                for rep in r["replicas"])
+            + (f"  [{int(r['replicas_parked'])} parked]"
+               if r["replicas_parked"] else ""),
+            f"queue: depth={r['queue']['depth']:.0f} "
+            + " ".join(f"{c}={d:.0f}"
+                       for c, d in sorted(r["queue"]["per_class"].items()))
+            + ("  BROWNOUT" if r["queue"]["brownout_active"] else ""),
+            f"kv: blocks={r['occupancy']['kv_blocks_in_use']:.0f} "
+            f"bytes={r['occupancy']['kv_bytes_in_use']:.0f} "
+            f"staged={r['occupancy']['handoff_staged']:.0f}",
+        ]
+        c = r["counters"]
+        lines.append(
+            f"requests: submitted={c['requests_submitted']:.0f} "
+            f"completed={c['requests_completed']:.0f} "
+            f"shed={c['requests_shed']:.0f} "
+            f"failed={c['requests_failed']:.0f} "
+            f"failed_over={c['requests_failed_over']:.0f}")
+        for name, w in sorted(r["window"].items()):
+            if w.get("count"):
+                lines.append(
+                    f"window[{window_s:.0f}s] {name}: n={w['count']} "
+                    f"p50={w['p50'] * 1e3:.1f}ms p95={w['p95'] * 1e3:.1f}ms")
+        if r["slo"] is not None:
+            for name, s in sorted(r["slo"].items()):
+                state = "FIRING" if s["firing"] else "ok"
+                lines.append(
+                    f"slo {name}: {state} burn_fast={s['burn_fast']} "
+                    f"burn_slow={s['burn_slow']} "
+                    f"budget_spent={s['budget_spent_frac']}")
+        if r["events"]:
+            lines.append("recent events:")
+            lines.append(self.journal.render_text(limit=recent_events))
+        return "\n".join(lines)
 
     # ------------------------------------------------------------ telemetry
     def debug_dump(self, dump_dir: Optional[str] = None) -> dict:

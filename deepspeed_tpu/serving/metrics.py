@@ -97,18 +97,21 @@ class Histogram:
     def mean(self) -> float:
         return self._sum / self._count if self._count else 0.0
 
-    def percentile(self, q: float) -> float:
-        """Estimated q-th percentile (q in [0, 100]) from the buckets.
-        Linear interpolation inside the winning bucket. Over-range
-        samples land in the +Inf overflow bucket, which has no finite
-        upper bound to interpolate toward — the estimate CLAMPS to the
-        largest finite bucket bound (a documented floor) instead of
-        reporting +Inf/garbage; size the bucket list so real tails stay
-        inside it."""
-        with self._lock:
-            counts = list(self._counts)
-            total = self._count
-        if total == 0 or not self.bounds:
+    @staticmethod
+    def percentile_from(bounds: Sequence[float], counts: Sequence[int],
+                        q: float) -> float:
+        """q-th percentile (q in [0, 100]) from per-bucket counts —
+        the shared interpolation used by the cumulative :meth:`percentile`
+        AND the windowed delta math (telemetry/windowed.py), so a sliding
+        window and the since-boot estimate can never disagree in
+        *method*, only in *data*. Linear interpolation inside the winning
+        bucket; over-range samples land in the +Inf overflow bucket,
+        which has no finite upper bound to interpolate toward — the
+        estimate CLAMPS to the largest finite bucket bound (a documented
+        floor) instead of reporting +Inf/garbage; size the bucket list so
+        real tails stay inside it."""
+        total = sum(counts)
+        if total == 0 or not bounds:
             return 0.0
         rank = max(1.0, math.ceil(q / 100.0 * total))
         seen = 0
@@ -116,26 +119,66 @@ class Histogram:
             if c == 0:
                 continue
             if seen + c >= rank:
-                if i >= len(self.bounds):       # overflow: clamp, never Inf
-                    return self.bounds[-1]
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i]
+                if i >= len(bounds):            # overflow: clamp, never Inf
+                    return bounds[-1]
+                lo = bounds[i - 1] if i > 0 else 0.0
+                hi = bounds[i]
                 frac = (rank - seen) / c
                 return lo + (hi - lo) * frac
             seen += c
-        return self.bounds[-1]
+        return bounds[-1]
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile over the cumulative (since-boot)
+        counts; see :meth:`percentile_from` for the interpolation and
+        over-range clamping contract."""
+        bounds, counts, _, _ = self.buckets_snapshot()
+        return self.percentile_from(bounds, counts, q)
+
+    @staticmethod
+    def fraction_over_from(bounds: Sequence[float], counts: Sequence[int],
+                           threshold: float) -> float:
+        """Fraction of the counted observations ABOVE ``threshold`` —
+        shared by the windowed burn rates (telemetry/windowed.py) and
+        the cumulative error-budget ledger (telemetry/slo.py), so the
+        two can never disagree on the bucket-boundary convention.
+        Resolution is the bucket grid: the threshold maps to the
+        smallest bound >= it (observations inside that bucket count as
+        compliant); beyond the largest finite bound only the +Inf
+        overflow bucket counts as over. 0.0 on an empty snapshot."""
+        total = sum(counts)
+        if total == 0:
+            return 0.0
+        under = 0
+        for i, b in enumerate(bounds):
+            under += counts[i]
+            if b >= threshold:
+                break
+        return max(0, total - under) / total
 
     def buckets_snapshot(self) -> Tuple[Tuple[float, ...], List[int],
                                         float, int]:
         """Consistent (bounds, per-bucket counts incl. the +Inf overflow,
-        sum, count) — the raw material for Prometheus exposition."""
+        sum, count) — ONE atomic read under the observe lock, so counts,
+        sum and count always describe the same set of observations. This
+        is the only sanctioned way to read the histogram for delta math:
+        two snapshots taken around concurrent ``observe`` calls yield
+        per-bucket / count / sum deltas that are each non-negative and
+        mutually consistent (count delta == sum of bucket deltas) — the
+        property telemetry/windowed.py's sliding windows are built on."""
         with self._lock:
             return self.bounds, list(self._counts), self._sum, self._count
 
     def snapshot(self) -> Dict[str, float]:
-        return {"count": float(self._count), "sum": self._sum,
-                "mean": self.mean, "p50": self.percentile(50),
-                "p95": self.percentile(95), "p99": self.percentile(99)}
+        """Summary stats computed from ONE consistent bucket snapshot
+        (count/sum/mean and every percentile describe the same set of
+        observations even while other threads observe concurrently)."""
+        bounds, counts, total_sum, total = self.buckets_snapshot()
+        return {"count": float(total), "sum": total_sum,
+                "mean": total_sum / total if total else 0.0,
+                "p50": self.percentile_from(bounds, counts, 50),
+                "p95": self.percentile_from(bounds, counts, 95),
+                "p99": self.percentile_from(bounds, counts, 99)}
 
 
 class MetricsRegistry:
@@ -191,6 +234,31 @@ class MetricsRegistry:
         for name, h in hists.items():
             out[name] = h.snapshot()
         return out
+
+    def raw_snapshot(self) -> Dict[str, object]:
+        """The delta-math view (telemetry/windowed.py): counter/gauge
+        values plus each histogram's consistent
+        ``(bounds, counts, sum, count)`` bucket snapshot — percentile
+        summaries would be useless for windowing (quantiles don't
+        subtract; bucket counts do)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in counters.items()},
+            "gauges": {n: g.value for n, g in gauges.items()},
+            "hists": {n: h.buckets_snapshot() for n, h in hists.items()},
+        }
+
+    def names(self) -> Dict[str, Tuple[str, ...]]:
+        """Declared metric names by kind — the audit surface
+        (tests compare this against docs/OBSERVABILITY.md's metric-name
+        reference table, both directions)."""
+        with self._lock:
+            return {"counters": tuple(sorted(self._counters)),
+                    "gauges": tuple(sorted(self._gauges)),
+                    "histograms": tuple(sorted(self._histograms))}
 
     def events(self, step: int) -> List[Event]:
         evs: List[Event] = []
@@ -257,10 +325,24 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
-def serving_metrics() -> MetricsRegistry:
+#: request classes every fresh registry declares series for;
+#: ``serving_metrics(classes=...)`` extends the set from the config so
+#: custom classes ALSO expose zero-valued series before first traffic
+STOCK_CLASSES = ("interactive", "batch")
+
+
+def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
+                    ) -> MetricsRegistry:
     """Registry pre-declaring the serving layer's metric names, so
-    dashboards and ``bench.py`` see zeros (not absences) before traffic."""
+    dashboards and ``bench.py`` see zeros (not absences) before traffic.
+    ``classes`` extends the per-class series (``ttft_s_class_<cls>``,
+    ``requests_shed_class_<cls>``, …) beyond the stock
+    interactive/batch pair — ``ServingFrontend`` passes the configured
+    ``classes:`` map, so ``render_prometheus()`` exposes every class's
+    zero-valued series at boot (an absent series is indistinguishable
+    from a broken exporter; a zero one isn't)."""
     reg = MetricsRegistry("serving")
+    all_classes = list(dict.fromkeys(list(STOCK_CLASSES) + list(classes)))
     for c in ("requests_submitted", "requests_admitted", "requests_shed",
               "requests_expired", "requests_completed", "requests_cancelled",
               "requests_failed", "tokens_generated",
@@ -285,19 +367,15 @@ def serving_metrics() -> MetricsRegistry:
               # prefill-role replicas; completed = imports that resumed
               # on a decode-role replica; fallbacks = handoffs that
               # degraded to re-prefill (export/import failure or a full
-              # staging buffer). Per-class shed counters for the stock
-              # classes (others appear on first use).
+              # staging buffer)
               "handoffs_started", "handoffs_completed",
-              "handoff_fallbacks",
-              "requests_shed_class_interactive",
-              "requests_shed_class_batch"):
+              "handoff_fallbacks"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
-              # phase-split router load + per-class queue depths + KV
-              # handoff staging occupancy + per-role KV pool split
-              # (docs/SERVING.md "Disaggregated serving")
+              # phase-split router load + KV handoff staging occupancy +
+              # per-role KV pool split (docs/SERVING.md "Disaggregated
+              # serving")
               "outstanding_prefill_tokens", "outstanding_decode_tokens",
-              "queue_depth_class_interactive", "queue_depth_class_batch",
               "handoff_staged",
               "kv_blocks_in_use_role_prefill",
               "kv_blocks_in_use_role_decode",
@@ -307,17 +385,29 @@ def serving_metrics() -> MetricsRegistry:
               # brownout_active: 1 while the admission queue is shedding
               # lowest-urgency work under degraded capacity
               "replicas_parked", "capacity_alarm", "brownout_active",
+              # SLO burn-rate alerting (docs/OBSERVABILITY.md "SLOs and
+              # burn-rate alerts"): number of alert rules currently
+              # firing; per-rule alert_firing_<rule> gauges are declared
+              # by the AlertEngine from the configured rules
+              "alerts_firing",
               # KV-pool occupancy summed over the fleet from
               # ``engine.occupancy()`` (docs/SERVING.md "KV
               # quantization"): bytes shrink ~2x per block under kv_quant
               "kv_blocks_in_use", "kv_bytes_in_use"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
-              # per-class latency splits + staging→import handoff time
-              # (docs/SERVING.md "Disaggregated serving")
-              "ttft_s_class_interactive", "ttft_s_class_batch",
-              "tpot_s_class_interactive", "tpot_s_class_batch",
+              # staging→import handoff time (docs/SERVING.md
+              # "Disaggregated serving")
               "handoff_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
+    # per-class series (docs/SERVING.md "Disaggregated serving",
+    # docs/OBSERVABILITY.md "SLOs and burn-rate alerts"): latency splits,
+    # queue depth, submit/shed counters — the SLO engine's raw material
+    for cls in all_classes:
+        reg.counter(f"requests_submitted_class_{cls}")
+        reg.counter(f"requests_shed_class_{cls}")
+        reg.gauge(f"queue_depth_class_{cls}")
+        reg.histogram(f"ttft_s_class_{cls}", DEFAULT_LATENCY_BUCKETS)
+        reg.histogram(f"tpot_s_class_{cls}", DEFAULT_LATENCY_BUCKETS)
     reg.histogram("queue_depth_hist", DEFAULT_DEPTH_BUCKETS)
     return reg

@@ -32,9 +32,13 @@ from .request import Rejected, RequestState, ServingRequest, FinishReason
 
 class AdmissionQueue:
     def __init__(self, max_depth: int, metrics: Optional[MetricsRegistry] = None,
-                 brownout_threshold: float = 0.0):
+                 brownout_threshold: float = 0.0, journal=None):
         self.max_depth = int(max_depth)
         self.metrics = metrics
+        # ops journal (telemetry/journal.py): brownout enter/exit
+        # transitions are fleet-lifecycle events worth a durable record,
+        # not just a gauge flip
+        self.journal = journal
         # healthy-capacity fraction below this activates brownout
         # (0 = brownout disabled, the historical behavior)
         self.brownout_threshold = float(brownout_threshold)
@@ -159,13 +163,16 @@ class AdmissionQueue:
         if self.brownout_threshold <= 0.0:
             return
         shed: List[ServingRequest] = []
+        transition = None
         with self._lock:
             self._healthy_frac = max(0.0, min(1.0, float(frac)))
             was = self._brownout
             self._brownout = self._healthy_frac < self.brownout_threshold
-            if self.metrics is not None and was != self._brownout:
-                self.metrics.gauge("brownout_active").set(
-                    1.0 if self._brownout else 0.0)
+            if was != self._brownout:
+                transition = self._brownout
+                if self.metrics is not None:
+                    self.metrics.gauge("brownout_active").set(
+                        1.0 if self._brownout else 0.0)
             if self._brownout:
                 eff = self._effective_depth()
                 while len(self._heap) > eff:
@@ -175,6 +182,11 @@ class AdmissionQueue:
                     shed.append(self._pop_index_locked(worst_i))
                 if shed:
                     self._note_depth()
+        if transition is not None and self.journal is not None:
+            self.journal.emit(
+                "brownout_enter" if transition else "brownout_exit",
+                healthy_fraction=round(self._healthy_frac, 4),
+                shed_now=len(shed))
         for req in shed:
             self._count_shed(req, FinishReason.BROWNOUT)
             req.finish(RequestState.REJECTED, FinishReason.BROWNOUT)

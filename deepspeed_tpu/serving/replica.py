@@ -54,10 +54,14 @@ class Replica:
                  speculative=None, tracer=None, recorder=None,
                  faults=None, on_failover: Optional[Callable] = None,
                  role: str = "mixed", decode_reserve_tokens: int = 0,
-                 on_handoff: Optional[Callable] = None):
+                 on_handoff: Optional[Callable] = None, journal=None):
         from ..telemetry import NOOP_TRACER
 
         self.replica_id = replica_id
+        # ops journal (telemetry/journal.py): import-side handoff
+        # fallbacks are fleet-lifecycle events (the export side journals
+        # in the frontend)
+        self.journal = journal
         # disaggregated serving role (docs/SERVING.md "Disaggregated
         # serving"): "prefill" runs prompt-chunk-only steps and hands
         # each finished prompt's KV to ``on_handoff``; "decode" reserves
@@ -335,6 +339,10 @@ class Replica:
                         "falling back to re-prefill")
                     if self.metrics is not None:
                         self.metrics.counter("handoff_fallbacks").inc()
+                    if self.journal is not None:
+                        self.journal.emit("handoff_fallback", uid=req.uid,
+                                          where="import",
+                                          replica=self.replica_id)
                     payload = None
                     with self._lock:
                         # the assign-time charge was 0 (staged = no

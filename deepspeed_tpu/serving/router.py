@@ -43,7 +43,8 @@ class ReplicaRouter:
     def __init__(self, replicas: List[Replica], admission: AdmissionQueue,
                  metrics: Optional[MetricsRegistry] = None,
                  poll_interval_s: float = 0.05,
-                 tracer=None, recorder=None, disaggregation=None):
+                 tracer=None, recorder=None, disaggregation=None,
+                 tick_hooks=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         from ..telemetry import NOOP_TRACER
@@ -61,6 +62,12 @@ class ReplicaRouter:
         # (docs/OBSERVABILITY.md); both default to no-ops
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.recorder = recorder
+        # ~1/s observability hooks run every loop iteration alongside the
+        # flight-recorder snapshot: windowed-metrics ticks and SLO alert
+        # evaluation (docs/OBSERVABILITY.md "SLOs and burn-rate alerts").
+        # Each hook is cadence-gated internally and exception-isolated
+        # here — observability must never kill the dispatcher.
+        self.tick_hooks = list(tick_hooks) if tick_hooks else []
         self.poll_interval_s = poll_interval_s
         # attached by the frontend when fault_tolerance is enabled; the
         # supervisor swaps restarted replicas in via replace_replica
@@ -250,10 +257,18 @@ class ReplicaRouter:
                 self.metrics.counter("requests_failed").inc()
             req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
 
+    def _tick(self) -> None:
+        if self.recorder is not None:
+            self.recorder.maybe_snapshot()
+        for hook in self.tick_hooks:
+            try:
+                hook()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(f"serving router tick hook failed: {e!r}")
+
     def _loop(self) -> None:
         while not self._stop.is_set():
-            if self.recorder is not None:
-                self.recorder.maybe_snapshot()
+            self._tick()
             if self.pick() is None:
                 # no free slot anywhere: leave the backlog in the
                 # admission queue (priority/deadline order) rather than

@@ -55,7 +55,7 @@ class ReplicaSupervisor:
     def __init__(self, router, replica_factory: Callable,
                  engine_factory: Optional[Callable],
                  config: Optional[FaultToleranceConfig] = None,
-                 metrics=None, tracer=None, recorder=None):
+                 metrics=None, tracer=None, recorder=None, journal=None):
         from ..telemetry import NOOP_TRACER
 
         self.router = router
@@ -65,6 +65,9 @@ class ReplicaSupervisor:
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.recorder = recorder
+        # ops journal (telemetry/journal.py): restart/park transitions
+        # become durable queryable events, not just log lines
+        self.journal = journal
         self.rng = random.Random(self.config.seed)
         cfg = self.config
         self._slots = [
@@ -162,6 +165,10 @@ class ReplicaSupervisor:
         if self.metrics is not None:
             self.metrics.gauge("replicas_parked").set(parked)
             self.metrics.gauge("capacity_alarm").set(1.0)
+        if self.journal is not None:
+            self.journal.emit("replica_parked", replica=slot.index,
+                              crashes_in_window=n_crashes,
+                              parked_total=parked)
         if self.tracer.enabled:
             self.tracer.begin("replica_parked",
                               trace_id=f"replica-{slot.index}",
@@ -242,6 +249,12 @@ class ReplicaSupervisor:
                     "attempt": attempt})
             if self.metrics is not None:
                 self.metrics.counter("replica_restarts").inc()
+            if self.journal is not None:
+                self.journal.emit(
+                    "replica_restart", replica=slot.index, attempt=attempt,
+                    recovery_s=round(t_up - t_dead, 4),
+                    backoff_s=round(getattr(slot, "backoff_s", 0.0), 4),
+                    fresh_engine=self.engine_factory is not None)
             logger.warning(f"serving replica {slot.index} restarted "
                            f"(attempt {attempt}, "
                            f"{t_up - t_dead:.2f}s after death)")

@@ -9,7 +9,13 @@ One subsystem behind the framework's three observability surfaces
   runtime/engine.py, published through monitor/;
 - **flight recorder** — a bounded ring of recent spans + metric
   snapshots, dumped as raw JSON and Chrome ``trace_event`` JSON on
-  demand and on replica/scheduler errors.
+  demand and on replica/scheduler errors;
+- **SLO observability** (docs/OBSERVABILITY.md "SLOs and burn-rate
+  alerts") — sliding-window quantiles/rates over the cumulative metrics
+  (windowed.py), per-class SLO burn-rate alerting (slo.py), and the
+  unified ops event journal (journal.py) behind
+  ``ServingFrontend.health_report()`` /
+  ``TrainingSupervisor.health_report()``.
 
 Importable without JAX: the tracer is pure stdlib; the optional
 ``jax.profiler.TraceAnnotation`` pass-through imports lazily.
@@ -19,7 +25,14 @@ from .config import TelemetryConfig  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
 from .tracer import (NOOP_SPAN, NOOP_TRACER, Span, Tracer,  # noqa: F401
                      chrome_trace, trace_coverage, validate_chrome_trace)
+from .journal import (EVENT_SCHEMAS, OpsJournal,  # noqa: F401
+                      validate_event, validate_events)
+from .windowed import WindowedMetrics  # noqa: F401
+from .slo import (AlertEngine, AlertRule, SLOClassTarget,  # noqa: F401
+                  SLOConfig)
 
 __all__ = ["Tracer", "Span", "NOOP_TRACER", "NOOP_SPAN", "TelemetryConfig",
            "FlightRecorder", "chrome_trace", "validate_chrome_trace",
-           "trace_coverage"]
+           "trace_coverage", "OpsJournal", "EVENT_SCHEMAS",
+           "validate_event", "validate_events", "WindowedMetrics",
+           "AlertEngine", "AlertRule", "SLOClassTarget", "SLOConfig"]

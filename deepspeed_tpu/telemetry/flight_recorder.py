@@ -118,30 +118,52 @@ class FlightRecorder:
                       fh, default=str)
         return {"json": raw_path, "chrome_trace": trace_path}
 
-    def on_error(self, where: str, exc: BaseException) -> Optional[Dict[str, str]]:
-        """Crash hook for replica/scheduler error paths: best-effort dump,
-        rate-limited to ``max_error_dumps`` per ``error_dump_window_s``
-        (a dying fleet must not fill the disk, but a long-lived service
-        keeps capturing later incidents), never raises (the caller is
-        already handling a fault)."""
-        if not self.tracer.enabled:
-            return None
+    def _acquire_dump_slot(self) -> bool:
+        """One shared sliding-window budget for every automatic dump
+        trigger (errors AND alert firings — an alert storm must not fill
+        the disk any more than a crash loop may): True when a dump may
+        proceed, False when the window's ``max_error_dumps`` are spent."""
         now = self.tracer.clock()
         with self._lock:
             while self._error_dump_times and \
                     now - self._error_dump_times[0] > self.error_dump_window_s:
                 self._error_dump_times.popleft()
             if len(self._error_dump_times) >= self.max_error_dumps:
-                return None
+                return False
             self._error_dump_times.append(now)
+        return True
+
+    def _auto_dump(self, reason: str, what: str) -> Optional[Dict[str, str]]:
+        """Shared body of every automatic dump trigger: telemetry gate,
+        sliding rate-limit slot, snapshot + dump, never raises. ``what``
+        is the human log phrasing; ``reason`` lands in the filenames."""
+        if not self.tracer.enabled:
+            return None
+        if not self._acquire_dump_slot():
+            return None
         try:
             self.snapshot_metrics()
-            paths = self.dump(reason=f"error_{where}")
-            logger.warning(
-                f"telemetry: flight-recorder dump for error in {where} "
-                f"({type(exc).__name__}: {exc}) -> {paths['json']}")
+            paths = self.dump(reason=reason)
+            logger.warning(f"telemetry: flight-recorder dump for {what} "
+                           f"-> {paths['json']}")
             return paths
         except Exception as dump_exc:  # pragma: no cover - defensive
             logger.warning(f"telemetry: flight-recorder dump failed: "
                            f"{dump_exc!r}")
             return None
+
+    def on_error(self, where: str, exc: BaseException) -> Optional[Dict[str, str]]:
+        """Crash hook for replica/scheduler error paths: best-effort dump,
+        rate-limited to ``max_error_dumps`` per ``error_dump_window_s``
+        (a dying fleet must not fill the disk, but a long-lived service
+        keeps capturing later incidents), never raises (the caller is
+        already handling a fault)."""
+        return self._auto_dump(
+            f"error_{where}",
+            f"error in {where} ({type(exc).__name__}: {exc})")
+
+    def on_event(self, reason: str) -> Optional[Dict[str, str]]:
+        """Automatic dump for a non-error incident (a burn-rate alert
+        firing — telemetry/slo.py): same telemetry gate, same sliding
+        rate limiter as error dumps, never raises."""
+        return self._auto_dump(reason, reason)

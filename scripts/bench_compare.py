@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Diff two bench JSONs with per-metric tolerances — the perf
+trajectory's own observability (docs/OBSERVABILITY.md "Comparing bench
+runs").
+
+``bench.py`` emits one JSON line per round; this tool makes a pair of
+them answer "did we regress?" mechanically instead of by eyeball:
+
+    python scripts/bench_compare.py BENCH_r7.json BENCH_r8.json
+    python scripts/bench_compare.py BASELINE.json BENCH_r8.json --tol 0.15
+    python scripts/bench_compare.py A.json B.json --tol p50_ttft_ms=0.05
+
+Both numeric trees are flattened to dotted paths; every numeric leaf
+present in BOTH files is compared. Direction is inferred from the leaf
+name (latencies/times/losses regress UP, throughputs/rates/ratios
+regress DOWN; unknown names are reported as informational, never a
+breach). A move beyond the tolerance *in the regressing direction* is a
+BREACH; the exit code is non-zero when any breach exists, so CI (and
+scripts/tier1.sh users) can gate on it. Improvements and within-band
+moves never fail.
+
+Skipped phases (``phase_skipped`` stamps) are excluded from comparison
+on either side — an honest skip is not a regression, but it IS listed
+so a silently-shrinking bench can't hide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional, Tuple
+
+# name fragments -> regression direction. "lower": bigger is worse
+# (latency-shaped); "higher": smaller is worse (throughput-shaped).
+# _INFORMATIONAL wins over both: environment measurements (what the
+# MACHINE did, not the code) must never gate — the repo's own rounds
+# span 0.19%..4.78% noise floors across boxes.
+_INFORMATIONAL = ("noise_floor", "wall_", "budget_s")
+_LOWER_IS_BETTER = (
+    "ttft", "tpot", "latency", "_ms", "_time_s", "time_s", "wait",
+    "steps_lost", "overhead", "shed_rate", "ppl",
+    "loss", "fallbacks", "expired", "recovery", "_pct", "save_s",
+    "fire_to_resolve",
+)
+_HIGHER_IS_BETTER = (
+    "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
+    "tflops", "hit_rate", "acceptance_rate", "concurrency",
+    "max_concurrent", "vs_baseline", "coverage", "success_rate",
+    "tokens_generated", "decode_tokens", "value",
+)
+
+
+def direction_of(path: str) -> Optional[str]:
+    """"lower" / "higher" is better, or None (informational only).
+    Informational fragments win outright; then lower-is-better is
+    checked before higher: a name matching both families (rare) is
+    treated as latency-shaped — the conservative read for a serving
+    bench."""
+    leaf = path.rsplit(".", 1)[-1].lower()
+    for frag in _INFORMATIONAL:
+        if frag in leaf:
+            return None
+    for frag in _LOWER_IS_BETTER:
+        if frag in leaf:
+            return "lower"
+    for frag in _HIGHER_IS_BETTER:
+        if frag in leaf:
+            return "higher"
+    return None
+
+
+def flatten(obj, prefix="", skipped=None) -> Dict[str, float]:
+    """Numeric leaves by dotted path; bools excluded (they are parity
+    bits, compared separately). A dict stamped ``phase_skipped`` is
+    recorded in ``skipped`` and not descended into."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        if "phase_skipped" in obj:
+            if skipped is not None:
+                skipped.add(prefix or "<root>")
+            return out
+        for k, v in obj.items():
+            out.update(flatten(v, f"{prefix}.{k}" if prefix else k,
+                               skipped))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    return out
+
+
+def flatten_bools(obj, prefix="") -> Dict[str, bool]:
+    out: Dict[str, bool] = {}
+    if isinstance(obj, dict):
+        if "phase_skipped" in obj:
+            return out
+        for k, v in obj.items():
+            out.update(flatten_bools(v, f"{prefix}.{k}" if prefix else k))
+    elif isinstance(obj, bool):
+        out[prefix] = obj
+    return out
+
+
+def parse_tols(args_tol) -> Tuple[float, Dict[str, float]]:
+    """--tol accepts a bare default fraction and/or path=frac overrides
+    (matched by substring, most specific wins by longest match)."""
+    default = 0.10
+    per: Dict[str, float] = {}
+    for t in args_tol or []:
+        if "=" in t:
+            key, _, val = t.partition("=")
+            per[key] = float(val)
+        else:
+            default = float(t)
+    return default, per
+
+
+def tol_for(path: str, default: float, per: Dict[str, float]) -> float:
+    best = None
+    for key, val in per.items():
+        if key in path and (best is None or len(key) > len(best[0])):
+            best = (key, val)
+    return best[1] if best else default
+
+
+def compare(a: dict, b: dict, default_tol: float,
+            per_tol: Dict[str, float]):
+    skipped_a, skipped_b = set(), set()
+    fa = flatten(a, skipped=skipped_a)
+    fb = flatten(b, skipped=skipped_b)
+    rows = []
+    breaches = []
+    for path in sorted(set(fa) & set(fb)):
+        va, vb = fa[path], fb[path]
+        direction = direction_of(path)
+        tol = tol_for(path, default_tol, per_tol)
+        base = max(abs(va), 1e-12)
+        delta = (vb - va) / base
+        status = "ok"
+        if direction == "lower" and delta > tol:
+            status = "BREACH"
+        elif direction == "higher" and delta < -tol:
+            status = "BREACH"
+        elif direction is None:
+            status = "info"
+        elif (direction == "lower" and delta < -tol) or \
+                (direction == "higher" and delta > tol):
+            status = "improved"
+        rows.append((path, va, vb, delta, direction or "-", status))
+        if status == "BREACH":
+            breaches.append(path)
+    # parity/gate bits: a True that became False is always a breach
+    ba, bb = flatten_bools(a), flatten_bools(b)
+    for path in sorted(set(ba) & set(bb)):
+        if ba[path] and not bb[path]:
+            rows.append((path, 1.0, 0.0, -1.0, "bool", "BREACH"))
+            breaches.append(path)
+    return rows, breaches, skipped_a, skipped_b
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two bench JSONs; non-zero exit on regression.")
+    ap.add_argument("old", help="baseline bench JSON (e.g. BASELINE.json "
+                                "or the previous round's BENCH_*.json)")
+    ap.add_argument("new", help="candidate bench JSON")
+    ap.add_argument("--tol", action="append", metavar="FRAC|PATH=FRAC",
+                    help="default tolerance fraction (bare number) or a "
+                         "per-metric override (substring=frac); "
+                         "repeatable. Default 0.10.")
+    ap.add_argument("--all", action="store_true",
+                    help="print every compared row, not just "
+                         "breaches/improvements")
+    args = ap.parse_args(argv)
+    with open(args.old) as fh:
+        a = json.load(fh)
+    with open(args.new) as fh:
+        b = json.load(fh)
+    default_tol, per_tol = parse_tols(args.tol)
+    rows, breaches, skipped_a, skipped_b = compare(a, b, default_tol,
+                                                   per_tol)
+    shown = [r for r in rows
+             if args.all or r[5] in ("BREACH", "improved")]
+    if shown:
+        w = max(len(r[0]) for r in shown)
+        print(f"{'metric':<{w}}  {'old':>12}  {'new':>12}  {'delta':>8}  "
+              f"{'dir':>6}  status")
+        for path, va, vb, delta, direction, status in shown:
+            print(f"{path:<{w}}  {va:>12.4f}  {vb:>12.4f}  "
+                  f"{delta * 100:>7.1f}%  {direction:>6}  {status}")
+    for side, skipped in (("old", skipped_a), ("new", skipped_b)):
+        for s in sorted(skipped):
+            print(f"# {side}: phase {s} skipped (excluded from diff)")
+    n_cmp = len(rows)
+    print(f"# compared {n_cmp} metrics, tolerance {default_tol:.0%}"
+          + (f" (+{len(per_tol)} overrides)" if per_tol else ""))
+    if breaches:
+        print(f"REGRESSION: {len(breaches)} metric(s) breached: "
+              + ", ".join(breaches[:10]))
+        return 1
+    print("no regressions beyond tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,11 +13,18 @@
 #                  from 870 when the train chaos suite joined tier-1)
 #   TIER1_ARGS     extra pytest args (e.g. "-k spec")
 #   TIER1_PHASE    run ONE named serving bench phase as a smoke instead
-#                  of the test suite (e.g. TIER1_PHASE=kv_quant or
-#                  TIER1_PHASE=disagg for the disaggregated
-#                  prefill/decode phase) — wires bench.py's
-#                  phase-resumable runner (BENCH_PHASES +
+#                  of the test suite (e.g. TIER1_PHASE=kv_quant,
+#                  TIER1_PHASE=disagg for disaggregated prefill/decode,
+#                  or TIER1_PHASE=slo for the SLO burn-rate-alerting
+#                  phase — injected latency fault must fire AND resolve
+#                  the interactive alert, with journal/alert schema
+#                  validation folded into schema_problems) — wires
+#                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
+#                  Compare two rounds' bench JSONs with per-metric
+#                  tolerances via scripts/bench_compare.py (non-zero
+#                  exit on regression — docs/OBSERVABILITY.md
+#                  "Comparing bench runs").
 #   TIER1_CHAOS_TRAIN=1  smoke ONLY the training chaos suite
 #                  (tests/test_train_resilience.py — preemption/crash/
 #                  wedge/anomaly recovery; docs/TRAINING.md) instead of

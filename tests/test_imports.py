@@ -57,6 +57,9 @@ MODULES = [
     "deepspeed_tpu.serving.supervisor",
     "deepspeed_tpu.telemetry",
     "deepspeed_tpu.telemetry.flight_recorder",
+    "deepspeed_tpu.telemetry.journal",
+    "deepspeed_tpu.telemetry.slo",
+    "deepspeed_tpu.telemetry.windowed",
     "deepspeed_tpu.utils.comms_logging",
     "deepspeed_tpu.utils.restart",
     "deepspeed_tpu.utils.zero_to_fp32",

@@ -1,0 +1,241 @@
+"""Ops-journal-under-churn chaos suite (ISSUE 9 satellite).
+
+Drives the serving FaultInjector and the TrainFaultInjector through
+real recovery stories and asserts the unified journal's contract held
+under the churn: every restart / rollback / handoff appears EXACTLY
+once (cross-checked against the supervisors' own logs and the metrics
+counters — the journal must neither drop nor duplicate), the whole
+stream passes schema validation, the ring stays bounded, and
+timestamps are monotonic (docs/OBSERVABILITY.md "The ops event
+journal").
+"""
+
+import time
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2, RaggedInferenceEngineConfig)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.serving import ServingConfig, ServingFrontend
+from deepspeed_tpu.telemetry import validate_events
+
+VOCAB = 128
+
+_model = None
+_params = None
+
+
+def tiny_engine(i=0):
+    global _model, _params
+    if _model is None:
+        _model = CausalLM(TransformerConfig(
+            vocab_size=VOCAB, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=2, max_seq_len=128, norm="rmsnorm",
+            activation="silu", position="rope"))
+    vcfg = RaggedInferenceEngineConfig(
+        max_ragged_batch_size=128, max_ragged_sequence_count=4,
+        max_chunk_tokens=32, kv_blocks=64, kv_block_size=8,
+        max_tracked_sequences=16)
+    eng = InferenceEngineV2(_model, params=_params, config=vcfg)
+    _params = eng.params
+    return eng
+
+
+def prompts(n, seed, lo=8, hi=20):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, VOCAB, size=int(l)).tolist()
+            for l in rng.integers(lo, hi, size=n)]
+
+
+def _journal_invariants(journal):
+    evs = journal.events()
+    assert validate_events(evs) == [], validate_events(evs)[:5]
+    assert len(journal) <= journal.capacity
+    ts = [e["t"] for e in evs]
+    assert ts == sorted(ts)
+    return evs
+
+
+def test_serving_crash_churn_journal_exact_once():
+    """Replica 0 crashes mid-burst under supervision: the journal's
+    replica_restart events must match the supervisor's restart log 1:1
+    and request_failover events must match the counter — exactly once
+    each, valid schema, monotonic, bounded."""
+    scfg = ServingConfig(
+        max_queue_depth=64,
+        fault_tolerance={"enabled": True, "max_retries": 3,
+                         "restart_backoff_s": 0.05,
+                         "restart_backoff_max_s": 0.2,
+                         "supervisor_poll_s": 0.02,
+                         "restart_window_s": 60.0,
+                         "max_restarts_in_window": 5},
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 3}]})
+    fe = ServingFrontend([tiny_engine(0), tiny_engine(1)], scfg,
+                         engine_factory=tiny_engine)
+    try:
+        hs = [fe.submit(p, max_new_tokens=5) for p in prompts(8, 1)]
+        assert fe.wait_all(hs, timeout=300)
+        deadline = time.monotonic() + 60
+        while not fe.supervisor.restart_log and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        snap = fe.metrics_snapshot()
+        evs = _journal_invariants(fe.journal)
+        restarts = [e for e in evs if e["kind"] == "replica_restart"]
+        assert len(restarts) == len(fe.supervisor.restart_log) >= 1
+        # 1:1 against the supervisor's own record, field for field
+        for ev, log in zip(restarts, fe.supervisor.restart_log):
+            assert ev["detail"]["replica"] == log["replica"]
+            assert ev["detail"]["attempt"] == log["attempt"]
+        failovers = [e for e in evs if e["kind"] == "request_failover"]
+        assert len(failovers) == int(snap["requests_failed_over"])
+        # one journal entry per failover uid+attempt — no duplicates
+        keys = [(e["detail"]["uid"], e["detail"]["attempt"])
+                for e in failovers]
+        assert len(keys) == len(set(keys))
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_serving_park_and_brownout_journaled():
+    """A replica that crashes on every step trips the circuit breaker:
+    the park lands in the journal exactly once; with a brownout
+    threshold, the capacity collapse also journals the brownout entry."""
+    scfg = ServingConfig(
+        max_queue_depth=16,
+        fault_tolerance={"enabled": True, "restart_backoff_s": 0.01,
+                         "restart_backoff_max_s": 0.05,
+                         "restart_backoff_jitter": 0.0,
+                         "supervisor_poll_s": 0.01,
+                         "max_restarts_in_window": 2,
+                         "restart_window_s": 60.0,
+                         "brownout_threshold": 0.75},
+        faults={"enabled": True, "schedule": [
+            {"kind": "crash", "replica": 0, "at_step": 0, "count": 0}]})
+    fe = ServingFrontend([tiny_engine(0), tiny_engine(1)], scfg,
+                         engine_factory=tiny_engine)
+    try:
+        hs = []
+        for p in prompts(6, 2):
+            try:
+                hs.append(fe.submit(p, max_new_tokens=4))
+            except Exception:
+                pass
+        deadline = time.monotonic() + 60
+        while fe.supervisor.parked_count() == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fe.supervisor.parked_count() == 1
+        # survivors finish the work
+        fe.wait_all(hs, timeout=120)
+        evs = _journal_invariants(fe.journal)
+        parks = [e for e in evs if e["kind"] == "replica_parked"]
+        assert len(parks) == 1
+        assert parks[0]["detail"]["replica"] == 0
+        # every restart that happened before the park is journaled too
+        n_restarts = len([e for e in evs
+                          if e["kind"] == "replica_restart"])
+        assert n_restarts == len(fe.supervisor.restart_log)
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_disagg_handoff_churn_journal_matches_counters():
+    """Role-split fleet: every staged handoff journals handoff_staged
+    exactly once (== handoffs_started counter); fallbacks (if any)
+    journal too (== handoff_fallbacks counter)."""
+    scfg = ServingConfig(
+        max_queue_depth=64,
+        disaggregation={"enabled": True,
+                        "roles": ["prefill", "decode"],
+                        "handoff": {"enabled": True, "max_staged": 8}})
+    fe = ServingFrontend([tiny_engine(0), tiny_engine(1)], scfg)
+    try:
+        hs = [fe.submit(p, max_new_tokens=4) for p in prompts(6, 4)]
+        assert fe.wait_all(hs, timeout=300)
+        snap = fe.metrics_snapshot()
+        assert snap["handoffs_started"] >= 1
+        evs = _journal_invariants(fe.journal)
+        staged = [e for e in evs if e["kind"] == "handoff_staged"]
+        assert len(staged) == int(snap["handoffs_started"])
+        uids = [e["detail"]["uid"] for e in staged]
+        assert len(uids) == len(set(uids))
+        fallbacks = [e for e in evs if e["kind"] == "handoff_fallback"]
+        assert len(fallbacks) == int(snap["handoff_fallbacks"])
+    finally:
+        fe.shutdown(drain=False, timeout=5)
+
+
+def test_train_chaos_journal_exact_once(tmp_path):
+    """Training churn: a crash restart and an anomaly rollback each
+    journal exactly once, checkpoint publications match the saves that
+    actually happened, schema/bounds/ordering hold throughout."""
+    import deepspeed_tpu
+    import deepspeed_tpu.parallel.topology as topo
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.runtime.resilience import TrainingSupervisor
+
+    rng = np.random.default_rng(0)
+
+    def build(save_dir, faults):
+        topo.reset_topology()
+        cfg = {
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": -1, "fsdp": 1},
+            "steps_per_print": 10**9,
+            "resilience": {"enabled": True, "save_dir": str(save_dir),
+                           "save_interval_steps": 2,
+                           "restart_backoff_s": 0.01,
+                           "restart_backoff_jitter": 0.0,
+                           "watchdog_enabled": False,
+                           "max_consecutive_anomalies": 2,
+                           "faults": faults},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=build_model("tiny"), config=cfg,
+            training_data={"input_ids": rng.integers(
+                0, 256, size=(64, 33), dtype=np.int64)})
+        return engine
+
+    # crash at 3 AND a 2-step NaN storm at 5 -> one restart + one rollback
+    faults = {"enabled": True, "schedule": [
+        {"kind": "crash", "at_step": 3},
+        {"kind": "nan_grads", "at_step": 5, "count": 2},
+    ]}
+    d = tmp_path / "churn"
+    sup = TrainingSupervisor(engine=build(d, faults))
+    r = sup.run(8)
+    assert r["status"] == "completed"
+    evs = _journal_invariants(sup.journal)
+    kinds = [e["kind"] for e in evs]
+    assert kinds.count("train_restart") == r["train_restarts"]
+    assert kinds.count("train_anomaly_rollback") == \
+        r["anomaly_rollbacks"] == 1
+    # every train_restart detail matches the supervisor's restart log
+    restarts = [e for e in evs if e["kind"] == "train_restart"]
+    for ev, log in zip(restarts, sup.restart_log):
+        assert ev["detail"]["reason"] == log["reason"]
+        assert ev["detail"]["steps_lost"] == log["steps_lost"]
+        assert ev["detail"]["resumed_step"] == log["resumed_step"]
+    assert kinds.count("checkpoint_saved") >= 2
+    assert kinds.count("train_parked") == 0
+
+
+def test_journal_stays_bounded_under_event_storm():
+    """A pathological storm (far more events than capacity) keeps the
+    ring at capacity with the NEWEST events, still schema-valid."""
+    from deepspeed_tpu.telemetry import OpsJournal
+
+    j = OpsJournal(capacity=32)
+    for i in range(10_000):
+        j.emit("train_wedge", step=i)
+    assert len(j) == 32
+    assert j.total_emitted == 10_000
+    evs = j.events()
+    assert validate_events(evs) == []
+    assert evs[-1]["detail"]["step"] == 9_999

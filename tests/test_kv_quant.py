@@ -463,9 +463,17 @@ def test_bench_schema_validator():
                          "mean_matched_prefix_frac": 1.0,
                          "disabled_parity": True, "kv_occupancy": occ}}
     for name in bench._STAMPED_PHASES:
-        if name in ("kv_quant", "train_chaos", "disagg"):
+        if name in ("kv_quant", "train_chaos", "disagg", "slo"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
+    good["slo"] = {"alert_fired": True, "alert_resolved": True,
+                   "fire_to_resolve_s": 4.9, "alerts_firing_peak": 1,
+                   "alerts_firing_final": 0, "window_p95_ttft_ms": 12.5,
+                   "cum_p95_ttft_ms": 12.5, "window_agrees": True,
+                   "noise_floor_pct": 1.0, "overhead_slo_pct": 0.3,
+                   "overhead_ok": True, "journal_events": 2,
+                   "journal_schema_ok": True, "disabled_parity": True,
+                   "kv_occupancy": dict(occ)}
     good["train_chaos"] = {"recovery_time_s": 0.12, "steps_lost": 1,
                            "resume_parity": True,
                            "sigterm_resume_parity": True,
@@ -518,6 +526,20 @@ def test_bench_schema_validator():
     bad4["kv_quant"] = dict(good["kv_quant"], max_concurrent_base=True)
     assert any("kv_quant.max_concurrent_base" in p
                for p in bench.validate_serving_schema(bad4))
+    # slo typed checks: missing/mistyped fields named; a journal that
+    # failed validate_events is a schema problem in its own right
+    bad5 = dict(good)
+    bad5["slo"] = {"alert_fired": 1, "kv_occupancy": dict(occ)}
+    problems5 = bench.validate_serving_schema(bad5)
+    assert any("slo.alert_fired" in p for p in problems5)
+    assert any("slo.journal_schema_ok: missing" in p for p in problems5)
+    bad6 = dict(good)
+    bad6["slo"] = dict(good["slo"], journal_schema_ok=False)
+    assert any("journal events failed schema" in p
+               for p in bench.validate_serving_schema(bad6))
+    skipped3 = dict(good)
+    skipped3["slo"] = {"phase_skipped": "not selected"}
+    assert bench.validate_serving_schema(skipped3) == []
 
 
 def test_phase_runner_skip_and_budget(tmp_path, monkeypatch):
