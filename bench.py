@@ -237,7 +237,11 @@ class PhaseRunner:
 # ``schema_problems``) and asserted by tests/test_kv_quant.py.
 _OCCUPANCY_KEYS = ("total_blocks", "free_blocks", "in_use_blocks",
                    "bytes_per_block", "bytes_in_use", "bytes_total",
-                   "evictable_blocks", "available_blocks")
+                   "evictable_blocks", "available_blocks",
+                   # tiered KV memory (docs/SERVING.md "KV tiering"):
+                   # zeros on engines without a tier, same schema
+                   "kv_blocks_host_tier", "kv_bytes_host_tier",
+                   "kv_blocks_disk_tier", "kv_bytes_disk_tier")
 _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("max_concurrent_int8", int),
                   ("concurrency_ratio", (int, float)),
@@ -251,7 +255,21 @@ _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("disabled_parity", bool))
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
-                   "disagg", "slo")
+                   "disagg", "slo", "kv_tier")
+# Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
+# TTFT comparison with the device pool sized below the prefix working
+# set, spill/restore counts, and the parity bits the acceptance gates
+# read (tier-on greedy parity + disabled byte-parity, both asserted).
+_KV_TIER_KEYS = (("tier_on_p50_ttft_ms", (int, float)),
+                 ("tier_off_p50_ttft_ms", (int, float)),
+                 ("ttft_improved", bool),
+                 ("blocks_spilled", int),
+                 ("blocks_restored", int),
+                 ("blocks_dropped", int),
+                 ("prefix_hit_rate_on", (int, float)),
+                 ("prefix_hit_rate_off", (int, float)),
+                 ("greedy_parity", bool),
+                 ("disabled_parity", bool))
 # Typed shape of the disagg phase (docs/SERVING.md "Disaggregated
 # serving"): the TTFT/TPOT comparison, handoff counts and parity bits
 # the acceptance gates read.
@@ -330,6 +348,11 @@ def validate_serving_schema(serving: dict):
         problems.append("disagg: missing or not an object")
     elif "phase_skipped" not in dg:
         _check_typed_phase("disagg", dg, _DISAGG_KEYS, problems)
+    kt = serving.get("kv_tier")
+    if not isinstance(kt, dict):
+        problems.append("kv_tier: missing or not an object")
+    elif "phase_skipped" not in kt:
+        _check_typed_phase("kv_tier", kt, _KV_TIER_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -1093,6 +1116,154 @@ def bench_serving(on_tpu: bool):
             "disabled_parity": bool(disabled["gens"] == mixed["gens"]),
         }
 
+    def run_kv_tier_phase():
+        """Tiered KV memory (docs/SERVING.md "KV tiering"): N requests
+        over K system prompts with the device KV pool deliberately too
+        small to hold every prefix, so cold prefixes are LRU-evicted
+        between repeats. Tier off: an evicted prefix re-prefills from
+        scratch. Tier on: the eviction spilled its blocks to host RAM
+        and the repeat restores them — only the still-cold tail
+        prefills. Reports p50 TTFT and prefix hit rate both ways over a
+        measured repeat pass (greedy streams asserted byte-identical
+        tier on vs off, restores asserted > 0 so the comparison isn't
+        vacuous), plus spill/restore/drop counts, and asserts
+        ``kv_tier.enabled=false`` through the frontend config path is
+        byte-identical to a config without the block."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+        from deepspeed_tpu.serving import ServingConfig, ServingFrontend
+
+        bs = vcfg.kv_block_size
+        # sys_len matters: the batched restore costs ~constant per run
+        # while re-prefill scales with prefix length, so the prefix must
+        # be long enough that saved forwards dominate dispatch overhead
+        # (production system prompts are hundreds of tokens)
+        if on_tpu:
+            n_req, k_prompts, sys_len, tail_len, max_new = 24, 6, 512, 32, 8
+        else:
+            n_req, k_prompts, sys_len, tail_len, max_new = 16, 4, 128, 8, 4
+        sys_prompts = [rng.integers(0, cfg.vocab_size,
+                                    size=sys_len).tolist()
+                       for _ in range(k_prompts)]
+        reqs = [sys_prompts[i % k_prompts]
+                + rng.integers(0, cfg.vocab_size, size=tail_len).tolist()
+                for i in range(n_req)]
+        prompt_tokens_total = n_req * (sys_len + tail_len)
+        blocks_per_prefix = sys_len // bs
+        per_req_blocks = -(-(sys_len + tail_len + max_new) // bs)
+        # the working set (K cached prefixes + one active request) must
+        # NOT fit: size the pool to about half the prefixes
+        kv_blocks_small = (blocks_per_prefix * (k_prompts // 2)
+                           + per_req_blocks + 1)
+
+        def build(tier):
+            pcfg = type(vcfg)(**vars(vcfg))
+            pcfg.enable_prefix_cache = True
+            pcfg.kv_blocks = kv_blocks_small
+            # cap concurrency BELOW the pool's deadlock regime: the
+            # scheduler admits chunk-by-chunk, so N concurrent partial
+            # prefills can exhaust the pool with none able to finish (a
+            # pre-existing sharp edge of KV-pressure serving, not a tier
+            # behavior — two sequences always fit this pool whole)
+            pcfg.max_ragged_sequence_count = 2
+            eng = InferenceEngineV2(engine.model, params=engine.params,
+                                    config=pcfg)
+            if tier:
+                eng.configure_kv_tier(True, host_bytes=256 << 20)
+            return eng
+
+        def run(tier, uid_base):
+            eng = build(tier)
+            sched = ContinuousBatchingScheduler(eng)
+            # pass 1 — sequential: compiles buckets, records greedy
+            # tokens for the parity check, and (tier on) warms the
+            # spill tier through the eviction churn
+            gens = []
+            for i, p in enumerate(reqs):
+                sched.submit(uid_base + i, p, max_new_tokens=max_new)
+                sched.run_to_completion()
+                gens.append(sched.finished[uid_base + i].generated)
+            stats0 = eng.prefix_stats()
+            tier0 = eng.tier_stats()
+            # pass 2 — measured repeat traffic: every prefix was seen
+            # before, but the pool can't hold them all — tier-off
+            # re-prefills what was evicted, tier-on restores it
+            t0, first = {}, {}
+
+            def on_token(uid, tok):
+                if uid not in first:
+                    first[uid] = time.perf_counter() - t0[uid]
+
+            for i, p in enumerate(reqs):
+                uid = uid_base + 1000 + i
+                t0[uid] = time.perf_counter()
+                sched.submit(uid, p, max_new_tokens=max_new,
+                             on_token=on_token)
+                sched.run_to_completion()
+                # pass-2 streams feed the parity check too: the
+                # restores being timed must ALSO be proven lossless
+                gens.append(sched.finished[uid].generated)
+            pstats = {k: v - stats0[k]
+                      for k, v in eng.prefix_stats().items()}
+            tstats = {k: eng.tier_stats().get(k, 0) - tier0.get(k, 0)
+                      for k in ("spilled", "restored", "dropped")}
+            return gens, sorted(first.values()), pstats, tstats
+
+        gens_off, ttft_off, pstats_off, _ = run(False, 150_000)
+        gens_on, ttft_on, pstats_on, tstats_on = run(True, 160_000)
+
+        # disabled-path byte parity through the frontend config surface:
+        # a kv_tier block with enabled=false must be byte-identical to a
+        # config that never heard of the block
+        def frontend_gens(kv_tier_block):
+            extra = ({"kv_tier": kv_tier_block}
+                     if kv_tier_block is not None else {})
+            scfg = ServingConfig(max_queue_depth=max(64, n_req),
+                                 prefix_cache={"enabled": True}, **extra)
+            fe = ServingFrontend([build(False)], scfg)
+            try:
+                handles = [fe.submit(p, max_new_tokens=max_new)
+                           for p in reqs]
+                assert fe.wait_all(handles, timeout=600)
+                return [[ev.token for ev in h.drain()] for h in handles]
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        gens_absent = frontend_gens(None)
+        gens_disabled = frontend_gens({"enabled": False})
+        disabled_parity = gens_disabled == gens_absent
+        assert tstats_on["restored"] > 0, \
+            "measured pass restored nothing — TTFT comparison is vacuous"
+        assert gens_on == gens_off, \
+            "KV tier restore broke greedy byte-parity"
+        assert disabled_parity, \
+            "kv_tier.enabled=false diverged from the tier-less stack"
+        pct = lambda xs, q: round(float(np.percentile(xs, q)) * 1e3, 2)  # noqa: E731
+        return {
+            "n_requests": n_req,
+            "k_prompts": k_prompts,
+            "prompt_len": sys_len + tail_len,
+            "kv_blocks": int(kv_blocks_small),
+            "blocks_per_prefix": int(blocks_per_prefix),
+            "tier_on_p50_ttft_ms": pct(ttft_on, 50),
+            "tier_on_p95_ttft_ms": pct(ttft_on, 95),
+            "tier_off_p50_ttft_ms": pct(ttft_off, 50),
+            "tier_off_p95_ttft_ms": pct(ttft_off, 95),
+            "ttft_improved": bool(pct(ttft_on, 50) < pct(ttft_off, 50)),
+            "blocks_spilled": int(tstats_on["spilled"]),
+            "blocks_restored": int(tstats_on["restored"]),
+            "blocks_dropped": int(tstats_on["dropped"]),
+            "prefix_hit_rate_on": round(pstats_on["tokens_saved"]
+                                        / prompt_tokens_total, 4),
+            "prefix_hit_rate_off": round(pstats_off["tokens_saved"]
+                                         / prompt_tokens_total, 4),
+            "prefill_tokens_saved_on": int(pstats_on["tokens_saved"]),
+            "prefill_tokens_saved_off": int(pstats_off["tokens_saved"]),
+            "greedy_parity": bool(gens_on == gens_off),
+            "disabled_parity": bool(disabled_parity),
+        }
+
     def run_slo_phase():
         """SLO observability phase (docs/OBSERVABILITY.md "SLOs and
         burn-rate alerts"): class-mixed traffic against a frontend with
@@ -1461,6 +1632,11 @@ def bench_serving(on_tpu: bool):
     # 2 decode vs 4 mixed — p95 interactive TTFT/TPOT on/off, handoff
     # count, byte-parity (handoff AND disabled-path, both asserted)
     result["disagg"] = runner.run("disagg", run_disagg_phase)
+    # tiered KV memory phase (docs/SERVING.md "KV tiering"): device pool
+    # sized below the shared-prefix working set — repeat-traffic TTFT
+    # and hit rate with host-RAM spillover on vs off, greedy parity and
+    # disabled byte-parity both asserted, restores asserted non-zero
+    result["kv_tier"] = runner.run("kv_tier", run_kv_tier_phase)
     # SLO observability phase (docs/OBSERVABILITY.md "SLOs and burn-rate
     # alerts"): injected latency fault trips the interactive burn-rate
     # alert and resolves after it clears (both transitions journaled),

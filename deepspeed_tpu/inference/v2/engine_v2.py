@@ -32,7 +32,11 @@ class RaggedInferenceEngineConfig:
                  prefix_cache_max_blocks: Optional[int] = None,
                  kv_quant_enabled: bool = False,
                  kv_quant_dtype: str = "int8",
-                 kv_quant_scale_granularity: str = "block"):
+                 kv_quant_scale_granularity: str = "block",
+                 kv_tier_enabled: bool = False,
+                 kv_tier_host_bytes: int = 64 * 1024 * 1024,
+                 kv_tier_disk_path: Optional[str] = None,
+                 kv_tier_disk_bytes: int = 0):
         self.max_ragged_batch_size = max_ragged_batch_size
         self.max_ragged_sequence_count = max_ragged_sequence_count
         self.max_chunk_tokens = max_chunk_tokens
@@ -49,6 +53,14 @@ class RaggedInferenceEngineConfig:
         self.kv_quant_enabled = kv_quant_enabled
         self.kv_quant_dtype = kv_quant_dtype
         self.kv_quant_scale_granularity = kv_quant_scale_granularity
+        # tiered KV memory (docs/SERVING.md "KV tiering"): spill evicted
+        # prefix-cache blocks to a bounded host-RAM tier (optionally
+        # overflowing to disk) and restore them on a later prefix match
+        # instead of re-prefilling — requires enable_prefix_cache
+        self.kv_tier_enabled = kv_tier_enabled
+        self.kv_tier_host_bytes = kv_tier_host_bytes
+        self.kv_tier_disk_path = kv_tier_disk_path
+        self.kv_tier_disk_bytes = kv_tier_disk_bytes
 
 
 class InferenceEngineV2:
@@ -126,7 +138,11 @@ class InferenceEngineV2:
             enable_prefix_cache=self.config.enable_prefix_cache,
             prefix_cache_max_blocks=self.config.prefix_cache_max_blocks,
             kv_quant=self.config.kv_quant_enabled,
-            scale_sharding=self._scale_sharding)
+            scale_sharding=self._scale_sharding,
+            kv_tier_enabled=self.config.kv_tier_enabled,
+            kv_tier_host_bytes=self.config.kv_tier_host_bytes,
+            kv_tier_disk_path=self.config.kv_tier_disk_path,
+            kv_tier_disk_bytes=self.config.kv_tier_disk_bytes)
 
     # ----------------------------------------------------------- admission
     def can_schedule(self, uids: Sequence[int],
@@ -291,6 +307,51 @@ class InferenceEngineV2:
         else:
             sm.clear_prefix_cache()
             sm.prefix_cache_enabled = False
+            if sm.kv_tier_enabled:
+                # the tier cannot outlive the cache it spills for
+                self.configure_kv_tier(False)
+
+    # ------------------------------------------------------------- KV tier
+    def configure_kv_tier(self, enabled: bool,
+                          host_bytes: Optional[int] = None,
+                          disk_path: Optional[str] = None,
+                          disk_bytes: Optional[int] = None) -> None:
+        """Toggle the tiered KV spillover on a built engine — the serving
+        layer's config-driven hook (``ServingConfig.kv_tier``; see
+        docs/SERVING.md "KV tiering"). Enabling requires the prefix
+        cache (spill/restore ride its eviction/match paths) and is safe
+        at any time — spilling starts with the next eviction. Disabling
+        drops every spilled entry (host and disk). ``None`` arguments
+        keep the config's current values — re-tuning the host bound
+        must not silently destroy a configured disk tier; pass
+        ``disk_bytes=0`` to explicitly drop one."""
+        host = (int(host_bytes) if host_bytes is not None
+                else self.config.kv_tier_host_bytes)
+        dpath = (disk_path if disk_path is not None
+                 else self.config.kv_tier_disk_path)
+        dbytes = (int(disk_bytes) if disk_bytes is not None
+                  else self.config.kv_tier_disk_bytes)
+        # build first, commit config after: a rejected configuration
+        # (prefix cache off) must not leave config claiming a tier the
+        # manager never built
+        self.state_manager.configure_kv_tier(
+            enabled, host_bytes=host, disk_path=dpath, disk_bytes=dbytes)
+        self.config.kv_tier_enabled = bool(enabled)
+        self.config.kv_tier_host_bytes = host
+        self.config.kv_tier_disk_path = dpath
+        self.config.kv_tier_disk_bytes = dbytes
+
+    def tier_stats(self) -> Dict[str, int]:
+        """Monotonic KV-tier counters (spilled/restored/dropped/...)
+        plus current host/disk residency; all zeros (same shape) when no
+        tier is configured — see :meth:`DSStateManager.tier_stats`."""
+        return self.state_manager.tier_stats()
+
+    def drain_restore_times(self) -> List[float]:
+        """Restore-dispatch wall times since the last drain — the
+        serving layer observes them into the ``kv_tier_restore_s``
+        histogram."""
+        return self.state_manager.drain_restore_times()
 
     def occupancy(self) -> Dict[str, int]:
         """KV-pool occupancy snapshot (blocks + bytes + evictable/

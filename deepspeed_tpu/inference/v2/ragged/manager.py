@@ -26,6 +26,7 @@ fail (or when ``max_cached_blocks`` is exceeded).
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -62,7 +63,11 @@ class DSStateManager:
                  dtype=None, sharding=None,
                  enable_prefix_cache: bool = False,
                  prefix_cache_max_blocks: Optional[int] = None,
-                 kv_quant: bool = False, scale_sharding=None):
+                 kv_quant: bool = False, scale_sharding=None,
+                 kv_tier_enabled: bool = False,
+                 kv_tier_host_bytes: int = 64 * 1024 * 1024,
+                 kv_tier_disk_path: Optional[str] = None,
+                 kv_tier_disk_bytes: int = 0):
         from ..kv_quant import kv_bytes_per_block
 
         self.cfg = model_cfg
@@ -94,6 +99,16 @@ class DSStateManager:
         #                           admission path reads it per candidate)
         self._stats = {"hits": 0, "misses": 0, "evictions": 0,
                        "tokens_saved": 0, "queries": 0}
+        # tiered KV memory (docs/SERVING.md "KV tiering"): host-RAM/disk
+        # spillover for evicted prefix-cache blocks with restore on
+        # match. None = the historical drop-on-evict path byte for byte.
+        self._tier = None
+        self._restore_times: List[float] = []   # drained by the serving
+        #                                         layer into kv_tier_restore_s
+        if kv_tier_enabled:
+            self.configure_kv_tier(True, host_bytes=kv_tier_host_bytes,
+                                   disk_path=kv_tier_disk_path,
+                                   disk_bytes=kv_tier_disk_bytes)
         dt = dtype or model_cfg.dtype
         # [L, NB, KH, bs, D]: the per-(block, kv-head) slab is the trailing
         # [bs, D] — one tileable VMEM block, DMA'd directly by the Pallas
@@ -363,6 +378,16 @@ class DSStateManager:
         occ = self.allocator.occupancy()
         occ["evictable_blocks"] = self.evictable_blocks
         occ["available_blocks"] = occ["free_blocks"] + occ["evictable_blocks"]
+        # per-tier residency (docs/SERVING.md "KV tiering"): zeros when
+        # no tier is configured, so the serving gauges and bench stamps
+        # have one schema either way
+        tier = (self._tier.occupancy() if self._tier is not None
+                else {"host_blocks": 0, "host_bytes": 0,
+                      "disk_blocks": 0, "disk_bytes": 0})
+        occ["kv_blocks_host_tier"] = tier["host_blocks"]
+        occ["kv_bytes_host_tier"] = tier["host_bytes"]
+        occ["kv_blocks_disk_tier"] = tier["disk_blocks"]
+        occ["kv_bytes_disk_tier"] = tier["disk_bytes"]
         return occ
 
     def prefix_stats(self) -> Dict[str, int]:
@@ -392,18 +417,31 @@ class DSStateManager:
         while n + self.block_size <= limit:
             key = (h, tuple(prompt_tokens[n:n + self.block_size]))
             b = self._index.get(key)
+            if b is None and self._tier is not None:
+                # tiered KV memory (docs/SERVING.md "KV tiering"): a
+                # device miss may be a spilled run — chain keys are
+                # computable from the prompt alone, so the whole
+                # contiguous spilled run restores in ONE batched
+                # scatter per pool tensor, then the walk re-reads the
+                # index and continues as if it had hit
+                if self._restore_chain(key, prompt_tokens, n, limit):
+                    b = self._index.get(key)
             if b is None:
                 self._stats["misses"] += 1
                 break
             self._index.move_to_end(key)     # LRU touch
             if self.allocator.ref_count(b) == 1:
                 self._evictable -= 1         # about to gain a sequence ref
+            # share NOW (not batched at the end): a tier restore later in
+            # this walk may trigger eviction, and an already-matched
+            # block held only by the cache's ref would be reclaimable —
+            # the sequence ref pins it for the rest of the walk
+            self.allocator.share([b])
             matched.append(b)
             h = hash(key)
             n += self.block_size
             self._stats["hits"] += 1
         if matched:
-            self.allocator.share(matched)
             seq.kv_blocks.extend(matched)
             seq.seen_tokens = n
             seq.chain_hash = h
@@ -453,8 +491,18 @@ class DSStateManager:
 
     def _evict(self, n: int) -> int:
         """Drop up to ``n`` LRU unreferenced cached blocks; returns how
-        many were evicted (their cache reference released → free list)."""
+        many were evicted (their cache reference released → free list).
+
+        With a KV tier configured (docs/SERVING.md "KV tiering") each
+        evicted block's slab bytes spill to the host tier under its
+        index key before the id returns to the free pool — safe even
+        though release precedes the copy, because JAX arrays are
+        immutable: the batched ``jnp.take`` below snapshots the pool
+        content as of this call, and nothing rewrites the pool until a
+        later forward. Only unreferenced full indexed blocks ever reach
+        this path, so a referenced or partial block can never spill."""
         evicted = 0
+        spill: List[tuple] = []         # (index key, block id)
         for key in list(self._index):
             if evicted >= n:
                 break
@@ -462,18 +510,180 @@ class DSStateManager:
             if self.allocator.ref_count(b) == 1:
                 del self._index[key]
                 del self._block_hash[b]
+                if self._tier is not None:
+                    spill.append((key, b))
                 self.allocator.release([b])
                 self._evictable -= 1
                 self._stats["evictions"] += 1
                 evicted += 1
+        if spill:
+            self._spill_blocks(spill)
         return evicted
+
+    # -- tiered KV memory (docs/SERVING.md "KV tiering") ---------------------
+    def configure_kv_tier(self, enabled: bool, host_bytes: int = 64 << 20,
+                          disk_path: Optional[str] = None,
+                          disk_bytes: int = 0) -> None:
+        """Build (or tear down) the host-RAM/disk spill tier behind the
+        prefix cache. Enabling requires the prefix cache — spill happens
+        at cache eviction and restore at match, so a tier without the
+        cache could never see a block. Disabling drops every spilled
+        entry (and its disk files); re-enabling starts empty."""
+        if self._tier is not None:
+            self._tier.close()
+            self._tier = None
+        self._restore_times.clear()
+        if not enabled:
+            return
+        if not self.prefix_cache_enabled:
+            raise ValueError(
+                "kv_tier requires the prefix cache: spill/restore happen "
+                "at prefix-cache eviction/match (enable prefix_cache "
+                "first)")
+        from ..kv_tier import TieredKVStore
+
+        self._tier = TieredKVStore(host_bytes, disk_path=disk_path,
+                                   disk_max_bytes=disk_bytes)
+
+    @property
+    def kv_tier_enabled(self) -> bool:
+        return self._tier is not None
+
+    def _spill_blocks(self, spill: List[tuple]) -> None:
+        """Copy evicted blocks' slabs device→host into the tier. One
+        batched gather per pool tensor with the host copies started
+        async for all slabs before any is materialized (the
+        export_sequence idiom), then one tier entry per block."""
+        ids = jnp.asarray([b for _, b in spill], dtype=jnp.int32)
+        arrs = {name: jnp.take(pool, ids, axis=1)
+                for name, pool in self.kv_cache.items()}
+        for a in arrs.values():
+            try:
+                a.copy_to_host_async()
+            except Exception:       # backend without async host copy
+                pass
+        host = {name: np.asarray(a) for name, a in arrs.items()}
+        for i, (key, _) in enumerate(spill):
+            self._tier.put(key, {name: host[name][:, i] for name in host})
+
+    def _restore_chain(self, first_key: tuple, prompt_tokens: Sequence[int],
+                       n: int, limit: int) -> int:
+        """Restore the contiguous spilled run starting at ``first_key``:
+        look the chain ahead (key ``i+1`` is ``hash(key_i)`` + the next
+        token block — computable from the prompt alone, no device data
+        needed), pop every consecutive tier entry, and scatter them all
+        back in ONE batched ``.at[:, ids].set`` per pool tensor — the
+        per-block dispatch overhead is what would otherwise eat the
+        saved prefill at small block sizes. The scatters are dispatched
+        asynchronously (JAX async dispatch): the call returns with the
+        copies in flight and the forward that later reads the pool
+        orders itself after them, so other requests' work overlaps the
+        restore. Each restored block re-registers under its original
+        key; blocks the pool has no room for are readmitted to the tier
+        (the match then degrades to a re-prefill from that point,
+        exactly the tier-less behavior). Returns how many blocks were
+        restored."""
+        bs = self.block_size
+        h, pos = first_key[0], n
+        # cap the lookahead at what the pool could possibly hold BEFORE
+        # popping anything: a chain longer than free+evictable would
+        # otherwise pop (and disk-read, CRC-check, then readmit and
+        # disk-REWRITE) a tail that can never fit — O(chain) disk churn
+        # per repeat request in exactly the pool-smaller-than-working-set
+        # regime the tier exists for
+        budget = self.allocator.free_blocks + self.evictable_blocks
+        if self.prefix_cache_max_blocks:
+            budget = min(budget,
+                         max(0, self.prefix_cache_max_blocks
+                             - len(self._index)) + self.evictable_blocks)
+        if budget <= 0:
+            if first_key in self._tier:
+                # the tier HAS the block but the pool can't take it:
+                # that is a miss the serving path experienced, even
+                # though nothing was popped
+                self._tier.stats["misses"] += 1
+            return 0
+        keys: List[tuple] = []
+        entries: List[Dict[str, np.ndarray]] = []
+        while pos + bs <= limit and len(entries) < budget:
+            key = (h, tuple(prompt_tokens[pos:pos + bs]))
+            if key in self._index:
+                break               # back in device: the walk takes over
+            entry = self._tier.get(key)
+            if entry is None:
+                break
+            keys.append(key)
+            entries.append(entry)
+            h = hash(key)
+            pos += bs
+        if not entries:
+            return 0
+        t0 = time.perf_counter()
+        m = len(entries)
+        short = m - self.allocator.free_blocks
+        if short > 0:
+            self._evict(short)      # colder residents spill to make room
+        m = min(m, self.allocator.free_blocks)
+        if self.prefix_cache_max_blocks:
+            allowed = self.prefix_cache_max_blocks - len(self._index)
+            if allowed < m:
+                self._evict(m - allowed)
+                allowed = self.prefix_cache_max_blocks - len(self._index)
+            m = min(m, max(0, allowed), self.allocator.free_blocks)
+        for key, entry in zip(keys[m:], entries[m:]):
+            # no room: keep them for a calmer moment (readmit keeps the
+            # tier's hit/miss/spill counters describing what happened)
+            self._tier.readmit(key, entry)
+        if m <= 0:
+            return 0
+        blocks = self.allocator.allocate(m)
+        ids = jnp.asarray(blocks, dtype=jnp.int32)
+        for name, pool in self.kv_cache.items():
+            stacked = np.stack([entries[i][name] for i in range(m)], axis=1)
+            self.kv_cache[name] = pool.at[:, ids].set(
+                jnp.asarray(stacked, dtype=pool.dtype))
+        for key, b in zip(keys[:m], blocks):
+            self._index[key] = b
+            self._block_hash[b] = key
+            self._evictable += 1    # only the cache's ref so far; the
+            #                         match hit path shares + decrements
+        self._tier.stats["restored"] += m
+        self._restore_times.append(time.perf_counter() - t0)
+        if len(self._restore_times) > 4096:     # bounded when undrained
+            del self._restore_times[:2048]
+        return m
+
+    def tier_stats(self) -> Dict[str, int]:
+        """Monotonic spill/restore/drop counters plus current host/disk
+        occupancy — all zeros (same shape) without a tier, so consumers
+        (replica delta publish, bench stamps) need no feature check."""
+        from ..kv_tier import empty_tier_stats
+
+        if self._tier is None:
+            return empty_tier_stats()
+        out = dict(self._tier.stats)
+        out.update(self._tier.occupancy())
+        return out
+
+    def drain_restore_times(self) -> List[float]:
+        """Wall-clock restore-batch dispatch durations (one per
+        contiguous restored run) since the last drain — the serving
+        layer observes them into ``kv_tier_restore_s``."""
+        out, self._restore_times = self._restore_times, []
+        return out
 
     def clear_prefix_cache(self) -> None:
         """Drop every index entry, releasing the cache's references.
         Blocks still shared by live sequences stay allocated until those
-        sequences flush; unreferenced ones return to the free list."""
+        sequences flush; unreferenced ones return to the free list. A
+        configured KV tier is emptied too (its entries are keyed by the
+        chain hashes this wipe invalidates only in spirit — content keys
+        stay valid — but a cleared cache should not keep shadow
+        residency in host RAM)."""
         for key, b in list(self._index.items()):
             self.allocator.release([b])
         self._index.clear()
         self._block_hash.clear()
         self._evictable = 0
+        if self._tier is not None:
+            self._tier.clear()

@@ -226,6 +226,13 @@ class ContinuousBatchingScheduler:
             new_candidates.append(self.pending.popleft())
         for req in candidates + new_candidates:
             if req.prefix_matched < 0:
+                # tiered KV memory (docs/SERVING.md "KV tiering"): count
+                # how many of this request's matched blocks came back
+                # from the host/disk tier — only when tracing, the extra
+                # stats read is off the default hot path
+                tier_fn = (getattr(self.engine, "tier_stats", None)
+                           if req.spans is not None else None)
+                restored0 = tier_fn()["restored"] if tier_fn else 0
                 req.prefix_matched = self.engine.match_prefix(
                     req.uid, req.prompt_tokens)
                 if req.prefix_matched > 0:
@@ -235,6 +242,10 @@ class ContinuousBatchingScheduler:
                     # this TTFT go" answer includes what was skipped
                     req.spans["prefill"].set("prefix_matched_tokens",
                                              req.prefix_matched)
+                    if tier_fn:
+                        req.spans["prefill"].set(
+                            "kv_tier_restored_blocks",
+                            tier_fn()["restored"] - restored0)
 
         def admit(req, chunk) -> bool:
             ok = self.engine.can_schedule(uids + [req.uid],

@@ -21,8 +21,9 @@ from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
 from .resilience import ResilienceConfig
-from ..serving.config import (KVQuantConfig, PrefixCacheConfig,
-                              ServingConfig, SpeculativeConfig)
+from ..serving.config import (KVQuantConfig, KVTierConfig,
+                              PrefixCacheConfig, ServingConfig,
+                              SpeculativeConfig)
 from ..telemetry.config import TelemetryConfig
 from ..utils.logging import logger
 
@@ -352,6 +353,9 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # int8 KV-cache quantization for the v2 ragged engine (docs/SERVING.md
     # "KV quantization"); also reachable as ``serving.kv_quant``
     kv_quant: KVQuantConfig = Field(default_factory=KVQuantConfig)
+    # tiered KV memory for the v2 ragged engine (docs/SERVING.md
+    # "KV tiering"); also reachable as ``serving.kv_tier``
+    kv_tier: KVTierConfig = Field(default_factory=KVTierConfig)
     # unified telemetry (docs/OBSERVABILITY.md): training step spans here;
     # serving request tracing via ``serving.telemetry``
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)

@@ -44,7 +44,25 @@ class AsyncTensorSwapper:
             self._lib.ds_aio_pwrite(self._handle, self._path(key).encode(),
                                     buf, array.nbytes, 0)
         else:
-            array.tofile(self._path(key))
+            # crash-safe sync fallback: temp file + flush/fsync + atomic
+            # rename (the runtime/checkpointing.py _atomic_write_text
+            # discipline) — a crash mid-write leaves either the old
+            # complete .swp or none, never a torn one a later swap_in
+            # would read back as garbage
+            path = self._path(key)
+            tmp = path + ".tmp"
+            try:
+                with open(tmp, "wb") as fh:
+                    array.tofile(fh)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
 
     def swap_in(self, key: str, array: np.ndarray) -> None:
         """Read from NVMe into ``array`` (async when native)."""

@@ -56,34 +56,65 @@ class _HostStore:
         self.device = device
         self.reads = 0
         self.writes = 0
+        self.flushes = 0
         self.bytes_read = 0
         self.read_keys: set = set()
         self._mem: Dict[str, np.ndarray] = {}
         self._shapes: Dict[str, tuple] = {}
+        # in-flight async swap_outs: (key, buffer) pairs kept ALIVE until
+        # flush() — the aio engine writes from the caller's memory, so
+        # dropping the array before wait() would hand it freed pages
+        self._pending: List[tuple] = []
         self.swapper = None
+        self._read_swapper = None
         if device == "nvme":
             if not nvme_path:
                 raise ValueError("offload_param.nvme_path required for NVMe")
             self.swapper = AsyncTensorSwapper(nvme_path)
+            # reads get their OWN aio handle: a read's completing wait()
+            # on a shared handle would drain every in-flight write too,
+            # re-serializing the writes the group-boundary batching just
+            # overlapped (the per-shard opt_m/opt_v reads interleave
+            # with the previous shard's writes)
+            self._read_swapper = AsyncTensorSwapper(nvme_path)
 
     def put(self, key: str, arr: np.ndarray):
+        """Queue one array for NVMe (async): the write is dispatched and
+        the buffer parked in ``_pending``; the single ``swapper.wait()``
+        happens at the group boundary (:meth:`flush`) so a group's N
+        writes overlap compute instead of each serializing against it.
+        The caller must not mutate ``arr`` until the next flush."""
         self.writes += 1
         if self.swapper is not None:
             self._shapes[key] = (arr.shape, arr.dtype)
-            self.swapper.swap_out(key, np.ascontiguousarray(arr))
-            self.swapper.wait()
+            buf = np.ascontiguousarray(arr)
+            self.swapper.swap_out(key, buf)
+            self._pending.append((key, buf))
         else:
             self._mem[key] = np.array(arr, copy=True)
+
+    def flush(self):
+        """Group-boundary barrier: one ``wait()`` for every in-flight
+        swap_out, then release the kept-alive buffers. No-op with
+        nothing pending (and on the host-RAM store)."""
+        self.flushes += 1
+        if self.swapper is not None and self._pending:
+            self.swapper.wait()
+        self._pending.clear()
 
     def get(self, key: str, out: Optional[np.ndarray] = None) -> np.ndarray:
         self.reads += 1
         self.read_keys.add(key)
         if self.swapper is not None:
+            if any(k == key for k, _ in self._pending):
+                # read-after-write: the file must be complete before the
+                # pread — settle every in-flight write first
+                self.flush()
             shape, dtype = self._shapes[key]
             buf = out if out is not None and out.shape == shape \
                 else np.empty(shape, dtype)
-            self.swapper.swap_in(key, buf)
-            self.swapper.wait()
+            self._read_swapper.swap_in(key, buf)
+            self._read_swapper.wait()
             self.bytes_read += buf.nbytes
             return buf
         arr = self._mem[key]
@@ -92,7 +123,10 @@ class _HostStore:
 
     def close(self):
         if self.swapper is not None:
+            self.flush()
             self.swapper.close()
+        if self._read_swapper is not None:
+            self._read_swapper.close()
 
 
 class ZeroInfinityEngine:
@@ -177,6 +211,10 @@ class ZeroInfinityEngine:
                     self.store.put(f"opt_m.{key}", np.zeros_like(piece))
                     self.store.put(f"opt_v.{key}", np.zeros_like(piece))
                 self.param_bytes += arr.nbytes
+                # per-leaf flush: async batching must not pin ~3x the
+                # whole model (param + both moments of EVERY leaf) in
+                # host RAM at once during init
+                self.store.flush()
         # Edge params (embed / final_norm / lm_head) stream through the
         # store like layer groups (r5 — the r4 design held them resident,
         # replicated fp32, with a dense host-Adam pass every step; for a
@@ -200,6 +238,8 @@ class ZeroInfinityEngine:
                     self.store.put(f"opt_v.{key}", np.zeros_like(piece))
                 self.param_bytes += arr.nbytes
                 self._edge_bytes += arr.nbytes
+                self.store.flush()          # per-leaf, as above
+        self.store.flush()          # settle any straggler init writes
         self.opt_step = 0
         self.global_steps = 0
         self._prefetch = concurrent.futures.ThreadPoolExecutor(1)
@@ -498,6 +538,10 @@ class ZeroInfinityEngine:
                 g = self._acc_shard(key, dev_grads[k][si], micro, last)
                 if g is not None:
                     self._opt_shard(key, master_arr, g / gas)
+        # group boundary: ONE wait for this group's N async NVMe writes
+        # (master + moments + acc shards) — the writes overlapped the
+        # optimizer math above instead of each serializing against it
+        self.store.flush()
 
     def _update_edges(self, host_edges, edge_grads, micro: int, gas: int):
         last = micro == gas - 1
@@ -508,6 +552,7 @@ class ZeroInfinityEngine:
                     g = self._acc_shard(key, g, micro, last)
                     if g is not None:
                         self._opt_shard(key, host_edges[grp][k][si], g / gas)
+        self.store.flush()          # edge-group boundary, same contract
 
     # ------------------------------------------------------------------ step
     def train_batch(self, batch) -> float:

@@ -17,8 +17,8 @@ from ..telemetry.slo import (AlertEngine, SLOClassTarget,  # noqa: F401
 from ..telemetry.windowed import WindowedMetrics  # noqa: F401
 from .config import (ClassPolicy, DisaggregationConfig,  # noqa: F401
                      FaultsConfig, FaultToleranceConfig, HandoffConfig,
-                     KVQuantConfig, PrefixCacheConfig, ServingConfig,
-                     SpeculativeConfig)
+                     KVQuantConfig, KVTierConfig, PrefixCacheConfig,
+                     ServingConfig, SpeculativeConfig)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .handoff import HandoffStager  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
@@ -48,6 +48,7 @@ def __getattr__(name):
 
 
 __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
+           "KVTierConfig",
            "SpeculativeConfig", "ClassPolicy", "DisaggregationConfig",
            "HandoffConfig", "HandoffStager",
            "FaultToleranceConfig", "FaultsConfig", "FaultInjector",

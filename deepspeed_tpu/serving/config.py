@@ -62,6 +62,38 @@ class KVQuantConfig(DSConfigModel):
         engine_config.kv_quant_scale_granularity = self.scale_granularity
 
 
+class KVTierConfig(DSConfigModel):
+    """``kv_tier: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "KV tiering"): host-RAM (and optional disk) spillover for evicted
+    prefix-cache KV blocks with async restore on a later prefix match —
+    the ZeRO-Infinity memory-tier treatment applied to the serving KV
+    cache (PAPERS.md: arxiv 2104.07857, 2101.06840). Requires
+    ``prefix_cache.enabled`` (spill/restore ride its eviction/match
+    paths). Under ``kv_quant`` the spilled bytes are the int8 slabs +
+    scale entries, so spill bandwidth rides the 4x compression. Mounted
+    on both :class:`ServingConfig` and ``DeepSpeedTpuConfig``; disabled
+    (the default) keeps the drop-on-evict prefix cache byte for byte."""
+
+    enabled: bool = False
+    # host-RAM tier byte bound; LRU entries past it demote to the disk
+    # tier (when configured) or drop
+    host_max_bytes: int = 64 * 1024 * 1024
+    # optional disk tier (runtime/swap_tensor AsyncTensorSwapper): one
+    # CRC-checked file per spilled block under disk_path, bounded by
+    # disk_max_bytes (both must be set for the tier to exist; a corrupt
+    # file reads back as a miss — re-prefill, never a crash)
+    disk_path: Optional[str] = None
+    disk_max_bytes: int = 0
+
+    def apply(self, engine_config) -> None:
+        """Stamp these settings onto a ``RaggedInferenceEngineConfig``
+        (the engine-factory hook for config-driven serving)."""
+        engine_config.kv_tier_enabled = self.enabled
+        engine_config.kv_tier_host_bytes = self.host_max_bytes
+        engine_config.kv_tier_disk_path = self.disk_path
+        engine_config.kv_tier_disk_bytes = self.disk_max_bytes
+
+
 class SpeculativeConfig(DSConfigModel):
     """``speculative: {...}`` block (docs/CONFIG.md, docs/SERVING.md
     "Speculative decoding"): greedy-lossless speculative decoding in the
@@ -268,6 +300,10 @@ class ServingConfig(DSConfigModel):
     # int8 KV-cache quantization (engine-level; ``ServingFrontend``
     # applies it per replica engine before traffic)
     kv_quant: KVQuantConfig = Field(default_factory=KVQuantConfig)
+    # tiered KV memory (engine-level; requires prefix_cache.enabled):
+    # spill evicted prefix-cache blocks to host RAM/disk, restore on
+    # match (docs/SERVING.md "KV tiering")
+    kv_tier: KVTierConfig = Field(default_factory=KVTierConfig)
     # speculative decoding (scheduler-level; applied per replica)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     # unified telemetry: request tracing + flight recorder

@@ -75,6 +75,12 @@ class ServingFrontend:
         self.windowed = WindowedMetrics(self.metrics,
                                         bucket_s=slo.window_bucket_s,
                                         history_s=slo.window_history_s)
+        # KV-tier pressure journaling state (docs/SERVING.md "KV
+        # tiering"): per-replica-slot counter baselines as of the last
+        # EMITTED event + the ~1/s cadence gate — must exist before the
+        # router tick can fire
+        self._tier_journal_t = 0.0
+        self._tier_last: dict = {}
         self.alerts = None
         if slo.enabled:
             self.alerts = AlertEngine(slo, self.windowed,
@@ -194,6 +200,19 @@ class ServingFrontend:
                 configure(True,
                           self.config.prefix_cache.max_cached_blocks
                           or None)
+        if self.config.kv_tier.enabled:
+            # tiered KV memory (docs/SERVING.md "KV tiering"): applied
+            # AFTER the prefix cache (the tier requires it — the engine
+            # raises on a config that enables the tier without the
+            # cache, a misconfiguration better caught at boot than as a
+            # silent never-spills tier). Engines the caller tiered
+            # directly are left alone when the block is off.
+            configure = getattr(engine, "configure_kv_tier", None)
+            if configure is not None:
+                kt = self.config.kv_tier
+                configure(True, host_bytes=kt.host_max_bytes,
+                          disk_path=kt.disk_path,
+                          disk_bytes=kt.disk_max_bytes)
         ft = self.config.fault_tolerance
         role = self._role_of(replica_id)
         return Replica(replica_id, engine, self.metrics, self._sample_fn,
@@ -449,6 +468,59 @@ class ServingFrontend:
         self.windowed.maybe_tick()
         if self.alerts is not None:
             self.alerts.maybe_evaluate()
+        self._maybe_journal_tier_pressure()
+
+    def _maybe_journal_tier_pressure(self) -> None:
+        """Journal a ``kv_tier_pressure`` event when the fleet's KV tier
+        churned since the last EMITTED event (spills or drops — the
+        signals that the device pool is too small for the working set
+        and, on drops, that the tier itself is too). Cadence-gated to
+        ~1/s; silent while the tier is idle or absent.
+
+        Deltas are per replica SLOT against the slot's last-emitted
+        baseline, with Prometheus-style reset detection (a counter
+        below its baseline means the supervisor swapped in a fresh
+        engine — baseline drops to zero, not negative deltas), and the
+        baselines advance only when an event is emitted — restores that
+        happen in quiet windows are carried into the next event instead
+        of being silently absorbed."""
+        now = time.monotonic()
+        if now - self._tier_journal_t < 1.0:
+            return
+        self._tier_journal_t = now
+        deltas = {"spilled": 0, "restored": 0, "dropped": 0}
+        host_bytes = 0
+        current: dict = {}
+        found = False
+        for rep in self.router.replicas:
+            fn = getattr(getattr(rep, "engine", None), "tier_stats", None)
+            if fn is None:
+                continue
+            try:
+                t = fn()
+            except Exception:
+                continue
+            found = True
+            slot = getattr(rep, "replica_id", id(rep))
+            base = self._tier_last.get(slot)
+            if base is None or any(t.get(k, 0) < base[k] for k in deltas):
+                base = {k: 0 for k in deltas}    # fresh engine: reset
+            for k in deltas:
+                deltas[k] += t.get(k, 0) - base[k]
+            current[slot] = {k: t.get(k, 0) for k in deltas}
+            host_bytes += t.get("host_bytes", 0)
+        if not found:
+            return
+        if deltas["spilled"] > 0 or deltas["dropped"] > 0:
+            self.journal.emit("kv_tier_pressure",
+                              spilled=deltas["spilled"],
+                              restored=deltas["restored"],
+                              dropped=deltas["dropped"],
+                              host_bytes=int(host_bytes))
+            # MERGE, don't replace: a slot whose stats read transiently
+            # failed this tick must keep its baseline, or its lifetime
+            # totals would re-emit as a phantom burst next tick
+            self._tier_last.update(current)
 
     def _refresh_kv_gauges(self) -> None:
         """Sum KV-pool occupancy over the fleet into the
@@ -457,6 +529,7 @@ class ServingFrontend:
         replica from ``engine.occupancy()`` — the single snapshot that
         replaced the ad-hoc block counts (BlockedAllocator.occupancy)."""
         blocks = total_bytes = 0
+        host_blocks = host_bytes = disk_blocks = disk_bytes = 0
         role_blocks: dict = {}
         found = False
         for rep in self.router.replicas:
@@ -470,12 +543,22 @@ class ServingFrontend:
             found = True
             blocks += occ.get("in_use_blocks", 0)
             total_bytes += occ.get("bytes_in_use", 0)
+            # tiered KV residency (docs/SERVING.md "KV tiering"); zero
+            # on engines without a tier — same occupancy schema
+            host_blocks += occ.get("kv_blocks_host_tier", 0)
+            host_bytes += occ.get("kv_bytes_host_tier", 0)
+            disk_blocks += occ.get("kv_blocks_disk_tier", 0)
+            disk_bytes += occ.get("kv_bytes_disk_tier", 0)
             role = getattr(rep, "role", "mixed")
             role_blocks[role] = (role_blocks.get(role, 0)
                                  + occ.get("in_use_blocks", 0))
         if found:
             self.metrics.gauge("kv_blocks_in_use").set(blocks)
             self.metrics.gauge("kv_bytes_in_use").set(total_bytes)
+            self.metrics.gauge("kv_blocks_host_tier").set(host_blocks)
+            self.metrics.gauge("kv_blocks_disk_tier").set(disk_blocks)
+            self.metrics.gauge("kv_tier_bytes_host").set(host_bytes)
+            self.metrics.gauge("kv_tier_bytes_disk").set(disk_bytes)
             # per-role split (docs/SERVING.md "Disaggregated serving"):
             # handoff pressure — decode pools filling while prefill
             # pools stay light — is visible in flight-recorder metric
@@ -520,7 +603,8 @@ class ServingFrontend:
         self.windowed.tick()
         snap = self.metrics.snapshot()
         classes = sorted(self.config.classes)
-        hist_names = (["ttft_s", "tpot_s", "queue_wait_s"]
+        hist_names = (["ttft_s", "tpot_s", "queue_wait_s",
+                       "kv_tier_restore_s"]
                       + [f"ttft_s_class_{c}" for c in classes]
                       + [f"tpot_s_class_{c}" for c in classes])
         report = {
@@ -540,6 +624,9 @@ class ServingFrontend:
             "occupancy": {
                 "kv_blocks_in_use": snap.get("kv_blocks_in_use", 0.0),
                 "kv_bytes_in_use": snap.get("kv_bytes_in_use", 0.0),
+                "kv_blocks_host_tier": snap.get("kv_blocks_host_tier", 0.0),
+                "kv_tier_bytes_host": snap.get("kv_tier_bytes_host", 0.0),
+                "kv_tier_bytes_disk": snap.get("kv_tier_bytes_disk", 0.0),
                 "handoff_staged": snap.get("handoff_staged", 0.0),
                 "outstanding_tokens": snap.get("outstanding_tokens", 0.0),
             },

@@ -369,7 +369,14 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # degraded to re-prefill (export/import failure or a full
               # staging buffer)
               "handoffs_started", "handoffs_completed",
-              "handoff_fallbacks"):
+              "handoff_fallbacks",
+              # tiered KV memory (docs/SERVING.md "KV tiering"):
+              # spilled = evicted prefix blocks copied into the host
+              # tier; restored = tier hits scattered back into device
+              # pools on a prefix match; dropped = blocks that fell out
+              # of the tier entirely (byte bounds / corrupt disk entry)
+              "kv_tier_blocks_spilled", "kv_tier_blocks_restored",
+              "kv_tier_blocks_dropped"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -393,12 +400,19 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # KV-pool occupancy summed over the fleet from
               # ``engine.occupancy()`` (docs/SERVING.md "KV
               # quantization"): bytes shrink ~2x per block under kv_quant
-              "kv_blocks_in_use", "kv_bytes_in_use"):
+              "kv_blocks_in_use", "kv_bytes_in_use",
+              # tiered KV memory residency, fleet-summed from the same
+              # occupancy snapshot (docs/SERVING.md "KV tiering")
+              "kv_blocks_host_tier", "kv_blocks_disk_tier",
+              "kv_tier_bytes_host", "kv_tier_bytes_disk"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md
               # "Disaggregated serving")
-              "handoff_s"):
+              "handoff_s",
+              # host→device restore-batch dispatch time, one sample per
+              # contiguous restored run (docs/SERVING.md "KV tiering")
+              "kv_tier_restore_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
     # per-class series (docs/SERVING.md "Disaggregated serving",
     # docs/OBSERVABILITY.md "SLOs and burn-rate alerts"): latency splits,

@@ -148,6 +148,7 @@ class Replica:
         # across replicas)
         self._prefix_last: Dict[str, int] = {}
         self._spec_last: Dict[str, int] = {}
+        self._tier_last: Dict[str, int] = {}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"serving-replica-{replica_id}")
 
@@ -461,6 +462,9 @@ class Replica:
                       ("accepted", "spec_tokens_accepted"),
                       ("emitted", "spec_tokens_emitted"),
                       ("decode_rows", "spec_decode_forwards"))
+    _TIER_COUNTERS = (("spilled", "kv_tier_blocks_spilled"),
+                      ("restored", "kv_tier_blocks_restored"),
+                      ("dropped", "kv_tier_blocks_dropped"))
 
     def _publish_prefix_stats(self) -> None:
         """Forward the engine's monotonic prefix-cache counters (and the
@@ -488,6 +492,20 @@ class Replica:
             if delta:
                 self.metrics.counter(name).inc(delta)
         self._spec_last = sstats
+        # tiered KV memory (docs/SERVING.md "KV tiering"): spill/restore
+        # counters as deltas, per-block restore times into the histogram
+        tier_fn = getattr(self.engine, "tier_stats", None)
+        if tier_fn is not None:
+            tstats = tier_fn()
+            for key, name in self._TIER_COUNTERS:
+                delta = tstats.get(key, 0) - self._tier_last.get(key, 0)
+                if delta > 0:
+                    self.metrics.counter(name).inc(delta)
+            self._tier_last = tstats
+        drain = getattr(self.engine, "drain_restore_times", None)
+        if drain is not None:
+            for dt in drain():
+                self.metrics.histogram("kv_tier_restore_s").observe(dt)
 
     def _enforce_slo(self) -> None:
         """Cancel/expire active requests; scheduler.cancel frees their KV

@@ -64,6 +64,11 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     "alert_firing": frozenset({"alert", "request_class", "slo_kind",
                                "burn_fast", "burn_slow"}),
     "alert_resolved": frozenset({"alert", "firing_s"}),
+    # tiered KV memory (docs/SERVING.md "KV tiering"): the fleet's
+    # prefix-cache spill tier churned since the last ~1s look — deltas
+    # of blocks spilled/restored/dropped plus current host residency
+    "kv_tier_pressure": frozenset({"spilled", "restored", "dropped",
+                                   "host_bytes"}),
     # ----------------------------------------------------------- training
     # supervised restart (docs/TRAINING.md "Fault tolerance")
     "train_restart": frozenset({"reason", "attempt", "steps_lost",

@@ -42,12 +42,18 @@ _LOWER_IS_BETTER = (
     "steps_lost", "overhead", "shed_rate", "ppl",
     "loss", "fallbacks", "expired", "recovery", "_pct", "save_s",
     "fire_to_resolve",
+    # kv_tier phase: blocks that fell out of the spill tier entirely
+    # (byte bounds / disk corruption) — fewer is better
+    "blocks_dropped",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
     "tflops", "hit_rate", "acceptance_rate", "concurrency",
     "max_concurrent", "vs_baseline", "coverage", "success_rate",
     "tokens_generated", "decode_tokens", "value",
+    # kv_tier phase: restored blocks are prefills NOT re-run and saved
+    # prefill tokens are the tier's whole point — fewer is a regression
+    "blocks_restored", "tokens_saved",
 )
 
 

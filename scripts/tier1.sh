@@ -9,12 +9,17 @@
 #
 # Env knobs:
 #   TIER1_LOG      log path (default /tmp/_t1.log)
-#   TIER1_TIMEOUT  whole-run timeout in seconds (default 1200; raised
-#                  from 870 when the train chaos suite joined tier-1)
+#   TIER1_TIMEOUT  whole-run timeout in seconds (default 1800; raised
+#                  from 1200 when the kv_tier suite joined tier-1 — the
+#                  1200s bound started binding at the suite tail)
 #   TIER1_ARGS     extra pytest args (e.g. "-k spec")
 #   TIER1_PHASE    run ONE named serving bench phase as a smoke instead
 #                  of the test suite (e.g. TIER1_PHASE=kv_quant,
 #                  TIER1_PHASE=disagg for disaggregated prefill/decode,
+#                  TIER1_PHASE=kv_tier for tiered KV memory — device
+#                  pool sized below the prefix working set; tier-on must
+#                  restore spilled blocks with greedy parity and
+#                  disabled byte-parity asserted,
 #                  or TIER1_PHASE=slo for the SLO burn-rate-alerting
 #                  phase — injected latency fault must fire AND resolve
 #                  the interactive alert, with journal/alert schema
@@ -35,9 +40,9 @@ cd "$(dirname "$0")/.."
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 if [ -n "${TIER1_PHASE:-}" ]; then
-    timeout -k 10 "${TIER1_TIMEOUT:-1200}" env JAX_PLATFORMS=cpu \
+    timeout -k 10 "${TIER1_TIMEOUT:-1800}" env JAX_PLATFORMS=cpu \
         BENCH_SERVING_ONLY=1 BENCH_PHASES="$TIER1_PHASE" \
-        BENCH_TIMEOUT_S="${TIER1_TIMEOUT:-1200}" \
+        BENCH_TIMEOUT_S="${TIER1_TIMEOUT:-1800}" \
         python bench.py 2>&1 | tee "$LOG"
     rc=${PIPESTATUS[0]}
     echo "DOTS_PASSED=0"   # smoke mode: no pytest dots, exit code is truth
@@ -47,7 +52,7 @@ TARGET="tests/"
 if [ -n "${TIER1_CHAOS_TRAIN:-}" ] && [ "${TIER1_CHAOS_TRAIN}" != "0" ]; then
     TARGET="tests/test_train_resilience.py"
 fi
-timeout -k 10 "${TIER1_TIMEOUT:-1200}" env JAX_PLATFORMS=cpu \
+timeout -k 10 "${TIER1_TIMEOUT:-1800}" env JAX_PLATFORMS=cpu \
     python -m pytest "$TARGET" -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee "$LOG"

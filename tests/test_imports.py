@@ -18,6 +18,7 @@ MODULES = [
     "deepspeed_tpu.inference.quantization",
     "deepspeed_tpu.inference.v2.engine_v2",
     "deepspeed_tpu.inference.v2.kv_quant",
+    "deepspeed_tpu.inference.v2.kv_tier",
     "deepspeed_tpu.inference.v2.paged_model",
     "deepspeed_tpu.inference.v2.ragged.blocked_allocator",
     "deepspeed_tpu.inference.v2.scheduler",

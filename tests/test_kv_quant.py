@@ -463,9 +463,18 @@ def test_bench_schema_validator():
                          "mean_matched_prefix_frac": 1.0,
                          "disabled_parity": True, "kv_occupancy": occ}}
     for name in bench._STAMPED_PHASES:
-        if name in ("kv_quant", "train_chaos", "disagg", "slo"):
+        if name in ("kv_quant", "train_chaos", "disagg", "slo",
+                    "kv_tier"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
+    good["kv_tier"] = {"tier_on_p50_ttft_ms": 10.7,
+                       "tier_off_p50_ttft_ms": 14.1,
+                       "ttft_improved": True, "blocks_spilled": 64,
+                       "blocks_restored": 64, "blocks_dropped": 0,
+                       "prefix_hit_rate_on": 0.89,
+                       "prefix_hit_rate_off": 0.0,
+                       "greedy_parity": True, "disabled_parity": True,
+                       "kv_occupancy": dict(occ)}
     good["slo"] = {"alert_fired": True, "alert_resolved": True,
                    "fire_to_resolve_s": 4.9, "alerts_firing_peak": 1,
                    "alerts_firing_final": 0, "window_p95_ttft_ms": 12.5,
@@ -494,6 +503,14 @@ def test_bench_schema_validator():
     assert any("disagg.handoffs_completed" in p for p in problems_dg)
     assert any("disagg.handoff_parity" in p for p in problems_dg)
     assert any("disagg.disabled_parity: missing" in p for p in problems_dg)
+    # kv_tier typed checks: missing and mistyped (bool-for-int) named
+    bad_kt = dict(good)
+    bad_kt["kv_tier"] = {"blocks_restored": True, "greedy_parity": 1}
+    problems_kt = bench.validate_serving_schema(bad_kt)
+    assert any("kv_tier.blocks_restored" in p for p in problems_kt)
+    assert any("kv_tier.greedy_parity" in p for p in problems_kt)
+    assert any("kv_tier.disabled_parity: missing" in p
+               for p in problems_kt)
     # skipped phases are exempt from field checks
     skipped = dict(good)
     skipped["chaos"] = {"phase_skipped": "phase budget 240s exceeded"}

@@ -74,6 +74,45 @@ def test_cpu_adagrad_and_lion_run():
         assert not np.allclose(params, p)
 
 
+def test_sync_fallback_roundtrip_and_no_temp_left(tmp_path):
+    """The no-native sync path (.tofile fallback) must round-trip and
+    leave no .tmp droppings — the write goes temp + fsync + os.replace
+    (the checkpointing.py atomic-write discipline)."""
+    sw = AsyncTensorSwapper(str(tmp_path), n_threads=1)
+    sw.close()                  # drops the native handle -> sync path
+    arr = np.arange(257, dtype=np.float32)
+    sw.swap_out("sync_key", arr)
+    sw.wait()                   # no-op on the sync path
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    buf = np.empty_like(arr)
+    sw.swap_in("sync_key", buf)
+    np.testing.assert_array_equal(buf, arr)
+
+
+def test_sync_fallback_write_is_atomic(tmp_path, monkeypatch):
+    """A failed sync swap_out must never tear the destination: the old
+    complete .swp survives (os.replace is the only publication step) and
+    the temp file is cleaned up."""
+    sw = AsyncTensorSwapper(str(tmp_path), n_threads=1)
+    sw.close()
+    old = np.full(64, 7.0, np.float32)
+    sw.swap_out("k", old)
+    new = np.full(64, 9.0, np.float32)
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("simulated crash at publication")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="simulated crash"):
+        sw.swap_out("k", new)
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    buf = np.empty_like(old)
+    sw.swap_in("k", buf)
+    np.testing.assert_array_equal(buf, old)     # old content intact
+
+
 def test_aio_roundtrip(tmp_path):
     sw = AsyncTensorSwapper(str(tmp_path), n_threads=2)
     assert sw.has_native

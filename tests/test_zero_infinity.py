@@ -298,3 +298,110 @@ def test_gas_on_mesh_converges(tmp_path):
               for _ in range(6)]
     assert losses[-1] < losses[0] - 0.3, f"no convergence: {losses}"
     engine.close()
+
+
+# ------------------------------------------------- async host-store batching
+class _RecordingSwapper:
+    """Fake aio engine that models asynchrony honestly: a swap_out is
+    only readable after wait() commits it — so a get() that skipped the
+    read-after-write flush would blow up, and the counters prove how
+    many waits the store actually paid."""
+
+    def __init__(self):
+        self.in_flight = {}
+        self.committed = {}
+        self.write_calls = 0
+        self.wait_calls = 0
+
+    def swap_out(self, key, array):
+        self.write_calls += 1
+        self.in_flight[key] = array          # NOT copied: aio reads the
+        #                                      caller's memory at wait time
+
+    def swap_in(self, key, array):
+        if key not in self.committed:
+            raise IOError(f"read of uncommitted key {key!r} — a write "
+                          "was not waited on before the read")
+        array[...] = self.committed[key]
+
+    def wait(self):
+        self.wait_calls += 1
+        for k, a in self.in_flight.items():
+            self.committed[k] = np.array(a, copy=True)
+        self.in_flight.clear()
+
+    def close(self):
+        pass
+
+
+def test_host_store_put_batches_waits(tmp_path):
+    """The ISSUE 10 satellite: ``_HostStore.put`` must NOT wait per
+    write (that serializes every NVMe write with compute) — writes stay
+    in flight, buffers stay alive, and ONE flush() at the group boundary
+    settles them all."""
+    from deepspeed_tpu.runtime.zero_infinity import _HostStore
+
+    store = _HostStore("nvme", str(tmp_path / "swap"), 1)
+    store.swapper.close()
+    store._read_swapper.close()
+    fake = _RecordingSwapper()
+    store.swapper = fake
+    store._read_swapper = fake
+    arrs = [np.full(32, float(i), np.float32) for i in range(3)]
+    for i, a in enumerate(arrs):
+        store.put(f"k{i}", a)
+    # three writes dispatched, ZERO waits paid — they overlap compute
+    assert fake.write_calls == 3
+    assert fake.wait_calls == 0
+    assert len(store._pending) == 3          # buffers kept alive
+    store.flush()
+    assert fake.wait_calls == 1              # one wait for the batch
+    assert not store._pending
+    got = store.get("k1")
+    np.testing.assert_array_equal(got, arrs[1])
+
+
+def test_host_store_get_flushes_pending_write(tmp_path):
+    """Read-after-write inside a group: get() of a key with an in-flight
+    swap_out must flush first (the file is not complete until the wait)
+    — the fake swapper raises if the store ever skips that."""
+    from deepspeed_tpu.runtime.zero_infinity import _HostStore
+
+    store = _HostStore("nvme", str(tmp_path / "swap"), 1)
+    store.swapper.close()
+    store._read_swapper.close()
+    fake = _RecordingSwapper()
+    store.swapper = fake
+    store._read_swapper = fake
+    arr = np.arange(16, dtype=np.float32)
+    store.put("acc.x", arr)
+    assert fake.wait_calls == 0
+    got = store.get("acc.x")                 # would raise without flush
+    np.testing.assert_array_equal(got, arr)
+    assert fake.wait_calls >= 1
+
+
+def test_host_store_reads_do_not_drain_in_flight_writes(tmp_path):
+    """Reads use their OWN aio handle: a get() of a non-pending key must
+    not wait on in-flight writes (a shared handle's wait() would drain
+    them, re-serializing exactly what the group-boundary batching
+    overlapped)."""
+    from deepspeed_tpu.runtime.zero_infinity import _HostStore
+
+    store = _HostStore("nvme", str(tmp_path / "swap"), 1)
+    store.swapper.close()
+    store._read_swapper.close()
+    writes, reads = _RecordingSwapper(), _RecordingSwapper()
+    reads.committed = writes.committed      # same files on disk
+    store.swapper, store._read_swapper = writes, reads
+    a = np.full(8, 1.0, np.float32)
+    store.put("a", a)
+    store.flush()                           # "a" durable
+    b = np.full(8, 2.0, np.float32)
+    store.put("b", b)                       # in flight on the write handle
+    got = store.get("a")                    # non-pending key
+    np.testing.assert_array_equal(got, a)
+    assert writes.wait_calls == 1           # the read drained NOTHING
+    assert len(store._pending) == 1         # "b" still overlapping
+    store.flush()
+    assert writes.wait_calls == 2
