@@ -255,7 +255,7 @@ _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("disabled_parity", bool))
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
-                   "disagg", "slo", "kv_tier")
+                   "disagg", "slo", "kv_tier", "overload")
 # Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
 # TTFT comparison with the device pool sized below the prefix working
 # set, spill/restore counts, and the parity bits the acceptance gates
@@ -280,6 +280,29 @@ _DISAGG_KEYS = (("handoffs_completed", int),
                 ("disabled_parity", bool),
                 ("replicas", int),
                 ("decode_reserve_tokens", int))
+# Typed shape of the overload phase (docs/SERVING.md "Admission and
+# preemption"): sustained ~10x KV overload with reservation admission +
+# preemptive spill — zero wedges, completed-sequence throughput vs the
+# pre-change stack, interactive tail latency, and the parity bits
+# (preempted-and-resumed greedy streams + disabled byte-parity) the
+# acceptance gates read.
+_OVERLOAD_KEYS = (("n_requests", int),
+                  ("kv_blocks", int),
+                  ("overload_ratio", (int, float)),
+                  ("oversubscription_factor", (int, float)),
+                  ("zero_wedges", bool),
+                  ("completed_on", int),
+                  ("completed_off", int),
+                  ("completed_per_sec_on", (int, float)),
+                  ("completed_per_sec_off", (int, float)),
+                  ("sequences_preempted", int),
+                  ("sequences_resumed", int),
+                  ("p95_interactive_ttft_ms", (int, float)),
+                  ("p99_interactive_ttft_ms", (int, float)),
+                  ("p95_interactive_tpot_ms", (int, float)),
+                  ("p99_interactive_tpot_ms", (int, float)),
+                  ("preempt_parity", bool),
+                  ("disabled_parity", bool))
 # Typed shape of the slo phase (docs/OBSERVABILITY.md "SLOs and
 # burn-rate alerts"): the alert fire/resolve transitions, the
 # window-vs-cumulative quantile agreement, the overhead-vs-noise-floor
@@ -353,6 +376,11 @@ def validate_serving_schema(serving: dict):
         problems.append("kv_tier: missing or not an object")
     elif "phase_skipped" not in kt:
         _check_typed_phase("kv_tier", kt, _KV_TIER_KEYS, problems)
+    ov = serving.get("overload")
+    if not isinstance(ov, dict):
+        problems.append("overload: missing or not an object")
+    elif "phase_skipped" not in ov:
+        _check_typed_phase("overload", ov, _OVERLOAD_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -1161,12 +1189,10 @@ def bench_serving(on_tpu: bool):
             pcfg = type(vcfg)(**vars(vcfg))
             pcfg.enable_prefix_cache = True
             pcfg.kv_blocks = kv_blocks_small
-            # cap concurrency BELOW the pool's deadlock regime: the
-            # scheduler admits chunk-by-chunk, so N concurrent partial
-            # prefills can exhaust the pool with none able to finish (a
-            # pre-existing sharp edge of KV-pressure serving, not a tier
-            # behavior — two sequences always fit this pool whole)
-            pcfg.max_ragged_sequence_count = 2
+            # reservation admission (docs/SERVING.md "Admission and
+            # preemption") makes small-pool concurrency safe — no need
+            # to size max_ragged_sequence_count below the pool anymore
+            pcfg.admission_reservation = True
             eng = InferenceEngineV2(engine.model, params=engine.params,
                                     config=pcfg)
             if tier:
@@ -1261,6 +1287,166 @@ def bench_serving(on_tpu: bool):
             "prefill_tokens_saved_on": int(pstats_on["tokens_saved"]),
             "prefill_tokens_saved_off": int(pstats_off["tokens_saved"]),
             "greedy_parity": bool(gens_on == gens_off),
+            "disabled_parity": bool(disabled_parity),
+        }
+
+    def run_overload_phase():
+        """Reservation-aware admission + preemptive KV spill under
+        sustained overload (docs/SERVING.md "Admission and
+        preemption"): a burst whose aggregate KV demand is ~10x the
+        device pool, batch + interactive mixed. Admission ON
+        (reservation + preemption, oversubscription_factor > 1): every
+        request completes — zero wedges — with batch victims spilled to
+        the KV tier for the interactive burst and resumed later, greedy
+        streams byte-identical to an uncontended run (preempted ones
+        included). Admission OFF (the pre-change stack): the same
+        traffic part-prefills the pool into the chunked-admission
+        deadlock within a bounded wait. Also asserts the all-default
+        ``admission`` block is byte-for-byte a config without it."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+        from deepspeed_tpu.serving import ServingConfig, ServingFrontend
+
+        bs = vcfg.kv_block_size
+        if on_tpu:
+            n_int, n_batch = 20, 14
+            int_plen, batch_plen = 256, 256
+            int_new, batch_new = 32, 192
+            kv_small, factor, max_seqs = 18, 2.5, 16
+            off_wait_s = 40.0
+        else:
+            n_int, n_batch = 14, 10
+            int_plen, batch_plen = 40, 40
+            int_new, batch_new = 8, 24
+            kv_small, factor, max_seqs = 8, 2.5, 8
+            off_wait_s = 20.0
+        blocks = lambda plen, mn: -(-(plen + mn) // bs)  # noqa: E731
+        demand = (n_int * blocks(int_plen, int_new)
+                  + n_batch * blocks(batch_plen, batch_new))
+        batch_prompts = [rng.integers(0, cfg.vocab_size,
+                                      size=batch_plen).tolist()
+                         for _ in range(n_batch)]
+        int_prompts = [rng.integers(0, cfg.vocab_size,
+                                    size=int_plen).tolist()
+                       for _ in range(n_int)]
+
+        # uncontended reference streams: big pool, sequential — what
+        # every stream (preempted-and-resumed ones included) must match
+        rcfg = type(vcfg)(**vars(vcfg))
+        rcfg.kv_blocks = max(256, demand + 16)
+        ref_sched = ContinuousBatchingScheduler(
+            InferenceEngineV2(engine.model, params=engine.params,
+                              config=rcfg))
+        ref = []
+        for i, (p, mn) in enumerate([(p, batch_new) for p in batch_prompts]
+                                    + [(p, int_new) for p in int_prompts]):
+            ref_sched.submit(170_000 + i, p, max_new_tokens=mn)
+            ref_sched.run_to_completion()
+            ref.append(ref_sched.finished[170_000 + i].generated)
+
+        def build_fe(admission):
+            pcfg = type(vcfg)(**vars(vcfg))
+            pcfg.enable_prefix_cache = True
+            pcfg.kv_blocks = kv_small
+            pcfg.max_ragged_sequence_count = max_seqs
+            extra = {"admission": admission} if admission else {}
+            scfg = ServingConfig(max_queue_depth=128,
+                                 prefix_cache={"enabled": True},
+                                 kv_tier={"enabled": True}, **extra)
+            eng = InferenceEngineV2(engine.model, params=engine.params,
+                                    config=pcfg)
+            return ServingFrontend([eng], scfg)
+
+        def drive(fe, timeout):
+            t0 = time.perf_counter()
+            hb = [fe.submit(p, max_new_tokens=batch_new,
+                            request_class="batch")
+                  for p in batch_prompts]
+            time.sleep(0.3)     # let batch occupy the pool first
+            hi = [fe.submit(p, max_new_tokens=int_new,
+                            request_class="interactive")
+                  for p in int_prompts]
+            done = fe.wait_all(hb + hi, timeout=timeout)
+            wall = time.perf_counter() - t0
+            snap = fe.metrics_snapshot()
+            gens = [[ev.token for ev in h.drain()] for h in hb + hi]
+            return done, wall, snap, gens
+
+        # ---- admission ON: zero wedges, preemptions, full parity ------
+        fe_on = build_fe({"reservation": True,
+                          "oversubscription_factor": factor,
+                          "preemption": {"enabled": True}})
+        try:
+            done_on, wall_on, snap_on, gens_on = drive(fe_on, 600)
+        finally:
+            fe_on.shutdown(drain=False, timeout=5)
+
+        # ---- admission OFF: the pre-change stack, bounded wait --------
+        fe_off = build_fe(None)
+        try:
+            done_off, wall_off, snap_off, _ = drive(fe_off, off_wait_s)
+        finally:
+            fe_off.shutdown(drain=False, timeout=5)
+
+        # ---- disabled byte-parity (all-default admission block) -------
+        def parity_gens(admission):
+            pr = type(vcfg)(**vars(vcfg))
+            fe = ServingFrontend(
+                [InferenceEngineV2(engine.model, params=engine.params,
+                                   config=pr)],
+                ServingConfig(max_queue_depth=64, **(
+                    {"admission": admission} if admission else {})))
+            try:
+                hs = [fe.submit(p, max_new_tokens=int_new)
+                      for p in int_prompts[:6]]
+                assert fe.wait_all(hs, timeout=600)
+                return [[ev.token for ev in h.drain()] for h in hs]
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        disabled_parity = (parity_gens({"reservation": False})
+                           == parity_gens(None))
+        preempt_parity = gens_on == ref
+        assert done_on, \
+            "overload burst wedged under reservation admission"
+        assert snap_on["sequences_preempted"] > 0, \
+            "overload phase drove no preemptions — spill path unexercised"
+        assert preempt_parity, \
+            "preempted-and-resumed streams broke greedy parity"
+        assert disabled_parity, \
+            "all-default admission block diverged from the old stack"
+        itf = snap_on["ttft_s_class_interactive"]
+        itp = snap_on["tpot_s_class_interactive"]
+        return {
+            "n_requests": n_int + n_batch,
+            "n_interactive": n_int, "n_batch": n_batch,
+            "kv_blocks": int(kv_small),
+            "aggregate_demand_blocks": int(demand),
+            "overload_ratio": round(demand / kv_small, 2),
+            "oversubscription_factor": factor,
+            "zero_wedges": bool(done_on),
+            "completed_on": int(snap_on["requests_completed"]),
+            "completed_off": int(snap_off["requests_completed"]),
+            "completed_per_sec_on": round(
+                snap_on["requests_completed"] / wall_on, 3),
+            "completed_per_sec_off": round(
+                snap_off["requests_completed"] / wall_off, 3),
+            "off_wedged": bool(not done_off),
+            "off_wait_s": off_wait_s,
+            "sequences_preempted": int(snap_on["sequences_preempted"]),
+            "sequences_resumed": int(snap_on["sequences_resumed"]),
+            "preempt_spill_p50_ms": round(
+                snap_on["preempt_spill_s"]["p50"] * 1e3, 3),
+            "preempt_resume_p50_ms": round(
+                snap_on["preempt_resume_s"]["p50"] * 1e3, 3),
+            "p95_interactive_ttft_ms": round(itf["p95"] * 1e3, 2),
+            "p99_interactive_ttft_ms": round(itf["p99"] * 1e3, 2),
+            "p95_interactive_tpot_ms": round(itp["p95"] * 1e3, 2),
+            "p99_interactive_tpot_ms": round(itp["p99"] * 1e3, 2),
+            "requests_shed_preempt_pressure": int(
+                snap_on.get("requests_shed_preempt_pressure", 0)),
+            "preempt_parity": bool(preempt_parity),
             "disabled_parity": bool(disabled_parity),
         }
 
@@ -1637,6 +1823,12 @@ def bench_serving(on_tpu: bool):
     # and hit rate with host-RAM spillover on vs off, greedy parity and
     # disabled byte-parity both asserted, restores asserted non-zero
     result["kv_tier"] = runner.run("kv_tier", run_kv_tier_phase)
+    # admission-overhaul overload phase (docs/SERVING.md "Admission and
+    # preemption"): ~10x KV overload — reservation admission sustains it
+    # with zero wedges, preempting batch victims to the KV tier for the
+    # interactive burst (greedy parity asserted, preempted-and-resumed
+    # streams included) while the pre-change stack deadlocks
+    result["overload"] = runner.run("overload", run_overload_phase)
     # SLO observability phase (docs/OBSERVABILITY.md "SLOs and burn-rate
     # alerts"): injected latency fault trips the interactive burn-rate
     # alert and resolves after it clears (both transitions journaled),

@@ -36,7 +36,12 @@ class RaggedInferenceEngineConfig:
                  kv_tier_enabled: bool = False,
                  kv_tier_host_bytes: int = 64 * 1024 * 1024,
                  kv_tier_disk_path: Optional[str] = None,
-                 kv_tier_disk_bytes: int = 0):
+                 kv_tier_disk_bytes: int = 0,
+                 admission_reservation: bool = False,
+                 admission_oversubscription_factor: float = 1.0,
+                 admission_preemption_enabled: bool = False,
+                 admission_victim_policy: str = "lowest_class",
+                 admission_max_preemptions_per_seq: int = 2):
         self.max_ragged_batch_size = max_ragged_batch_size
         self.max_ragged_sequence_count = max_ragged_sequence_count
         self.max_chunk_tokens = max_chunk_tokens
@@ -61,6 +66,21 @@ class RaggedInferenceEngineConfig:
         self.kv_tier_host_bytes = kv_tier_host_bytes
         self.kv_tier_disk_path = kv_tier_disk_path
         self.kv_tier_disk_bytes = kv_tier_disk_bytes
+        # admission overhaul (docs/SERVING.md "Admission and
+        # preemption"): total-block reservation admission in the
+        # scheduler — a sequence's whole projected KV need is reserved
+        # before its first prefill chunk, so N concurrent partial
+        # prefills can never exhaust the pool with none able to finish
+        # — plus preemption that spills a victim's KV to the tier and
+        # resumes it later via import + submit_prefilled. Off (the
+        # default) keeps the chunk-by-chunk admission byte for byte.
+        self.admission_reservation = admission_reservation
+        self.admission_oversubscription_factor = \
+            admission_oversubscription_factor
+        self.admission_preemption_enabled = admission_preemption_enabled
+        self.admission_victim_policy = admission_victim_policy
+        self.admission_max_preemptions_per_seq = \
+            admission_max_preemptions_per_seq
 
 
 class InferenceEngineV2:
@@ -276,6 +296,66 @@ class InferenceEngineV2:
         engine untouched) on representation mismatch or KV pressure; the
         serving layer falls back to re-prefilling."""
         self.state_manager.import_sequence(uid, payload, tokens)
+
+    # ---------------------------------------------- admission + preemption
+    def configure_admission(self, reservation: bool,
+                            oversubscription_factor: float = 1.0,
+                            preemption_enabled: bool = False,
+                            victim_policy: str = "lowest_class",
+                            max_preemptions_per_seq: int = 2) -> None:
+        """Stamp the admission-overhaul settings (docs/SERVING.md
+        "Admission and preemption") onto a built engine — the serving
+        layer's config-driven hook (``ServingConfig.admission``).
+        Schedulers read these at construction, so call it before the
+        replica (and its scheduler) is built — the ``ServingFrontend``
+        replica-build path does."""
+        if preemption_enabled and not reservation:
+            raise ValueError(
+                "admission preemption requires reservation admission "
+                "(preemption is triggered by reservation shortfall)")
+        self.config.admission_reservation = bool(reservation)
+        self.config.admission_oversubscription_factor = \
+            float(oversubscription_factor)
+        self.config.admission_preemption_enabled = bool(preemption_enabled)
+        self.config.admission_victim_policy = str(victim_policy)
+        self.config.admission_max_preemptions_per_seq = \
+            int(max_preemptions_per_seq)
+
+    def try_reserve(self, uid: int, total_blocks: int) -> bool:
+        """Reserve a sequence's total projected block need against the
+        ledger — see :meth:`DSStateManager.try_reserve`."""
+        return self.state_manager.try_reserve(uid, total_blocks)
+
+    def force_reserve(self, uid: int, total_blocks: int) -> None:
+        self.state_manager.force_reserve(uid, total_blocks)
+
+    def release_reservation(self, uid: int) -> None:
+        self.state_manager.release_reservation(uid)
+
+    def reservation_headroom(self) -> int:
+        """Blocks a new reservation can still claim — see
+        :meth:`DSStateManager.reservation_headroom`."""
+        return self.state_manager.reservation_headroom()
+
+    def reserved_total_blocks(self) -> int:
+        return self.state_manager.reserved_total_blocks()
+
+    def freeable_blocks_of(self, uid: int) -> int:
+        """Blocks a flush of this sequence would actually return to
+        ``available_blocks`` — see
+        :meth:`DSStateManager.freeable_blocks_of`."""
+        return self.state_manager.freeable_blocks_of(uid)
+
+    def preempt_stash(self, uid: int, payload: Dict[str, object]) -> None:
+        """Park an exported sequence's KV for a later preemption resume
+        — see :meth:`DSStateManager.preempt_stash`."""
+        self.state_manager.preempt_stash(uid, payload)
+
+    def preempt_restore_payload(self, uid: int) -> Optional[Dict[str, object]]:
+        return self.state_manager.preempt_restore_payload(uid)
+
+    def preempt_discard(self, uid: int) -> None:
+        self.state_manager.preempt_discard(uid)
 
     def match_prefix(self, uid: int, prompt_tokens: Sequence[int]) -> int:
         """Prefix-cache lookup for a new sequence: share every cached

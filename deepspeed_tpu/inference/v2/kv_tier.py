@@ -299,6 +299,12 @@ class TieredKVStore:
         self._remove_file(meta["fkey"])
         return slabs
 
+    def discard(self, key: tuple) -> None:
+        """Drop an entry from both tiers (and its disk file) WITHOUT
+        touching the hit/miss counters — the cancel path for parked
+        preemption payloads, not a serving-path lookup."""
+        self._forget(key)
+
     def readmit(self, key: tuple, slabs: Dict[str, np.ndarray]) -> None:
         """Put back an entry whose restore failed (no device block could
         be freed): the ``get`` that fetched it was not a real hit — the

@@ -84,6 +84,20 @@ class DSStateManager:
             bytes_per_block=kv_bytes_per_block(model_cfg, block_size,
                                                self.kv_quant, dtype))
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        # -- reservation ledger (docs/SERVING.md "Admission and
+        # preemption"): per-sequence TOTAL projected block need, recorded
+        # at admission. The scheduler's reservation admission keeps
+        # ``sum(unfilled) <= available_blocks`` — every admitted sequence
+        # can always obtain the blocks it still needs, so chunk-by-chunk
+        # prefill can never wedge the pool. Passive when nobody reserves
+        # (the ledger is empty → headroom == available_blocks).
+        self._reserved: Dict[int, int] = {}
+        # -- preemption spill store: whole-sequence KV exports parked
+        # under pressure. Slab bytes live in the KV tier when one is
+        # configured (byte-bounded LRU + disk demotion + CRC — dropped
+        # entries degrade to a lossless greedy re-prefill), else in a
+        # plain host-RAM dict bounded by the parked-sequence count.
+        self._preempt_store: Dict[int, dict] = {}
         # -- prefix cache ---------------------------------------------------
         self.prefix_cache_enabled = bool(enable_prefix_cache)
         self.prefix_cache_max_blocks = (prefix_cache_max_blocks
@@ -156,6 +170,7 @@ class DSStateManager:
         reference keeps them) and become evictable once no sequence refers
         to them."""
         seq = self._seqs.pop(uid, None)
+        self._reserved.pop(uid, None)     # reservation dies with the state
         if seq is not None and seq.kv_blocks:
             self._release_blocks(seq.kv_blocks)
 
@@ -350,6 +365,129 @@ class DSStateManager:
             if short > 0 and self.prefix_cache_enabled:
                 self._evict(short)           # LRU unreferenced cached blocks
             seq.kv_blocks.extend(self.allocator.allocate(need))
+
+    # -- reservation ledger (docs/SERVING.md "Admission and preemption") ----
+    def _unfilled(self, uid: int, total: int) -> int:
+        seq = self._seqs.get(uid)
+        have = len(seq.kv_blocks) if seq is not None else 0
+        return max(0, total - have)
+
+    def reserved_unfilled(self) -> int:
+        """Blocks the reserved sequences are still entitled to allocate —
+        the ledger's claim against ``available_blocks``. Recomputed per
+        read: the ledger only ever holds admitted + parked sequences
+        (bounded by the ragged seat count, dozens), so the walk is noise
+        next to the forward each scheduler step runs."""
+        return sum(self._unfilled(uid, total)
+                   for uid, total in self._reserved.items())
+
+    def freeable_blocks_of(self, uid: int) -> int:
+        """Blocks that would actually return to ``available_blocks`` if
+        this sequence were flushed right now: private blocks (the
+        sequence holds the only reference) plus cache-indexed blocks
+        whose only OTHER reference is the cache's own (they become
+        evictable). Prefix blocks other live sequences still share free
+        NOTHING on flush — preemption victim selection must not count
+        them, or a victim gets spilled for headroom that never
+        materializes."""
+        seq = self._seqs.get(uid)
+        if seq is None:
+            return 0
+        n = 0
+        for b in seq.kv_blocks:
+            rc = self.allocator.ref_count(b)
+            if rc == 1 or (rc == 2 and b in self._block_hash):
+                n += 1
+        return n
+
+    def reservation_headroom(self) -> int:
+        """``available_blocks`` minus the outstanding reservation claims:
+        what a NEW reservation (or a preempted sequence's resume) can
+        take without endangering an admitted sequence's future
+        allocations. Negative only after a ``force_reserve``
+        over-commitment (KV handoff imports) — the scheduler's
+        preemption path restores it."""
+        return self.available_blocks - self.reserved_unfilled()
+
+    def try_reserve(self, uid: int, total_blocks: int) -> bool:
+        """Reserve a sequence's total projected block need (prompt +
+        generation budget, blocks it already holds — prefix-cache hits
+        included — credited). False = shortfall: the caller defers the
+        sequence instead of part-prefilling it into a wedge."""
+        prior = self._reserved.pop(uid, None)
+        need = self._unfilled(uid, int(total_blocks))
+        if need > self.reservation_headroom():
+            if prior is not None:
+                self._reserved[uid] = prior
+            return False
+        self._reserved[uid] = int(total_blocks)
+        return True
+
+    def force_reserve(self, uid: int, total_blocks: int) -> None:
+        """Record a reservation unconditionally — the KV-handoff import
+        path, whose blocks are already resident when the ledger first
+        hears of the sequence. May push headroom negative; the
+        scheduler's preemption pass repairs that."""
+        self._reserved[uid] = int(total_blocks)
+
+    def release_reservation(self, uid: int) -> None:
+        self._reserved.pop(uid, None)
+
+    def reserved_total_blocks(self) -> int:
+        """Sum of the reserved sequences' total projected needs — the
+        resident half of the oversubscription-cap accounting."""
+        return sum(self._reserved.values())
+
+    @property
+    def reserved_sequences(self) -> int:
+        return len(self._reserved)
+
+    # -- preemption spill store (docs/SERVING.md "Admission and preemption")
+    def preempt_stash(self, uid: int, payload: Dict[str, object]) -> None:
+        """Park an exported sequence's KV (``export_sequence`` payload)
+        for a later resume. Slab bytes go through the KV tier when one
+        is configured — int8 slabs under kv_quant ride the 4x
+        compression, host overflow demotes to disk, and a dropped or
+        corrupt entry degrades the resume to a greedy re-prefill — else
+        they stay in host RAM on this store."""
+        meta = {k: payload[k] for k in ("seen_tokens", "block_size",
+                                        "kv_quant", "n_blocks")}
+        if self._tier is not None:
+            # not a prefix-cache spill: keep the per-block tier counters
+            # honest (sequences_preempted counts these instead)
+            self._tier.put(("__preempt__", uid), payload["slabs"],
+                           _count_spill=False)
+            meta["in_tier"] = True
+        else:
+            meta["slabs"] = payload["slabs"]
+        self._preempt_store[uid] = meta
+
+    def preempt_restore_payload(self, uid: int) -> Optional[Dict[str, object]]:
+        """Take a parked sequence's export payload back (one-shot).
+        ``None`` = nothing parked, or the tier dropped/corrupted the
+        entry — the caller re-prefills (byte-lossless under greedy)."""
+        meta = self._preempt_store.pop(uid, None)
+        if meta is None:
+            return None
+        meta = dict(meta)
+        if meta.pop("in_tier", False):
+            slabs = (self._tier.get(("__preempt__", uid))
+                     if self._tier is not None else None)
+            if slabs is None:
+                return None
+            meta["slabs"] = slabs
+        return meta
+
+    def preempt_discard(self, uid: int) -> None:
+        """Drop a parked payload (cancel/deadline/shutdown of a
+        preempted sequence)."""
+        meta = self._preempt_store.pop(uid, None)
+        if meta is not None and meta.get("in_tier") and self._tier is not None:
+            self._tier.discard(("__preempt__", uid))
+
+    @property
+    def preempted_parked(self) -> int:
+        return len(self._preempt_store)
 
     # -- prefix cache --------------------------------------------------------
     @property

@@ -23,7 +23,8 @@ historical one.
 from __future__ import annotations
 
 import dataclasses
-from collections import deque
+import time
+from collections import OrderedDict, deque
 from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
@@ -45,6 +46,15 @@ class Request:
     # notification with a finish reason ("eos" | "length" | "cancelled")
     on_token: Optional[Callable[[int, int], None]] = None
     on_finish: Optional[Callable[["Request", str], None]] = None
+    # admission overhaul (docs/SERVING.md "Admission and preemption"):
+    # shed_rank orders preemption victim selection (higher = lower
+    # urgency class, preempted first — the serving layer passes its
+    # class shed rank); preempt_count caps how often one sequence may
+    # be spilled (the starvation guard); total_blocks is the reserved
+    # total projected KV need recorded at admission
+    shed_rank: int = 0
+    preempt_count: int = 0
+    total_blocks: int = 0
     # state
     prompt_fed: int = 0
     prefix_matched: int = -1     # tokens served from the prefix cache
@@ -108,6 +118,34 @@ class ContinuousBatchingScheduler:
         self._spec_stats = {"proposed": 0, "accepted": 0, "emitted": 0,
                             "decode_rows": 0}
         self._proposer_warned = False
+        # admission overhaul (docs/SERVING.md "Admission and
+        # preemption"), read from the ENGINE config so bare schedulers
+        # (bench, tests) and the serving stack share one wiring point
+        # (``ServingFrontend`` stamps ``ServingConfig.admission`` onto
+        # each replica engine via ``engine.configure_admission`` before
+        # building the replica's scheduler). All-default = the
+        # historical chunk-by-chunk admission byte for byte.
+        ecfg = engine.config
+        self.reservation = bool(getattr(ecfg, "admission_reservation",
+                                        False))
+        self.oversubscription_factor = float(getattr(
+            ecfg, "admission_oversubscription_factor", 1.0))
+        self.preempt_enabled = bool(getattr(
+            ecfg, "admission_preemption_enabled", False))
+        self.victim_policy = str(getattr(
+            ecfg, "admission_victim_policy", "lowest_class"))
+        self.max_preemptions_per_seq = int(getattr(
+            ecfg, "admission_max_preemptions_per_seq", 2))
+        # parked (preempted) sequences, resume order = preemption order:
+        # uid -> {"req", "tokens", "stashed", "last_logits", "fed",
+        #         "n_blocks", "total_blocks"}
+        self.preempted: "OrderedDict[int, dict]" = OrderedDict()
+        self._preempt_stats = {"preempted": 0, "resumed": 0}
+        self._parked_blocks = 0           # device blocks parked seqs held
+        self._last_shortfall = 0          # blocks the pending head is short
+        self._preempt_events: List[dict] = []   # drained by the replica
+        self._spill_times: List[float] = []     # → preempt_spill_s
+        self._resume_times: List[float] = []    # → preempt_resume_s
 
     @property
     def spec_enabled(self) -> bool:
@@ -130,9 +168,10 @@ class ContinuousBatchingScheduler:
                max_new_tokens: int = 64, eos_token_id: Optional[int] = None,
                on_token: Optional[Callable[[int, int], None]] = None,
                on_finish: Optional[Callable[[Request, str], None]] = None,
-               trace_id: Optional[str] = None):
+               trace_id: Optional[str] = None, shed_rank: int = 0):
         req = Request(uid, list(prompt_tokens), max_new_tokens,
-                      eos_token_id, on_token, on_finish)
+                      eos_token_id, on_token, on_finish,
+                      shed_rank=int(shed_rank))
         if trace_id is not None and self.tracer.enabled:
             # the prefill stage starts at scheduler submission so the
             # request's span chain stays gap-free: any wait for a packing
@@ -149,7 +188,8 @@ class ContinuousBatchingScheduler:
                          on_token: Optional[Callable[[int, int], None]] = None,
                          on_finish: Optional[Callable[["Request", str],
                                                       None]] = None,
-                         trace_id: Optional[str] = None) -> Request:
+                         trace_id: Optional[str] = None,
+                         shed_rank: int = 0) -> Request:
         """Resume a sequence whose prompt KV was imported from a
         prefill-role replica (``engine.import_sequence`` must have run
         first): the request enters ``running`` directly with the prompt
@@ -158,10 +198,21 @@ class ContinuousBatchingScheduler:
         byte-lossless under greedy decoding (docs/SERVING.md
         "Disaggregated serving")."""
         req = Request(uid, list(prompt_tokens), max_new_tokens,
-                      eos_token_id, on_token, on_finish)
+                      eos_token_id, on_token, on_finish,
+                      shed_rank=int(shed_rank))
         req.prompt_fed = len(req.prompt_tokens)
         req.prefix_matched = 0       # no lookup: the KV arrived whole
         req.last_logits = np.asarray(last_logits)
+        if self.reservation:
+            # the imported blocks are already resident; reserve the
+            # remaining decode need. A shortfall here is repaired by the
+            # preemption pass (or, with preemption off, was prevented by
+            # the replica's pre-import headroom check) — the import
+            # cannot be un-done from here, so the ledger records it
+            # unconditionally rather than lying by omission.
+            req.total_blocks = self._total_blocks(req)
+            if not self.engine.try_reserve(uid, req.total_blocks):
+                self.engine.force_reserve(uid, req.total_blocks)
         if trace_id is not None and self.tracer.enabled:
             # no prefill stage here (it ran on the source replica); the
             # decode span opens at the first emitted token as usual
@@ -176,6 +227,14 @@ class ContinuousBatchingScheduler:
         not when the sequence would have finished). Returns False for
         unknown/already-finished uids."""
         req = self.running.pop(uid, None)
+        if req is None:
+            # a preempted (parked) sequence holds no device blocks —
+            # drop its spilled payload and settle terminally
+            entry = self.preempted.pop(uid, None)
+            if entry is not None:
+                req = entry["req"]
+                self._parked_blocks -= entry["n_blocks"]
+                self.engine.preempt_discard(uid)
         if req is None:
             for r in self.pending:
                 if r.uid == uid:
@@ -197,7 +256,7 @@ class ContinuousBatchingScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.pending or self.running)
+        return bool(self.pending or self.running or self.preempted)
 
     def _pack(self):
         """Dynamic SplitFuse packing: decodes first, then prompt chunks.
@@ -219,33 +278,26 @@ class ContinuousBatchingScheduler:
         # re-check in put() into a SchedulingError. One-time per request;
         # a no-op returning 0 when the cache is disabled. Matched blocks
         # stay shared across deferral/retry until finish/cancel flushes.
+        if self.reservation:
+            # admission overhaul (docs/SERVING.md "Admission and
+            # preemption"): repair any force-reserve over-commitment,
+            # resume parked sequences oldest-first while seats and
+            # headroom allow, then admit pending work under total-block
+            # reservation — a request that cannot reserve its whole
+            # projected need WAITS instead of part-prefilling the pool
+            # into a wedge.
+            self._maybe_restore_headroom()
+            self._resume_preempted()
+            new_candidates = self._admit_pending_reserved()
+        else:
+            new_candidates = []
+            while (self.pending
+                   and len(self.running) + len(new_candidates) < self._max_seqs):
+                new_candidates.append(self.pending.popleft())
         candidates: List[Request] = [r for r in self.running.values()
                                      if r.prompt_remaining > 0]
-        new_candidates: List[Request] = []
-        while self.pending and len(self.running) + len(new_candidates) < self._max_seqs:
-            new_candidates.append(self.pending.popleft())
         for req in candidates + new_candidates:
-            if req.prefix_matched < 0:
-                # tiered KV memory (docs/SERVING.md "KV tiering"): count
-                # how many of this request's matched blocks came back
-                # from the host/disk tier — only when tracing, the extra
-                # stats read is off the default hot path
-                tier_fn = (getattr(self.engine, "tier_stats", None)
-                           if req.spans is not None else None)
-                restored0 = tier_fn()["restored"] if tier_fn else 0
-                req.prefix_matched = self.engine.match_prefix(
-                    req.uid, req.prompt_tokens)
-                if req.prefix_matched > 0:
-                    req.prompt_fed = req.prefix_matched
-                if req.spans is not None:
-                    # cache outcome as a span attribute — the "where did
-                    # this TTFT go" answer includes what was skipped
-                    req.spans["prefill"].set("prefix_matched_tokens",
-                                             req.prefix_matched)
-                    if tier_fn:
-                        req.spans["prefill"].set(
-                            "kv_tier_restored_blocks",
-                            tier_fn()["restored"] - restored0)
+            self._match_prefix_for(req)
 
         def admit(req, chunk) -> bool:
             ok = self.engine.can_schedule(uids + [req.uid],
@@ -309,6 +361,304 @@ class ContinuousBatchingScheduler:
             if not scheduled and req.uid not in self.running:
                 self.pending.appendleft(req)   # new request deferred
         return uids, chunks, plan
+
+    def _match_prefix_for(self, req: Request) -> None:
+        """One-time prefix-cache lookup for a candidate (no-op once
+        done, or when the cache is disabled — returns 0, creates
+        nothing). Matched blocks stay shared across deferral/retry
+        until finish/cancel flushes."""
+        if req.prefix_matched >= 0:
+            return
+        # tiered KV memory (docs/SERVING.md "KV tiering"): count how
+        # many of this request's matched blocks came back from the
+        # host/disk tier — only when tracing, the extra stats read is
+        # off the default hot path
+        tier_fn = (getattr(self.engine, "tier_stats", None)
+                   if req.spans is not None else None)
+        restored0 = tier_fn()["restored"] if tier_fn else 0
+        req.prefix_matched = self.engine.match_prefix(
+            req.uid, req.prompt_tokens)
+        if req.prefix_matched > 0:
+            req.prompt_fed = req.prefix_matched
+        if req.spans is not None:
+            # cache outcome as a span attribute — the "where did
+            # this TTFT go" answer includes what was skipped
+            req.spans["prefill"].set("prefix_matched_tokens",
+                                     req.prefix_matched)
+            if tier_fn:
+                req.spans["prefill"].set(
+                    "kv_tier_restored_blocks",
+                    tier_fn()["restored"] - restored0)
+
+    # ------------------- reservation admission + preemption (tentpole;
+    # docs/SERVING.md "Admission and preemption") -------------------------
+    def _total_blocks(self, req: Request) -> int:
+        """A request's TOTAL projected KV block need: every token that
+        will ever sit in the cache — prompt plus the generation budget
+        still owed (``generated`` stays populated across a preemption
+        re-prefill, where the delivered tokens were folded into the
+        prompt). Clamped to the pool size: a request the pool can never
+        hold whole is admitted best-effort and defers at the tail
+        exactly as the historical path did, instead of blocking the
+        queue forever behind an unsatisfiable reservation."""
+        bs = self.engine.config.kv_block_size
+        total = (len(req.prompt_tokens)
+                 + max(0, req.max_new_tokens - len(req.generated)))
+        return min(-(-total // bs), self.engine.config.kv_blocks)
+
+    def _admit_pending_reserved(self) -> List[Request]:
+        """Pull pending requests under total-block reservation. FIFO
+        within an urgency class (skipping a blocked peer would starve
+        large requests), but a blocked head does NOT hold back
+        strictly-more-urgent work behind it — that work may be able to
+        reserve (or preempt) where the head could not. The unmet need
+        is published as the reservation shortfall."""
+        out: List[Request] = []
+        self._last_shortfall = 0
+        blocked_rank: Optional[int] = None    # most urgent rank blocked
+        i = 0
+        while (i < len(self.pending)
+               and len(self.running) + len(out) < self._max_seqs):
+            req = self.pending[i]
+            if blocked_rank is not None and req.shed_rank >= blocked_rank:
+                i += 1
+                continue
+            if self._try_admit(req):
+                del self.pending[i]
+                out.append(req)
+            else:
+                blocked_rank = (req.shed_rank if blocked_rank is None
+                                else min(blocked_rank, req.shed_rank))
+                i += 1
+        return out
+
+    def _try_admit(self, req: Request) -> bool:
+        """Reservation admission for one request: prefix-match first
+        (cached blocks credit against the need), then reserve the total
+        projected block count. On shortfall, preemption (when enabled)
+        may spill strictly-lower-urgency victims to the KV tier; a
+        request that still cannot reserve is rolled back — its matched
+        blocks released back to the cache — and waits."""
+        total = self._total_blocks(req)
+        self._match_prefix_for(req)
+        if self.engine.try_reserve(req.uid, total):
+            req.total_blocks = total
+            return True
+        if self.preempt_enabled and self._preempt_for(req, total):
+            if self.engine.try_reserve(req.uid, total):
+                req.total_blocks = total
+                return True
+        # rollback: the sequence keeps nothing while it waits (pinned
+        # shared blocks would shrink everyone else's headroom); the
+        # match re-runs on the next attempt
+        self.engine.flush(req.uid)
+        req.prefix_matched = -1
+        req.prompt_fed = 0
+        self._last_shortfall = max(
+            self._last_shortfall,
+            total - max(0, self.engine.reservation_headroom()))
+        return False
+
+    def _victim_order(self, req: Request, blocks: int):
+        """Sort key for victim selection, LARGEST preempted first.
+        ``lowest_class`` (default): lowest urgency class first (highest
+        shed_rank), then most blocks (frees the most memory), then
+        least progress (wastes the least work). ``most_blocks`` /
+        ``least_progress`` re-order the tie-breakers for workloads that
+        care more about one axis."""
+        progress = req.prompt_fed + len(req.generated)
+        if self.victim_policy == "most_blocks":
+            return (blocks, req.shed_rank, -progress)
+        if self.victim_policy == "least_progress":
+            return (-progress, req.shed_rank, blocks)
+        return (req.shed_rank, blocks, -progress)
+
+    def _eligible_victims(self, min_rank: Optional[int] = None) -> List[tuple]:
+        """(req, blocks) preemption candidates, best victim first.
+        ``min_rank`` (admission-driven preemption) requires a victim of
+        STRICTLY lower urgency than the newcomer — preempting peer work
+        to admit identical work is pure churn, so same-class overload
+        waits instead. ``max_preemptions_per_seq`` makes a sequence
+        immune after that many spills (the starvation cap)."""
+        out = []
+        for uid, req in self.running.items():
+            if req.preempt_count >= self.max_preemptions_per_seq:
+                continue
+            if min_rank is not None and req.shed_rank <= min_rank:
+                continue
+            # count only blocks a flush would actually return to the
+            # available pool — prefix blocks other sequences share free
+            # nothing, and spilling a victim for headroom that never
+            # materializes is pure churn
+            blocks = self.engine.freeable_blocks_of(uid)
+            if blocks <= 0:
+                continue         # nothing reclaimable to spill
+            out.append((req, blocks))
+        out.sort(key=lambda t: self._victim_order(*t), reverse=True)
+        return out
+
+    def _preempt_for(self, req: Request, total: int) -> bool:
+        """Admission-driven preemption: spill strictly-lower-urgency
+        victims until ``req`` can reserve, bounded by the
+        oversubscription cap (total committed blocks — resident
+        reservations plus parked sequences — may not exceed
+        ``oversubscription_factor x kv_blocks``; at the default 1.0
+        parking a victim to admit new work would always overflow the
+        cap, so a factor > 1 is what turns preemptive admission on).
+        Returns False without touching anything when the eligible
+        victims cannot cover the shortfall — pointless churn."""
+        committed = (self.engine.reserved_total_blocks()
+                     + sum(e["total_blocks"] for e in self.preempted.values()))
+        cap = self.oversubscription_factor * self.engine.config.kv_blocks
+        if committed + total > cap:
+            return False
+        victims = self._eligible_victims(min_rank=req.shed_rank)
+        have = self.engine.query(req.uid)[1]     # prefix-matched credit
+        shortfall = (max(0, total - have)
+                     - max(0, self.engine.reservation_headroom()))
+        freeable = sum(b for _, b in victims)
+        if freeable < shortfall:
+            return False
+        freed = 0
+        for victim, blocks in victims:
+            if freed >= shortfall:
+                break
+            self._preempt(victim)
+            freed += blocks      # the FREEABLE count, not the export size
+        return True
+
+    def _maybe_restore_headroom(self) -> None:
+        """Repair a negative reservation headroom (a ``force_reserve``
+        over-commitment from a KV-handoff import) by spilling victims —
+        any urgency class; the import already happened, so the only
+        alternative is exactly the deferred-forever wedge this overhaul
+        removes."""
+        if not self.preempt_enabled:
+            return
+        while self.engine.reservation_headroom() < 0:
+            victims = self._eligible_victims()
+            if not victims:
+                return
+            self._preempt(victims[0][0])
+
+    def _preempt(self, req: Request) -> int:
+        """Spill one running sequence: export its KV (pool slabs +
+        kv_quant scales) into the preemption store — the ``TieredKVStore``
+        when a tier is configured — free its device blocks, and park it
+        for a later byte-lossless resume. Returns the blocks freed."""
+        t0 = time.perf_counter()
+        uid = req.uid
+        payload = self.engine.export_sequence(uid)
+        n_blocks = int(payload["n_blocks"]) if payload else 0
+        if payload is not None:
+            self.engine.preempt_stash(uid, payload)
+        # the tokens the exported KV encodes: fed prompt + committed
+        # generation — what import_sequence replays into the prefix index
+        tokens = req.prompt_tokens[:req.prompt_fed] + list(req.generated)
+        self.engine.flush(uid)        # frees blocks + releases reservation
+        self.running.pop(uid, None)
+        if self.proposer is not None:
+            self.proposer.release(uid)
+        req.preempt_count += 1
+        self.preempted[uid] = {
+            "req": req, "tokens": tokens, "stashed": payload is not None,
+            "last_logits": req.last_logits, "fed": req.prompt_fed,
+            "n_blocks": n_blocks,
+            "total_blocks": req.total_blocks or self._total_blocks(req)}
+        self._parked_blocks += n_blocks
+        self._preempt_stats["preempted"] += 1
+        self._preempt_events.append({"uid": uid, "blocks": n_blocks})
+        self._spill_times.append(time.perf_counter() - t0)
+        if len(self._spill_times) > 4096:        # bounded when undrained
+            del self._spill_times[:2048]
+        return n_blocks
+
+    def _resume_preempted(self) -> None:
+        """Bring parked sequences back, oldest first, while a seat and
+        full-reservation headroom exist (strict FIFO: resuming younger,
+        smaller sequences over the head would starve it). The spilled
+        payload imports byte-losslessly — the resumed sequence decodes
+        from the exact logits it was parked with; a payload the tier
+        dropped (byte bounds, disk corruption) degrades to a greedy
+        re-prefill of prompt + delivered tokens, the failover resume
+        semantics."""
+        for uid in list(self.preempted):
+            if len(self.running) >= self._max_seqs:
+                return
+            entry = self.preempted[uid]
+            total = entry["total_blocks"]
+            if total > self.engine.reservation_headroom():
+                return
+            t0 = time.perf_counter()
+            req: Request = entry["req"]
+            payload = (self.engine.preempt_restore_payload(uid)
+                       if entry["stashed"] else None)
+            if payload is not None:
+                try:
+                    self.engine.import_sequence(uid, payload,
+                                                tokens=entry["tokens"])
+                except Exception as e:
+                    logger.warning(
+                        f"preemption resume import for sequence {uid} "
+                        f"failed ({e!r}); re-prefilling")
+                    payload = None
+            if payload is not None:
+                req.prompt_fed = entry["fed"]
+                req.last_logits = entry["last_logits"]
+            else:
+                # lost payload: re-prefill everything the KV held. The
+                # delivered tokens fold into the prompt (KV order is
+                # prompt-then-generation) while ``generated`` keeps the
+                # budget accounting; greedy decoding of this prefix
+                # continues the stream byte-identically.
+                req.prompt_tokens = list(entry["tokens"]) + \
+                    req.prompt_tokens[entry["fed"]:]
+                req.prompt_fed = 0
+                req.prefix_matched = -1
+                req.last_logits = None
+            self.engine.force_reserve(uid, total)
+            req.total_blocks = total
+            del self.preempted[uid]
+            self._parked_blocks -= entry["n_blocks"]
+            self.running[uid] = req
+            self._preempt_stats["resumed"] += 1
+            self._resume_times.append(time.perf_counter() - t0)
+            if len(self._resume_times) > 4096:
+                del self._resume_times[:2048]
+
+    # ---------------------------------------------- preemption observability
+    def preempt_stats(self) -> Dict[str, int]:
+        """Monotonic counters: sequences ``preempted`` (spilled to the
+        tier) and ``resumed`` (brought back) — the serving layer
+        delta-publishes them as ``sequences_preempted`` /
+        ``sequences_resumed``."""
+        return dict(self._preempt_stats)
+
+    def preempted_resident_blocks(self) -> int:
+        """Device blocks the currently-parked sequences held when they
+        were spilled — the footprint preemption is keeping off the pool
+        (the ``preempted_resident_blocks`` gauge)."""
+        return self._parked_blocks
+
+    def reserve_shortfall_blocks(self) -> int:
+        """Blocks the pending head is short of reserving, as of the
+        last packing pass (the ``queue_wait_blocks`` gauge; 0 with
+        reservation off or nothing waiting)."""
+        return self._last_shortfall
+
+    def drain_preempt_times(self):
+        """(spill wall times, resume wall times) since the last drain —
+        the serving layer observes them into ``preempt_spill_s`` /
+        ``preempt_resume_s``."""
+        spills, self._spill_times = self._spill_times, []
+        resumes, self._resume_times = self._resume_times, []
+        return spills, resumes
+
+    def drain_preempt_events(self) -> List[dict]:
+        """Per-preemption records since the last drain — the replica
+        journals each as a ``sequence_preempted`` ops event."""
+        out, self._preempt_events = self._preempt_events, []
+        return out
 
     def _propose(self, req: Request, tok: int, k: int) -> List[int]:
         """Fetch drafts, isolating the scheduler from proposer faults —

@@ -21,7 +21,7 @@ from pydantic import Field, model_validator
 
 from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_keys
 from .resilience import ResilienceConfig
-from ..serving.config import (KVQuantConfig, KVTierConfig,
+from ..serving.config import (AdmissionConfig, KVQuantConfig, KVTierConfig,
                               PrefixCacheConfig, ServingConfig,
                               SpeculativeConfig)
 from ..telemetry.config import TelemetryConfig
@@ -356,6 +356,10 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # tiered KV memory for the v2 ragged engine (docs/SERVING.md
     # "KV tiering"); also reachable as ``serving.kv_tier``
     kv_tier: KVTierConfig = Field(default_factory=KVTierConfig)
+    # reservation-aware admission + preemptive KV spill for the v2
+    # scheduler (docs/SERVING.md "Admission and preemption"); also
+    # reachable as ``serving.admission``
+    admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     # unified telemetry (docs/OBSERVABILITY.md): training step spans here;
     # serving request tracing via ``serving.telemetry``
     telemetry: TelemetryConfig = Field(default_factory=TelemetryConfig)

@@ -15,9 +15,10 @@ from ..telemetry.journal import OpsJournal  # noqa: F401
 from ..telemetry.slo import (AlertEngine, SLOClassTarget,  # noqa: F401
                              SLOConfig)
 from ..telemetry.windowed import WindowedMetrics  # noqa: F401
-from .config import (ClassPolicy, DisaggregationConfig,  # noqa: F401
-                     FaultsConfig, FaultToleranceConfig, HandoffConfig,
-                     KVQuantConfig, KVTierConfig, PrefixCacheConfig,
+from .config import (AdmissionConfig, ClassPolicy,  # noqa: F401
+                     DisaggregationConfig, FaultsConfig,
+                     FaultToleranceConfig, HandoffConfig, KVQuantConfig,
+                     KVTierConfig, PreemptionConfig, PrefixCacheConfig,
                      ServingConfig, SpeculativeConfig)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .handoff import HandoffStager  # noqa: F401
@@ -48,7 +49,7 @@ def __getattr__(name):
 
 
 __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
-           "KVTierConfig",
+           "KVTierConfig", "AdmissionConfig", "PreemptionConfig",
            "SpeculativeConfig", "ClassPolicy", "DisaggregationConfig",
            "HandoffConfig", "HandoffStager",
            "FaultToleranceConfig", "FaultsConfig", "FaultInjector",

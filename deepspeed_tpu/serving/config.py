@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from pydantic import Field, field_validator
+from pydantic import Field, field_validator, model_validator
 
 from ..runtime.config_utils import DSConfigModel
 from ..telemetry.config import TelemetryConfig
@@ -92,6 +92,70 @@ class KVTierConfig(DSConfigModel):
         engine_config.kv_tier_host_bytes = self.host_max_bytes
         engine_config.kv_tier_disk_path = self.disk_path
         engine_config.kv_tier_disk_bytes = self.disk_max_bytes
+
+
+class PreemptionConfig(DSConfigModel):
+    """``admission.preemption`` block (docs/SERVING.md "Admission and
+    preemption"): under reservation shortfall the scheduler spills a
+    victim sequence's KV through ``export_sequence`` into the
+    ``TieredKVStore`` (host RAM when no tier is configured), frees its
+    device blocks, and resumes it later via import +
+    ``submit_prefilled`` — byte-lossless greedy continuation."""
+
+    enabled: bool = False
+    # victim selection: "lowest_class" (lowest urgency class first, then
+    # most blocks, then least progress), "most_blocks", "least_progress"
+    victim_policy: str = "lowest_class"
+    # starvation cap: a sequence spilled this many times becomes immune
+    max_preemptions_per_seq: int = 2
+
+
+class AdmissionConfig(DSConfigModel):
+    """``admission: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Admission and preemption"): total-block reservation admission for
+    the v2 scheduler — a sequence's whole projected KV need (prompt +
+    max_new_tokens, prefix-cache hits credited) is reserved before its
+    first prefill chunk, so N concurrent partial prefills can never
+    exhaust the pool with none able to finish (the chunked-admission
+    deadlock becomes structurally impossible) — plus preemptive KV
+    spill for safe oversubscription. Mounted on both
+    :class:`ServingConfig` and ``DeepSpeedTpuConfig``; all-default (the
+    default) keeps chunk-by-chunk admission byte for byte."""
+
+    reservation: bool = False
+    # total committed blocks (resident reservations + preempted parked
+    # sequences) may reach this multiple of the device pool; > 1.0 is
+    # what enables preemptive admission — at 1.0 preemption only repairs
+    # handoff-import over-commitments
+    oversubscription_factor: float = 1.0
+    preemption: PreemptionConfig = Field(default_factory=PreemptionConfig)
+
+    @model_validator(mode="after")
+    def _preemption_needs_reservation(self):
+        # every preemption entry point lives on the reservation branch
+        # of the scheduler's packing pass — accepting this combination
+        # would silently serve the old admission with zero preemptions
+        if self.preemption.enabled and not self.reservation:
+            raise ValueError(
+                "admission.preemption.enabled requires "
+                "admission.reservation: preemption is triggered by "
+                "reservation shortfall (set reservation: true)")
+        return self
+
+    @property
+    def active(self) -> bool:
+        return self.reservation or self.preemption.enabled
+
+    def apply(self, engine_config) -> None:
+        """Stamp these settings onto a ``RaggedInferenceEngineConfig``
+        (the engine-factory hook for config-driven serving)."""
+        engine_config.admission_reservation = self.reservation
+        engine_config.admission_oversubscription_factor = \
+            self.oversubscription_factor
+        engine_config.admission_preemption_enabled = self.preemption.enabled
+        engine_config.admission_victim_policy = self.preemption.victim_policy
+        engine_config.admission_max_preemptions_per_seq = \
+            self.preemption.max_preemptions_per_seq
 
 
 class SpeculativeConfig(DSConfigModel):
@@ -304,6 +368,11 @@ class ServingConfig(DSConfigModel):
     # spill evicted prefix-cache blocks to host RAM/disk, restore on
     # match (docs/SERVING.md "KV tiering")
     kv_tier: KVTierConfig = Field(default_factory=KVTierConfig)
+    # admission overhaul (scheduler-level; docs/SERVING.md "Admission
+    # and preemption"): total-block reservation admission + preemptive
+    # KV spill for safe oversubscription; all-default = the historical
+    # chunk-by-chunk admission byte for byte
+    admission: AdmissionConfig = Field(default_factory=AdmissionConfig)
     # speculative decoding (scheduler-level; applied per replica)
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
     # unified telemetry: request tracing + flight recorder

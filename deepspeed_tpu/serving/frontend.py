@@ -213,6 +213,21 @@ class ServingFrontend:
                 configure(True, host_bytes=kt.host_max_bytes,
                           disk_path=kt.disk_path,
                           disk_bytes=kt.disk_max_bytes)
+        if self.config.admission.active:
+            # admission overhaul (docs/SERVING.md "Admission and
+            # preemption"): stamped onto the engine config BEFORE the
+            # replica builds its scheduler (schedulers read it at
+            # construction). Engines the caller configured directly are
+            # left alone when the block is off.
+            configure = getattr(engine, "configure_admission", None)
+            if configure is not None:
+                adm = self.config.admission
+                configure(adm.reservation,
+                          oversubscription_factor=adm.oversubscription_factor,
+                          preemption_enabled=adm.preemption.enabled,
+                          victim_policy=adm.preemption.victim_policy,
+                          max_preemptions_per_seq=(
+                              adm.preemption.max_preemptions_per_seq))
         ft = self.config.fault_tolerance
         role = self._role_of(replica_id)
         return Replica(replica_id, engine, self.metrics, self._sample_fn,
@@ -469,6 +484,29 @@ class ServingFrontend:
         if self.alerts is not None:
             self.alerts.maybe_evaluate()
         self._maybe_journal_tier_pressure()
+        self._refresh_admission_gauges()
+
+    def _refresh_admission_gauges(self) -> None:
+        """Sum the fleet's reservation shortfall and parked-sequence
+        footprint into the ``queue_wait_blocks`` /
+        ``preempted_resident_blocks`` gauges, and feed the queue's
+        preempt-pressure flag (labels overload sheds; docs/SERVING.md
+        "Admission and preemption"). Cheap no-ops — both reads are
+        plain ints — when admission is off."""
+        shortfall = parked = 0
+        for rep in self.router.replicas:
+            sched = getattr(rep, "scheduler", None)
+            if sched is None:
+                continue
+            fn = getattr(sched, "reserve_shortfall_blocks", None)
+            if fn is not None:
+                shortfall += fn()
+            fn = getattr(sched, "preempted_resident_blocks", None)
+            if fn is not None:
+                parked += fn()
+        self.metrics.gauge("queue_wait_blocks").set(shortfall)
+        self.metrics.gauge("preempted_resident_blocks").set(parked)
+        self.admission.set_preempt_pressure(shortfall > 0 or parked > 0)
 
     def _maybe_journal_tier_pressure(self) -> None:
         """Journal a ``kv_tier_pressure`` event when the fleet's KV tier
@@ -528,6 +566,7 @@ class ServingFrontend:
         "KV quantization" / OBSERVABILITY.md). One consistent read per
         replica from ``engine.occupancy()`` — the single snapshot that
         replaced the ad-hoc block counts (BlockedAllocator.occupancy)."""
+        self._refresh_admission_gauges()
         blocks = total_bytes = 0
         host_blocks = host_bytes = disk_blocks = disk_bytes = 0
         role_blocks: dict = {}
@@ -604,7 +643,8 @@ class ServingFrontend:
         snap = self.metrics.snapshot()
         classes = sorted(self.config.classes)
         hist_names = (["ttft_s", "tpot_s", "queue_wait_s",
-                       "kv_tier_restore_s"]
+                       "kv_tier_restore_s", "preempt_spill_s",
+                       "preempt_resume_s"]
                       + [f"ttft_s_class_{c}" for c in classes]
                       + [f"tpot_s_class_{c}" for c in classes])
         report = {
@@ -629,12 +669,16 @@ class ServingFrontend:
                 "kv_tier_bytes_disk": snap.get("kv_tier_bytes_disk", 0.0),
                 "handoff_staged": snap.get("handoff_staged", 0.0),
                 "outstanding_tokens": snap.get("outstanding_tokens", 0.0),
+                "preempted_resident_blocks": snap.get(
+                    "preempted_resident_blocks", 0.0),
+                "queue_wait_blocks": snap.get("queue_wait_blocks", 0.0),
             },
             "counters": {k: snap.get(k, 0.0) for k in (
                 "requests_submitted", "requests_completed",
                 "requests_shed", "requests_expired", "requests_failed",
                 "requests_failed_over", "replica_restarts",
-                "handoffs_completed", "handoff_fallbacks")},
+                "handoffs_completed", "handoff_fallbacks",
+                "sequences_preempted", "sequences_resumed")},
             "window_s": window_s,
             "window": self.windowed.summary(hist_names, window_s),
             "slo": (self.alerts.status() if self.alerts is not None

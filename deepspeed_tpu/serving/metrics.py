@@ -376,7 +376,14 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # pools on a prefix match; dropped = blocks that fell out
               # of the tier entirely (byte bounds / corrupt disk entry)
               "kv_tier_blocks_spilled", "kv_tier_blocks_restored",
-              "kv_tier_blocks_dropped"):
+              "kv_tier_blocks_dropped",
+              # admission overhaul (docs/SERVING.md "Admission and
+              # preemption"): sequences spilled to the KV tier under
+              # reservation pressure / brought back; sheds that happened
+              # while the fleet was under preemption pressure (counted
+              # separately from brownout sheds)
+              "sequences_preempted", "sequences_resumed",
+              "requests_shed_preempt_pressure"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -404,7 +411,11 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # tiered KV memory residency, fleet-summed from the same
               # occupancy snapshot (docs/SERVING.md "KV tiering")
               "kv_blocks_host_tier", "kv_blocks_disk_tier",
-              "kv_tier_bytes_host", "kv_tier_bytes_disk"):
+              "kv_tier_bytes_host", "kv_tier_bytes_disk",
+              # admission overhaul (docs/SERVING.md "Admission and
+              # preemption"): blocks the pending reservation head is
+              # short of; device-block footprint of parked sequences
+              "queue_wait_blocks", "preempted_resident_blocks"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md
@@ -412,7 +423,11 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               "handoff_s",
               # host→device restore-batch dispatch time, one sample per
               # contiguous restored run (docs/SERVING.md "KV tiering")
-              "kv_tier_restore_s"):
+              "kv_tier_restore_s",
+              # preemption spill (export → tier) / resume (import →
+              # running) wall time, one sample per preempted sequence
+              # (docs/SERVING.md "Admission and preemption")
+              "preempt_spill_s", "preempt_resume_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
     # per-class series (docs/SERVING.md "Disaggregated serving",
     # docs/OBSERVABILITY.md "SLOs and burn-rate alerts"): latency splits,

@@ -44,6 +44,14 @@ class AdmissionQueue:
         self.brownout_threshold = float(brownout_threshold)
         self._healthy_frac = 1.0
         self._brownout = False
+        # preemption pressure (docs/SERVING.md "Admission and
+        # preemption"): set by the frontend's observability tick while
+        # any replica scheduler reports a reservation shortfall or
+        # parked (preempted) sequences. Overload sheds during such a
+        # window count ``requests_shed_preempt_pressure`` too — "we
+        # shed because the KV pool is oversubscribed" is a different
+        # incident than "we shed because replicas died" (brownout).
+        self._preempt_pressure = False
         self._lock = threading.Condition()
         self._heap: List[tuple] = []      # (order_key, ServingRequest)
         # per-request-class depth (docs/SERVING.md "Disaggregated
@@ -83,6 +91,17 @@ class AdmissionQueue:
             f"requests_shed_class_{req.request_class}").inc()
         if reason == FinishReason.BROWNOUT:
             self.metrics.counter("requests_shed_brownout").inc()
+        elif reason == "overloaded" and self._preempt_pressure:
+            # only genuine overload sheds: a shutdown "draining" sweep
+            # during a pressure window is not an oversubscription signal
+            self.metrics.counter("requests_shed_preempt_pressure").inc()
+
+    def set_preempt_pressure(self, active: bool) -> None:
+        """Frontend tick hook: preemption/reservation pressure somewhere
+        in the fleet. Labels subsequent overload sheds (no effect on
+        admission itself — reservation pressure is resolved by the
+        schedulers, not by shrinking the queue)."""
+        self._preempt_pressure = bool(active)
 
     def offer(self, req: ServingRequest, block: bool = False,
               timeout: Optional[float] = None) -> None:

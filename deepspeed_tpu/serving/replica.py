@@ -149,6 +149,7 @@ class Replica:
         self._prefix_last: Dict[str, int] = {}
         self._spec_last: Dict[str, int] = {}
         self._tier_last: Dict[str, int] = {}
+        self._preempt_last: Dict[str, int] = {}
         self.thread = threading.Thread(target=self._loop, daemon=True,
                                        name=f"serving-replica-{replica_id}")
 
@@ -331,6 +332,27 @@ class Replica:
             payload = req.take_staged()
             if payload is not None:
                 try:
+                    # reservation admission without preemption cannot
+                    # repair an import over-commitment later, so the
+                    # headroom is enforced HERE: a staged handoff that
+                    # would strand already-admitted sequences degrades
+                    # to the recompute path (which re-enters reservation
+                    # admission properly) instead of importing into a
+                    # wedge (docs/SERVING.md "Admission and preemption")
+                    ecfg = getattr(self.engine, "config", None)
+                    if (ecfg is not None
+                            and getattr(ecfg, "admission_reservation", False)
+                            and not getattr(ecfg,
+                                            "admission_preemption_enabled",
+                                            False)):
+                        bs = ecfg.kv_block_size
+                        total = -(-(len(req.prompt_tokens)
+                                    + req.remaining_new_tokens) // bs)
+                        if total > self.engine.reservation_headroom():
+                            raise RuntimeError(
+                                f"KV import of {total} blocks exceeds "
+                                "reservation headroom "
+                                f"({self.engine.reservation_headroom()})")
                     self.engine.import_sequence(req.uid, payload,
                                                 tokens=req.prompt_tokens)
                 except Exception as e:
@@ -364,7 +386,7 @@ class Replica:
                     req.uid, req.prompt_tokens, payload["last_logits"],
                     req.remaining_new_tokens, req.eos_token_id,
                     on_token=self._on_token, on_finish=self._on_finish,
-                    trace_id=req.trace_id)
+                    trace_id=req.trace_id, shed_rank=req.shed_rank)
                 continue
             # resume semantics (a retried request re-prefills prompt +
             # already-delivered tokens and owes only the remaining
@@ -374,7 +396,7 @@ class Replica:
                 req.uid, req.resume_prompt(), req.remaining_new_tokens,
                 req.eos_token_id,
                 on_token=self._on_token, on_finish=self._on_finish,
-                trace_id=req.trace_id)
+                trace_id=req.trace_id, shed_rank=req.shed_rank)
 
     def _on_token(self, uid: int, token: int) -> None:
         # delivery is serialized with _fail_request under the replica
@@ -465,6 +487,8 @@ class Replica:
     _TIER_COUNTERS = (("spilled", "kv_tier_blocks_spilled"),
                       ("restored", "kv_tier_blocks_restored"),
                       ("dropped", "kv_tier_blocks_dropped"))
+    _PREEMPT_COUNTERS = (("preempted", "sequences_preempted"),
+                         ("resumed", "sequences_resumed"))
 
     def _publish_prefix_stats(self) -> None:
         """Forward the engine's monotonic prefix-cache counters (and the
@@ -506,6 +530,29 @@ class Replica:
         if drain is not None:
             for dt in drain():
                 self.metrics.histogram("kv_tier_restore_s").observe(dt)
+        # admission overhaul (docs/SERVING.md "Admission and
+        # preemption"): preempt/resume counters as deltas, spill/resume
+        # wall times into their histograms, and one ops-journal
+        # ``sequence_preempted`` event per spill
+        pstats = self.scheduler.preempt_stats()
+        for key, name in self._PREEMPT_COUNTERS:
+            delta = pstats.get(key, 0) - self._preempt_last.get(key, 0)
+            if delta > 0:
+                self.metrics.counter(name).inc(delta)
+        self._preempt_last = pstats
+        spills, resumes = self.scheduler.drain_preempt_times()
+        for dt in spills:
+            self.metrics.histogram("preempt_spill_s").observe(dt)
+        for dt in resumes:
+            self.metrics.histogram("preempt_resume_s").observe(dt)
+        if self.journal is not None:
+            for ev in self.scheduler.drain_preempt_events():
+                try:
+                    self.journal.emit("sequence_preempted", uid=ev["uid"],
+                                      blocks=ev["blocks"],
+                                      replica=self.replica_id)
+                except Exception:   # journal sink must not kill serving
+                    pass
 
     def _enforce_slo(self) -> None:
         """Cancel/expire active requests; scheduler.cancel frees their KV

@@ -69,6 +69,10 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     # of blocks spilled/restored/dropped plus current host residency
     "kv_tier_pressure": frozenset({"spilled", "restored", "dropped",
                                    "host_bytes"}),
+    # admission overhaul (docs/SERVING.md "Admission and preemption"):
+    # the scheduler spilled a running sequence's KV to the tier to make
+    # room — blocks freed, the replica it happened on
+    "sequence_preempted": frozenset({"uid", "blocks", "replica"}),
     # ----------------------------------------------------------- training
     # supervised restart (docs/TRAINING.md "Fault tolerance")
     "train_restart": frozenset({"reason", "attempt", "steps_lost",

@@ -45,6 +45,9 @@ _LOWER_IS_BETTER = (
     # kv_tier phase: blocks that fell out of the spill tier entirely
     # (byte bounds / disk corruption) — fewer is better
     "blocks_dropped",
+    # overload phase: sheds under preemption pressure mean the
+    # oversubscribed pool ran out of graceful-degradation headroom
+    "shed_preempt_pressure",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
@@ -54,6 +57,9 @@ _HIGHER_IS_BETTER = (
     # kv_tier phase: restored blocks are prefills NOT re-run and saved
     # prefill tokens are the tier's whole point — fewer is a regression
     "blocks_restored", "tokens_saved",
+    # overload phase: completed-sequence throughput under sustained
+    # oversubscription, and how many requests finished at all
+    "completed_per_sec", "completed_on",
 )
 
 

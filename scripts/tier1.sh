@@ -23,7 +23,12 @@
 #                  or TIER1_PHASE=slo for the SLO burn-rate-alerting
 #                  phase — injected latency fault must fire AND resolve
 #                  the interactive alert, with journal/alert schema
-#                  validation folded into schema_problems) — wires
+#                  validation folded into schema_problems,
+#                  or TIER1_PHASE=overload for the admission-overhaul
+#                  phase — ~10x KV overload must sustain zero wedges
+#                  under reservation admission with preempted-and-
+#                  resumed greedy parity and disabled byte-parity
+#                  asserted, while the pre-change stack deadlocks) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

@@ -21,6 +21,7 @@ MODULES = [
     "deepspeed_tpu.inference.v2.kv_tier",
     "deepspeed_tpu.inference.v2.paged_model",
     "deepspeed_tpu.inference.v2.ragged.blocked_allocator",
+    "deepspeed_tpu.inference.v2.ragged.manager",
     "deepspeed_tpu.inference.v2.scheduler",
     "deepspeed_tpu.launcher.runner",
     "deepspeed_tpu.models",
@@ -53,8 +54,13 @@ MODULES = [
     "deepspeed_tpu.sequence.layer",
     "deepspeed_tpu.sequence.ring_attention",
     "deepspeed_tpu.serving",
+    "deepspeed_tpu.serving.config",
     "deepspeed_tpu.serving.faults",
+    "deepspeed_tpu.serving.frontend",
     "deepspeed_tpu.serving.handoff",
+    "deepspeed_tpu.serving.queue",
+    "deepspeed_tpu.serving.replica",
+    "deepspeed_tpu.serving.router",
     "deepspeed_tpu.serving.supervisor",
     "deepspeed_tpu.telemetry",
     "deepspeed_tpu.telemetry.flight_recorder",

@@ -464,7 +464,7 @@ def test_bench_schema_validator():
                          "disabled_parity": True, "kv_occupancy": occ}}
     for name in bench._STAMPED_PHASES:
         if name in ("kv_quant", "train_chaos", "disagg", "slo",
-                    "kv_tier"):
+                    "kv_tier", "overload"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
     good["kv_tier"] = {"tier_on_p50_ttft_ms": 10.7,
@@ -495,7 +495,30 @@ def test_bench_schema_validator():
                       "disabled_parity": True, "replicas": 4,
                       "decode_reserve_tokens": 8,
                       "kv_occupancy": dict(occ)}
+    good["overload"] = {"n_requests": 24, "kv_blocks": 8,
+                        "overload_ratio": 10.25,
+                        "oversubscription_factor": 2.5,
+                        "zero_wedges": True, "completed_on": 24,
+                        "completed_off": 0,
+                        "completed_per_sec_on": 9.6,
+                        "completed_per_sec_off": 0.0,
+                        "sequences_preempted": 12,
+                        "sequences_resumed": 12,
+                        "p95_interactive_ttft_ms": 2500.0,
+                        "p99_interactive_ttft_ms": 2500.0,
+                        "p95_interactive_tpot_ms": 2.4,
+                        "p99_interactive_tpot_ms": 2.5,
+                        "preempt_parity": True, "disabled_parity": True,
+                        "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
+    # overload typed checks: bool-for-int rejected, missing fields named
+    bad_ov = dict(good)
+    bad_ov["overload"] = {"completed_on": True, "zero_wedges": 1}
+    problems_ov = bench.validate_serving_schema(bad_ov)
+    assert any("overload.completed_on" in p for p in problems_ov)
+    assert any("overload.zero_wedges" in p for p in problems_ov)
+    assert any("overload.preempt_parity: missing" in p
+               for p in problems_ov)
     # disagg typed checks: missing and mistyped fields are named
     bad_dg = dict(good)
     bad_dg["disagg"] = {"handoffs_completed": True, "handoff_parity": 1}

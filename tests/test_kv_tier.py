@@ -625,7 +625,12 @@ def serving_config(**kv_tier):
 
     return ServingConfig(max_queue_depth=64,
                          prefix_cache={"enabled": True},
-                         kv_tier=(kv_tier or {"enabled": True}))
+                         kv_tier=(kv_tier or {"enabled": True}),
+                         # reservation admission makes small-pool
+                         # concurrency safe (docs/SERVING.md "Admission
+                         # and preemption"), so these tests no longer
+                         # have to size max_seqs below the pool
+                         admission={"reservation": True})
 
 
 def test_frontend_applies_tier_and_publishes_metrics(model_and_params):
@@ -634,12 +639,7 @@ def test_frontend_applies_tier_and_publishes_metrics(model_and_params):
     model, params = model_and_params
     rng = np.random.default_rng(16)
     reqs = shared_prefix_reqs(rng)
-    # max_seqs=2: chunk-by-chunk admission can deadlock a small pool
-    # when N concurrent partial prefills exhaust it (pre-existing
-    # KV-pressure sharp edge, independent of the tier) — two sequences
-    # always fit this pool whole
-    eng = make_engine(model, params, tier=False, prefix=False,
-                      max_seqs=2)
+    eng = make_engine(model, params, tier=False, prefix=False)
     fe = ServingFrontend([eng], serving_config())
     try:
         assert eng.state_manager.kv_tier_enabled     # config applied it
@@ -673,10 +673,8 @@ def test_restore_races_cancel_and_deadline(model_and_params):
     model, params = model_and_params
     rng = np.random.default_rng(17)
     reqs = shared_prefix_reqs(rng, n_req=10)
-    # 40-token budgets need 10 blocks per sequence: pool 24 keeps two
-    # concurrent sequences clear of the chunked-admission deadlock
     eng = make_engine(model, params, tier=False, prefix=False,
-                      kv_blocks=24, max_seqs=2)
+                      kv_blocks=24)
     fe = ServingFrontend([eng], serving_config())
     try:
         warm = [fe.submit(p, max_new_tokens=3) for p in reqs]
