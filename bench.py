@@ -255,7 +255,7 @@ _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("disabled_parity", bool))
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
-                   "disagg", "slo", "kv_tier", "overload")
+                   "disagg", "slo", "kv_tier", "overload", "autoscale")
 # Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
 # TTFT comparison with the device pool sized below the prefix working
 # set, spill/restore counts, and the parity bits the acceptance gates
@@ -322,6 +322,30 @@ _SLO_KEYS = (("alert_fired", bool),
              ("journal_events", int),
              ("journal_schema_ok", bool),
              ("disabled_parity", bool))
+# Typed shape of the autoscale phase (docs/SERVING.md "Elastic
+# autoscaling"): diurnal + bursty replay against an elastic fleet
+# (autoscaler on, min..max) vs a static fleet pinned at max — SLO
+# attainment must match or beat the static fleet's while spending fewer
+# replica-seconds (the chip-seconds stand-in off-TPU), with greedy
+# parity and autoscaler-disabled byte-parity both asserted.
+_AUTOSCALE_KEYS = (("n_requests", int),
+                   ("min_replicas", int),
+                   ("max_replicas", int),
+                   ("static_replicas", int),
+                   ("slo_attainment_elastic", (int, float)),
+                   ("slo_attainment_static", (int, float)),
+                   ("attainment_ok", bool),
+                   ("replica_seconds_elastic", (int, float)),
+                   ("replica_seconds_static", (int, float)),
+                   ("elastic_beats_static_cost", bool),
+                   ("scale_ups", int),
+                   ("scale_downs", int),
+                   ("reroles", int),
+                   ("peak_replicas", int),
+                   ("final_replicas", int),
+                   ("requests_evacuated", int),
+                   ("greedy_parity", bool),
+                   ("disabled_parity", bool))
 # Typed shape of the train_chaos phase (docs/TRAINING.md "Fault
 # tolerance"): recovery/steps-lost/parity numbers the robustness gates
 # read. ``recovery_time_s`` may be absent only on a skipped phase.
@@ -381,6 +405,11 @@ def validate_serving_schema(serving: dict):
         problems.append("overload: missing or not an object")
     elif "phase_skipped" not in ov:
         _check_typed_phase("overload", ov, _OVERLOAD_KEYS, problems)
+    a = serving.get("autoscale")
+    if not isinstance(a, dict):
+        problems.append("autoscale: missing or not an object")
+    elif "phase_skipped" not in a:
+        _check_typed_phase("autoscale", a, _AUTOSCALE_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -1639,6 +1668,212 @@ def bench_serving(on_tpu: bool):
             "disabled_parity": bool(disabled_parity),
         }
 
+    def run_autoscale_phase():
+        """Elastic fleet autoscaling phase (docs/SERVING.md "Elastic
+        autoscaling"): a diurnal + bursty arrival replay — quiet
+        trickle, burst, trough, second burst, idle tail — driven
+        against (a) an ELASTIC fleet (autoscaler on, min_replicas=1,
+        max_replicas=N) and (b) a STATIC fleet pinned at N replicas.
+        Gates: the elastic fleet matches or beats the static fleet's
+        SLO attainment (completed/submitted under a real deadline)
+        while spending FEWER replica-seconds (the controller's
+        fleet-size-integral ledger vs N x wall — the chip-seconds
+        stand-in off-TPU); it actually scaled (>=1 up AND >=1 down,
+        ending back at min); every elastic stream is byte-identical to
+        an uncontended greedy reference (evacuated-and-resumed ones
+        included); and ``autoscaler: {enabled: false}`` is
+        byte-for-byte a config that never heard of the block."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+        from deepspeed_tpu.serving import ServingConfig, ServingFrontend
+
+        if on_tpu:
+            max_new, deadline_ms, max_seqs = 24, 120_000.0, 8
+            waves = [(4, 1.0), (18, 1.5), (2, 2.5), (14, 1.5), (1, 2.5)]
+            n_static = 3
+        else:
+            max_new, deadline_ms, max_seqs = 12, 120_000.0, 4
+            waves = [(3, 0.8), (14, 1.2), (2, 2.0), (10, 1.2), (1, 2.0)]
+            n_static = 3
+        n_req = sum(n for n, _ in waves)
+        plens = [int(x) for x in
+                 rng.integers(12, 28, size=n_req)]
+        reqs = [rng.integers(0, cfg.vocab_size, size=pl).tolist()
+                for pl in plens]
+
+        # uncontended greedy reference: what every elastic stream —
+        # including any evacuated off a shrinking replica — must match
+        rcfg = type(vcfg)(**vars(vcfg))
+        rcfg.max_ragged_sequence_count = max_seqs
+        ref_sched = ContinuousBatchingScheduler(
+            InferenceEngineV2(engine.model, params=engine.params,
+                              config=rcfg))
+        ref = []
+        for i, p in enumerate(reqs):
+            ref_sched.submit(190_000 + i, p, max_new_tokens=max_new)
+            ref_sched.run_to_completion()
+            ref.append(ref_sched.finished[190_000 + i].generated)
+
+        def engine_factory(i):
+            ecfg = type(vcfg)(**vars(vcfg))
+            ecfg.max_ragged_sequence_count = max_seqs
+            return InferenceEngineV2(engine.model, params=engine.params,
+                                     config=ecfg)
+
+        def build_fe(autoscaler, n_boot):
+            extra = {"autoscaler": autoscaler} if autoscaler else {}
+            scfg = ServingConfig(max_queue_depth=max(64, 2 * n_req),
+                                 num_replicas=n_boot, **extra)
+            return ServingFrontend.from_engine_factory(engine_factory,
+                                                       scfg)
+
+        def drive(fe, on_warm=None):
+            """Replay the waves; returns (handles, wall_s, snapshot)."""
+            # warmup outside the clock: compile the shape buckets
+            fe.wait_all([fe.submit(reqs[0][:8], max_new_tokens=2)],
+                        timeout=600)
+            if on_warm is not None:
+                on_warm()
+            handles = []
+            t0 = time.perf_counter()
+            i = 0
+            for n, pause_s in waves:
+                for _ in range(n):
+                    handles.append(fe.submit(
+                        reqs[i], max_new_tokens=max_new,
+                        deadline_ms=deadline_ms,
+                        request_class=("batch" if i % 3 == 2
+                                       else "interactive")))
+                    i += 1
+                time.sleep(pause_s)
+            assert fe.wait_all(handles, timeout=600)
+            wall = time.perf_counter() - t0
+            return handles, wall, fe.metrics_snapshot()
+
+        def attainment(snap):
+            sub = snap.get("requests_submitted", 0.0) - 1  # minus warmup
+            if sub <= 0:
+                return 0.0
+            bad = (snap.get("requests_shed", 0.0)
+                   + snap.get("requests_expired", 0.0)
+                   + snap.get("requests_failed", 0.0))
+            return max(0.0, (sub - bad) / sub)
+
+        # ---- elastic fleet: boots at min, reshapes itself ------------
+        fe_el = build_fe({"enabled": True, "min_replicas": 1,
+                          "max_replicas": n_static,
+                          "scale_up_queue_per_replica": 2.0,
+                          "scale_down_queue_per_replica": 0.25,
+                          "scale_down_tokens_per_replica": 1.0,
+                          "up_stable_ticks": 1, "down_stable_ticks": 3,
+                          "scale_up_cooldown_s": 0.15,
+                          "scale_down_cooldown_s": 0.4,
+                          "tick_interval_s": 0.05}, n_boot=1)
+        try:
+            # ledger baseline taken AFTER warmup: compile time precedes
+            # traffic on both fleets and is outside the static fleet's
+            # N x wall too — the comparison must cover the same window
+            rs_base = []
+            h_el, wall_el, snap_el = drive(
+                fe_el,
+                on_warm=lambda: rs_base.append(
+                    fe_el.autoscaler.replica_seconds()))
+            # idle tail: let the controller shrink back to min (part of
+            # the measured window for BOTH fleets — see below)
+            tail_deadline = time.monotonic() + 20.0
+            while time.monotonic() < tail_deadline and \
+                    len(fe_el.router.replicas) > 1:
+                time.sleep(0.05)
+            stats = fe_el.autoscaler.stats()
+            replica_seconds_el = (fe_el.autoscaler.replica_seconds()
+                                  - rs_base[0])
+            final_replicas = len(fe_el.router.replicas)
+            gens_el = [[ev.token for ev in h.drain()] for h in h_el]
+            snap_el = fe_el.metrics_snapshot()
+            from deepspeed_tpu.telemetry import validate_events
+            journal_problems = validate_events(fe_el.journal.events())
+            wall_el_total = wall_el + max(
+                0.0, 20.0 - (tail_deadline - time.monotonic()))
+        finally:
+            fe_el.shutdown(drain=False, timeout=5)
+
+        # ---- static fleet: pinned at max the whole time --------------
+        fe_st = build_fe(None, n_boot=n_static)
+        try:
+            h_st, wall_st, snap_st = drive(fe_st)
+            gens_st = [[ev.token for ev in h.drain()] for h in h_st]
+        finally:
+            fe_st.shutdown(drain=False, timeout=5)
+        # the static fleet burns n_static replicas for the same driving
+        # window INCLUDING the idle tail the elastic fleet used to
+        # shrink — that idle capacity is exactly the waste elasticity
+        # recovers
+        replica_seconds_st = n_static * (wall_st
+                                         + (wall_el_total - wall_el))
+
+        # ---- disabled byte-parity ------------------------------------
+        def parity_gens(autoscaler_block):
+            extra = ({"autoscaler": autoscaler_block}
+                     if autoscaler_block is not None else {})
+            fe = ServingFrontend([engine_factory(0)],
+                                 ServingConfig(max_queue_depth=64,
+                                               **extra))
+            try:
+                hs = [fe.submit(p, max_new_tokens=max_new)
+                      for p in reqs[:6]]
+                assert fe.wait_all(hs, timeout=600)
+                return [[ev.token for ev in h.drain()] for h in hs]
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        disabled_parity = (parity_gens({"enabled": False})
+                           == parity_gens(None))
+
+        att_el, att_st = attainment(snap_el), attainment(snap_st)
+        greedy_parity = gens_el == ref
+        assert gens_st == ref, "static fleet broke greedy parity"
+        assert greedy_parity, \
+            "elastic fleet broke greedy byte-parity (evacuation path?)"
+        assert disabled_parity, \
+            "autoscaler.enabled=false diverged from the block-less stack"
+        assert stats["scale_ups"] >= 1, \
+            "bursts never grew the elastic fleet"
+        assert stats["scale_downs"] >= 1, \
+            "idle never shrank the elastic fleet"
+        assert att_el >= att_st - 1e-9, \
+            f"elastic SLO attainment {att_el} fell below static {att_st}"
+        assert replica_seconds_el < replica_seconds_st, \
+            (f"elastic fleet spent {replica_seconds_el:.1f} replica-s "
+             f">= static {replica_seconds_st:.1f}")
+        assert not journal_problems, journal_problems[:5]
+        return {
+            "n_requests": n_req,
+            "min_replicas": 1,
+            "max_replicas": int(n_static),
+            "static_replicas": int(n_static),
+            "waves": [list(w) for w in waves],
+            "deadline_ms": deadline_ms,
+            "slo_attainment_elastic": round(att_el, 4),
+            "slo_attainment_static": round(att_st, 4),
+            "attainment_ok": bool(att_el >= att_st - 1e-9),
+            "replica_seconds_elastic": round(replica_seconds_el, 2),
+            "replica_seconds_static": round(replica_seconds_st, 2),
+            "elastic_beats_static_cost": bool(
+                replica_seconds_el < replica_seconds_st),
+            "wall_elastic_s": round(wall_el, 2),
+            "wall_static_s": round(wall_st, 2),
+            "scale_ups": int(stats["scale_ups"]),
+            "scale_downs": int(stats["scale_downs"]),
+            "reroles": int(stats["reroles"]),
+            "peak_replicas": int(stats["peak_replicas"]),
+            "final_replicas": int(final_replicas),
+            "requests_evacuated": int(snap_el.get("requests_evacuated",
+                                                  0)),
+            "greedy_parity": bool(greedy_parity),
+            "disabled_parity": bool(disabled_parity),
+        }
+
     def run_train_chaos_phase():
         """Training fault-tolerance chaos phase (docs/TRAINING.md "Fault
         tolerance"): a supervised tiny train run is killed at step k —
@@ -1835,6 +2070,11 @@ def bench_serving(on_tpu: bool):
     # window-vs-cumulative p95 agreement, overhead vs the noise floor,
     # disabled-path byte parity, journal schema validation
     result["slo"] = runner.run("slo", run_slo_phase)
+    # elastic fleet autoscaling phase (docs/SERVING.md "Elastic
+    # autoscaling"): diurnal + bursty replay — the elastic fleet must
+    # match/beat the static fleet's SLO attainment on fewer
+    # replica-seconds, with greedy + disabled byte-parity asserted
+    result["autoscale"] = runner.run("autoscale", run_autoscale_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

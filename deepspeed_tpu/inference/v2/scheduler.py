@@ -254,6 +254,62 @@ class ContinuousBatchingScheduler:
             req.on_finish(req, "cancelled")
         return True
 
+    def evacuate(self, uid: int) -> Optional[Dict[str, object]]:
+        """Detach a sequence for migration to ANOTHER replica
+        (docs/SERVING.md "Elastic autoscaling"): remove it from this
+        scheduler's structures and free its device blocks WITHOUT
+        settling it — no ``done`` mark, no ``on_finish`` callback; the
+        serving layer re-queues the request and its stream continues
+        elsewhere. For a fully-prefilled running sequence the resident
+        KV is exported first (the PR 11 spill representation) and
+        returned as a staged-handoff payload (``last_logits`` included)
+        so the destination replica imports instead of re-prefilling;
+        anything else — pending, mid-prefill, parked — returns ``None``
+        and the caller re-prefills from prompt + delivered tokens (the
+        failover resume semantics, lossless under greedy decoding).
+        Returns ``None`` also for unknown/finished uids (nothing to
+        move)."""
+        payload = None
+        req = self.running.pop(uid, None)
+        if req is not None:
+            if (req.prompt_remaining == 0 and not req.done
+                    and req.last_logits is not None):
+                try:
+                    payload = self.engine.export_sequence(uid)
+                except Exception as e:
+                    logger.warning(f"evacuation KV export for sequence "
+                                   f"{uid} failed ({e!r}); falling back "
+                                   "to re-prefill")
+                    payload = None
+                if payload is not None:
+                    payload["last_logits"] = req.last_logits
+        else:
+            # parked sequence: its device blocks are already free and
+            # its payload sits in the preempt stash — drop the stash
+            # (the re-prefill path is simpler than re-plumbing a parked
+            # import across replicas) and hand the request back
+            entry = self.preempted.pop(uid, None)
+            if entry is not None:
+                req = entry["req"]
+                self._parked_blocks -= entry["n_blocks"]
+                self.engine.preempt_discard(uid)
+        if req is None:
+            for r in self.pending:
+                if r.uid == uid:
+                    req = r
+                    self.pending.remove(r)
+                    break
+        if req is None or req.done:
+            return None
+        try:
+            self.engine.flush(uid)     # frees blocks + releases reservation
+        except Exception:
+            pass
+        if self.proposer is not None:   # drop draft state mid-speculation
+            self.proposer.release(uid)
+        self._end_request_spans(req, "evacuated")
+        return payload
+
     @property
     def has_work(self) -> bool:
         return bool(self.pending or self.running or self.preempted)

@@ -15,8 +15,8 @@ from ..telemetry.journal import OpsJournal  # noqa: F401
 from ..telemetry.slo import (AlertEngine, SLOClassTarget,  # noqa: F401
                              SLOConfig)
 from ..telemetry.windowed import WindowedMetrics  # noqa: F401
-from .config import (AdmissionConfig, ClassPolicy,  # noqa: F401
-                     DisaggregationConfig, FaultsConfig,
+from .config import (AdmissionConfig, AutoscalerConfig,  # noqa: F401
+                     ClassPolicy, DisaggregationConfig, FaultsConfig,
                      FaultToleranceConfig, HandoffConfig, KVQuantConfig,
                      KVTierConfig, PreemptionConfig, PrefixCacheConfig,
                      ServingConfig, SpeculativeConfig)
@@ -30,6 +30,10 @@ from .request import (DoneEvent, FinishReason, Priority,  # noqa: F401
                       TokenEvent)
 
 _LAZY = {
+    "FleetController": ("deepspeed_tpu.serving.autoscaler",
+                        "FleetController"),
+    "FleetSignals": ("deepspeed_tpu.serving.autoscaler", "FleetSignals"),
+    "ReplicaInfo": ("deepspeed_tpu.serving.autoscaler", "ReplicaInfo"),
     "ServingFrontend": ("deepspeed_tpu.serving.frontend", "ServingFrontend"),
     "Replica": ("deepspeed_tpu.serving.replica", "Replica"),
     "ReplicaState": ("deepspeed_tpu.serving.replica", "ReplicaState"),
@@ -50,6 +54,8 @@ def __getattr__(name):
 
 __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
            "KVTierConfig", "AdmissionConfig", "PreemptionConfig",
+           "AutoscalerConfig", "FleetController", "FleetSignals",
+           "ReplicaInfo",
            "SpeculativeConfig", "ClassPolicy", "DisaggregationConfig",
            "HandoffConfig", "HandoffStager",
            "FaultToleranceConfig", "FaultsConfig", "FaultInjector",

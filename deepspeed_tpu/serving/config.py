@@ -263,6 +263,87 @@ class DisaggregationConfig(DSConfigModel):
         return self.roles[replica_id]
 
 
+class AutoscalerConfig(DSConfigModel):
+    """``autoscaler: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Elastic autoscaling"): the SLO-driven fleet controller that grows,
+    shrinks, and re-roles the replica pool on the router's ~1/s tick.
+    Three actuators: (1) grow/shrink between ``min_replicas`` and
+    ``max_replicas`` from the frontend's ``engine_factory``, with
+    per-direction cooldowns and consecutive-tick hysteresis so the pool
+    never flaps; (2) re-role prefill<->decode as the traffic mix shifts
+    (role-split fleets only — drain is cheap because staged handoff +
+    kv_tier keep KV portable); (3) proactive brownout on slow-window
+    error-budget burn BEFORE the fast+slow alert fires. Disabled (the
+    default) builds no controller — byte-for-byte the static-fleet
+    stack. Enabling requires an ``engine_factory`` (the frontend
+    validates at construction: a fleet that cannot build engines cannot
+    grow)."""
+
+    enabled: bool = False
+    # fleet-size bounds. min_replicas >= 1 by validation: all-replicas-
+    # removed is impossible by construction, and the router
+    # independently refuses to empty its list.
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # grow when queued work per accepting replica exceeds this for
+    # up_stable_ticks consecutive ticks (and the up cooldown passed)
+    scale_up_queue_per_replica: float = 4.0
+    # shrink when queue depth per accepting replica is at/below this AND
+    # outstanding tokens per accepting replica are at/below
+    # scale_down_tokens_per_replica, for down_stable_ticks consecutive
+    # ticks (and the down cooldown passed)
+    scale_down_queue_per_replica: float = 0.25
+    scale_down_tokens_per_replica: float = 8.0
+    # hysteresis: consecutive qualifying ticks required per direction
+    # (scaling down on a single idle tick would flap a bursty fleet)
+    up_stable_ticks: int = 2
+    down_stable_ticks: int = 5
+    # per-direction cooldowns from the LAST membership change in either
+    # direction (growth must not immediately un-do a shrink and vice
+    # versa); up reacts faster than down by default
+    scale_up_cooldown_s: float = 5.0
+    scale_down_cooldown_s: float = 30.0
+    # decision cadence on the router tick (cadence-gated like the other
+    # tick hooks)
+    tick_interval_s: float = 1.0
+    # re-role (role-split fleets only): flip one replica's role when the
+    # weighted phase-load imbalance (prefill vs decode outstanding
+    # tokens, weighted by the disaggregation cost model) exceeds
+    # rerole_ratio for rerole_stable_ticks consecutive ticks, with its
+    # own cooldown — the flap suppressor for oscillating traffic mixes
+    rerole_ratio: float = 4.0
+    rerole_stable_ticks: int = 5
+    rerole_cooldown_s: float = 30.0
+    # proactive brownout: when any SLO rule's SLOW-window burn rate
+    # reaches brownout_burn_threshold (in error-budget multiples — set
+    # it below slo.burn_rate_threshold to act before the alert), feed
+    # brownout_fraction into the admission queue's effective capacity;
+    # deactivate once the slow burn halves. 0 disables the actuator.
+    brownout_burn_threshold: float = 2.0
+    brownout_fraction: float = 0.5
+
+    @model_validator(mode="after")
+    def _validate_bounds(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                "autoscaler.min_replicas must be >= 1 — a fleet scaled "
+                "to zero replicas could never serve (all-replicas-"
+                "removed must be impossible by construction)")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"autoscaler.max_replicas ({self.max_replicas}) must be "
+                f">= min_replicas ({self.min_replicas})")
+        if not (0.0 < self.brownout_fraction <= 1.0):
+            raise ValueError(
+                "autoscaler.brownout_fraction must be in (0, 1] — 0 "
+                "would shed the whole queue, above 1 does nothing")
+        for name in ("up_stable_ticks", "down_stable_ticks",
+                     "rerole_stable_ticks"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"autoscaler.{name} must be >= 1")
+        return self
+
+
 class FaultToleranceConfig(DSConfigModel):
     """``fault_tolerance: {...}`` block (docs/CONFIG.md, docs/SERVING.md
     "Fault tolerance"): replica supervision (restart DEAD replicas with
@@ -393,6 +474,10 @@ class ServingConfig(DSConfigModel):
     # (docs/SERVING.md "Fault tolerance"); disabled = historical behavior
     fault_tolerance: FaultToleranceConfig = Field(
         default_factory=FaultToleranceConfig)
+    # SLO-driven elastic fleet autoscaling (docs/SERVING.md "Elastic
+    # autoscaling"): grow/shrink/re-role the replica pool + proactive
+    # brownout; disabled = the static fleet byte for byte
+    autoscaler: AutoscalerConfig = Field(default_factory=AutoscalerConfig)
     # test-only deterministic fault injection (chaos suite / bench chaos
     # phase); disabled = no injection hooks anywhere on the hot path
     faults: FaultsConfig = Field(default_factory=FaultsConfig)

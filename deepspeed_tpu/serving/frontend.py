@@ -17,6 +17,7 @@ serving phase.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -96,6 +97,22 @@ class ServingFrontend:
             brownout_threshold=(ft.brownout_threshold if ft.enabled
                                 else 0.0),
             journal=self.journal)
+        # elastic autoscaling (docs/SERVING.md "Elastic autoscaling"):
+        # dynamic membership state. Replica ids are allocated
+        # monotonically and never reused; role overrides (set by
+        # add_replica / set_replica_role) win over the static
+        # disaggregation.roles list; the fleet lock serializes
+        # membership mutations (the controller issues one at a time,
+        # but the API must be safe for direct callers too).
+        self._engine_factory = engine_factory
+        self._next_replica_id = len(engines)
+        self._role_overrides: dict = {}
+        self._fleet_lock = threading.Lock()
+        # evacuated KV rides the same bounded host-RAM staging budget
+        # as disagg handoffs (built lazily when no handoff stager
+        # exists) — a removal of a fully-loaded replica must not
+        # balloon host RAM; over-budget payloads drop to re-prefill
+        self._evac_stager = None
         # speculative decoding is applied per replica: each Replica builds
         # its own proposer from the block (draft state is per-engine)
         self._sample_fn = sample_fn
@@ -140,6 +157,26 @@ class ServingFrontend:
                 config=ft, metrics=self.metrics, tracer=self.tracer,
                 recorder=self.recorder, journal=self.journal)
             self.router.supervisor = self.supervisor
+        # elastic autoscaling (docs/SERVING.md "Elastic autoscaling"):
+        # the FleetController rides the router tick; its actuation
+        # (engine builds, evacuation waits) runs on its own worker.
+        # replicas_target is pinned to the boot size either way, so
+        # dashboards see the fleet shape pre-traffic.
+        self.metrics.gauge("replicas_target").set(len(engines))
+        self.autoscaler = None
+        asc = self.config.autoscaler
+        if asc.enabled:
+            if engine_factory is None:
+                raise ValueError(
+                    "autoscaler.enabled requires an engine_factory — a "
+                    "fleet with no way to build engines cannot grow "
+                    "(use ServingFrontend.from_engine_factory, or pass "
+                    "engine_factory=)")
+            from .autoscaler import FleetController
+
+            self.autoscaler = FleetController(
+                asc, self, metrics=self.metrics, journal=self.journal)
+            self.router.tick_hooks.append(self.autoscaler.maybe_tick)
         self._closed = False
         self.router.start()
         if self.supervisor is not None:
@@ -170,6 +207,9 @@ class ServingFrontend:
                              "requires handoff.enabled")
 
     def _role_of(self, replica_id: int) -> str:
+        override = self._role_overrides.get(replica_id)
+        if override is not None:
+            return override
         if self._disagg is None:
             return "mixed"
         return self._disagg.role_of(replica_id)
@@ -449,6 +489,256 @@ class ServingFrontend:
                           attempt=req.attempts)
         return True
 
+    # ------------------------------------------------- dynamic membership
+    def add_replica(self, role: str = "mixed") -> int:
+        """Grow the fleet by one replica built from the stored
+        ``engine_factory`` (docs/SERVING.md "Elastic autoscaling").
+        Returns the new replica id (monotonic, never reused).
+        Specialized roles require a role-split fleet: "prefill"
+        additionally requires the handoff path (a prefill-only replica
+        with nowhere to send its KV could never finish a request)."""
+        if self._engine_factory is None:
+            raise RuntimeError("add_replica requires an engine_factory")
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(f"unknown replica role {role!r} "
+                             "(expected prefill/decode/mixed)")
+        if role != "mixed" and self._disagg is None:
+            raise ValueError(f"role {role!r} requires "
+                             "disaggregation.enabled — a single-role "
+                             "fleet routes every replica as mixed")
+        if role == "prefill" and not self._disagg.handoff.enabled:
+            raise ValueError("adding a prefill-role replica requires "
+                             "handoff.enabled")
+        with self._fleet_lock:
+            if self._closed:
+                raise RuntimeError("frontend is shut down")
+            rid = self._next_replica_id
+            self._next_replica_id += 1
+            self._role_overrides[rid] = role
+            try:
+                engine = self._engine_factory(rid)
+                replica = self._build_replica(rid, engine)
+                self.router.add_replica(replica)
+            except Exception:
+                self._role_overrides.pop(rid, None)
+                raise
+            if self.supervisor is not None:
+                self.supervisor.register_slot(rid)
+        return rid
+
+    def remove_replica(self, replica_id: int, reason: str = "scale_down",
+                       timeout_s: float = 30.0) -> bool:
+        """Shrink the fleet by one (docs/SERVING.md "Elastic
+        autoscaling"). Order matters for safety: the supervisor slot is
+        retired FIRST (a pending restart is cancelled; one already
+        building drops its replacement — no resurrection race), then
+        the replica drains WITH evacuation — resident sequences are
+        handed back with their KV staged for re-import elsewhere (or
+        re-prefilled from prompt + delivered tokens), lossless under
+        greedy decoding either way — and only then is it unlinked and
+        stopped. Refuses to remove the last (or last accepting, or last
+        accepting decode-capable) replica: all-replicas-removed is
+        impossible by construction."""
+        with self._fleet_lock:
+            if self._closed:
+                raise RuntimeError("frontend is shut down")
+            target = self.router.replica_by_id(replica_id)
+            if target is None:
+                raise KeyError(f"no replica {replica_id}")
+            others = [r for r in self.router.replicas if r is not target]
+            if not others:
+                raise ValueError("cannot remove the last replica")
+            if target.accepting:
+                if not any(r.accepting for r in others):
+                    raise ValueError("cannot remove the last accepting "
+                                     "replica")
+                if self._disagg is not None \
+                        and target.role in ("decode", "mixed") \
+                        and not any(r.accepting
+                                    and r.role in ("decode", "mixed")
+                                    for r in others):
+                    raise ValueError("cannot remove the last accepting "
+                                     "decode-capable replica")
+            if self.supervisor is not None:
+                self.supervisor.retire_slot(replica_id)
+            self._drain_out(target, timeout_s)
+            # stop what the unlink actually removed: a supervisor
+            # restart that squeaked past the retired check may have
+            # swapped a STARTED replacement into the slot since the
+            # lookup above — stopping only ``target`` would leak it
+            removed = self.router.remove_replica(replica_id)
+            removed.stop(timeout=1.0)
+            if removed is not target:
+                target.stop(timeout=1.0)
+            self._role_overrides.pop(replica_id, None)
+        return True
+
+    def set_replica_role(self, replica_id: int, role: str,
+                         timeout_s: float = 30.0) -> bool:
+        """Re-role one replica prefill<->decode(<->mixed) in place
+        (docs/SERVING.md "Elastic autoscaling"): drain WITH evacuation
+        (cheap — staged handoff + kv_tier keep KV portable), rebuild
+        the Replica over the same engine (fresh one only if the worker
+        wedged) with the new role's scheduler shape, and swap it into
+        the same slot. Supervision is suspended for the slot during the
+        swap and re-registered after. False when the replica already
+        has the role."""
+        if self._disagg is None:
+            raise ValueError("set_replica_role requires "
+                             "disaggregation.enabled")
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(f"unknown replica role {role!r}")
+        if role == "prefill" and not self._disagg.handoff.enabled:
+            raise ValueError("re-roling to prefill requires "
+                             "handoff.enabled")
+        with self._fleet_lock:
+            if self._closed:
+                raise RuntimeError("frontend is shut down")
+            target = self.router.replica_by_id(replica_id)
+            if target is None:
+                raise KeyError(f"no replica {replica_id}")
+            old_role = target.role
+            if old_role == role:
+                return False
+            if old_role in ("decode", "mixed") and role == "prefill" \
+                    and not any(r.accepting
+                                and r.role in ("decode", "mixed")
+                                for r in self.router.replicas
+                                if r is not target):
+                raise ValueError("re-role would leave no accepting "
+                                 "decode-capable replica")
+            suspended = (self.supervisor.retire_slot(replica_id)
+                         if self.supervisor is not None else False)
+            self._role_overrides[replica_id] = role
+            try:
+                self._drain_out(target, timeout_s)
+                if target.thread.is_alive():
+                    # wedged mid-drain: the stuck thread owns the old
+                    # engine — only a fresh one is safe
+                    if self._engine_factory is None:
+                        raise RuntimeError(
+                            f"replica {replica_id} wedged during "
+                            "re-role drain and no engine_factory exists")
+                    engine = self._engine_factory(replica_id)
+                else:
+                    engine = getattr(target.engine, "_ft_inner",
+                                     target.engine)
+                replacement = self._build_replica(replica_id, engine)
+                displaced = self.router.replace_replica(replica_id,
+                                                        replacement)
+                # the slot is retired during the swap, so nothing else
+                # can have removed it; stop whatever was displaced (and
+                # the drained target, if a racing swap displaced it
+                # first)
+                if displaced is not None:
+                    displaced.stop(timeout=1.0)
+                if displaced is not target:
+                    target.stop(timeout=1.0)
+            except Exception:
+                self._role_overrides[replica_id] = old_role
+                raise
+            finally:
+                if suspended:
+                    self.supervisor.register_slot(replica_id)
+        return True
+
+    def _drain_out(self, replica, timeout_s: float) -> None:
+        """Evacuate + wait for a replica's worker to exit (no-op for a
+        DEAD/STOPPED replica — its requests already failed over)."""
+        from .replica import ReplicaState
+
+        if replica.state in (ReplicaState.DEAD, ReplicaState.STOPPED):
+            return
+        replica.request_evacuation(self._evacuate_handback)
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while replica.thread.is_alive() \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+    def _evacuate_handback(self, req: ServingRequest, payload,
+                           replica_id: int) -> None:
+        """Evacuation hand-back (runs on the draining replica's worker
+        thread): re-queue the request — with its exported KV staged for
+        import on the destination when available, marked so the import
+        side keeps it out of the disagg handoff counters — or settle it
+        if cancel/deadline/shutdown already claimed it."""
+        if (self._closed or req.cancel_requested.is_set()
+                or req.expired()):
+            if req.cancel_requested.is_set():
+                req.finish(RequestState.CANCELLED, FinishReason.CANCELLED)
+                self.metrics.counter("requests_cancelled").inc()
+            elif req.expired():
+                req.finish(RequestState.EXPIRED, FinishReason.DEADLINE)
+                self.metrics.counter("requests_expired").inc()
+            else:
+                req.finish(RequestState.REJECTED, "draining")
+                self.metrics.counter("requests_shed").inc()
+            return
+        if payload is not None:
+            payload["evacuated"] = True
+            if self._evacuation_stager().try_stage(req, payload):
+                req.handoff_t = time.monotonic()
+            # else: staging budget full — the payload is dropped and
+            # the request re-prefills (recompute fallback, still
+            # lossless), exactly the disagg handoff degradation
+        self.metrics.counter("requests_evacuated").inc()
+        if req.spans is not None:
+            req.begin_span(self.tracer, "queue",
+                           attrs={"evacuated_from": replica_id})
+        req.state = RequestState.QUEUED
+        req.replica_id = None
+        if not self.admission.requeue(req):
+            # queue closed mid-evacuation: shutdown — terminal
+            req.finish(RequestState.REJECTED, "draining")
+            self.metrics.counter("requests_shed").inc()
+
+    def _evacuation_stager(self):
+        """Staging budget for evacuated KV: the disagg handoff stager
+        when one exists (one shared host-RAM bound + the
+        ``handoff_staged`` gauge), else a lazily-built stager with the
+        same configured budget."""
+        if self._stager is not None:
+            return self._stager
+        if self._evac_stager is None:
+            from .handoff import HandoffStager
+
+            self._evac_stager = HandoffStager(
+                self.config.disaggregation.handoff.max_staged,
+                self.metrics)
+        return self._evac_stager
+
+    def fleet_signals(self):
+        """One consistent elasticity-signal snapshot for the
+        :class:`~deepspeed_tpu.serving.autoscaler.FleetController`."""
+        from .autoscaler import FleetSignals, ReplicaInfo
+
+        parked = (set(self.supervisor.parked_ids())
+                  if self.supervisor is not None else set())
+        infos = tuple(
+            ReplicaInfo(r.replica_id, getattr(r, "role", "mixed"),
+                        r.accepting, r.replica_id in parked,
+                        r.outstanding_prefill_tokens,
+                        r.outstanding_decode_tokens)
+            for r in self.router.replicas)
+        burn = 0.0
+        if self.alerts is not None:
+            for s in self.alerts.status().values():
+                burn = max(burn, s["burn_slow"])
+        dis = self._disagg
+        return FleetSignals(
+            queue_depth=len(self.admission), replicas=infos,
+            burn_slow_max=burn,
+            prefill_token_cost=(dis.prefill_token_cost
+                                if dis is not None else 1.0),
+            decode_token_cost=(dis.decode_token_cost
+                               if dis is not None else 1.0),
+            disaggregated=dis is not None)
+
+    def set_proactive_brownout(self, fraction: Optional[float]) -> None:
+        """Autoscaler brownout actuator: degrade (or restore, with
+        ``None``) the admission queue's effective capacity fraction."""
+        self.admission.set_proactive_fraction(fraction)
+
     # ---------------------------------------------------------- lifecycle
     def stream(self, handle: RequestHandle, timeout: Optional[float] = None):
         return handle.stream(timeout=timeout)
@@ -685,6 +975,15 @@ class ServingFrontend:
                     else None),
             "alerts_firing": (self.alerts.firing()
                               if self.alerts is not None else []),
+            # elastic autoscaling (docs/SERVING.md "Elastic
+            # autoscaling"): what the controller wants vs has, its
+            # action tally and cost ledger; None on static fleets
+            "autoscaler": (dict(self.autoscaler.stats(),
+                                replicas_target=snap.get("replicas_target",
+                                                         0.0),
+                                brownout_proactive=bool(snap.get(
+                                    "brownout_proactive_active", 0.0)))
+                           if self.autoscaler is not None else None),
             "events": self.journal.events(limit=recent_events),
         }
         return report
@@ -721,6 +1020,15 @@ class ServingFrontend:
                 lines.append(
                     f"window[{window_s:.0f}s] {name}: n={w['count']} "
                     f"p50={w['p50'] * 1e3:.1f}ms p95={w['p95'] * 1e3:.1f}ms")
+        if r["autoscaler"] is not None:
+            a = r["autoscaler"]
+            lines.append(
+                f"autoscaler: target={a['replicas_target']:.0f} "
+                f"ups={a['scale_ups']} downs={a['scale_downs']} "
+                f"reroles={a['reroles']} "
+                f"replica_s={a['replica_seconds']:.1f}"
+                + ("  PROACTIVE-BROWNOUT" if a["brownout_proactive"]
+                   else ""))
         if r["slo"] is not None:
             for name, s in sorted(r["slo"].items()):
                 state = "FIRING" if s["firing"] else "ok"
@@ -751,7 +1059,20 @@ class ServingFrontend:
         still queued and stop."""
         if self._closed:
             return
-        self._closed = True
+        # the closed flip happens under the fleet lock: a membership
+        # change already in flight (add_replica building an engine on
+        # the autoscaler worker) completes and installs BEFORE the flag
+        # flips — its replica is then in the list the teardown below
+        # stops — while any later attempt sees _closed and aborts. A
+        # post-shutdown install that would leak a live worker is
+        # impossible either way.
+        with self._fleet_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self.autoscaler is not None:
+            # no membership changes may race the teardown below
+            self.autoscaler.stop()
         timeout = timeout if timeout is not None else self.config.drain_timeout_s
         deadline = time.monotonic() + timeout
         if drain:

@@ -383,7 +383,12 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # while the fleet was under preemption pressure (counted
               # separately from brownout sheds)
               "sequences_preempted", "sequences_resumed",
-              "requests_shed_preempt_pressure"):
+              "requests_shed_preempt_pressure",
+              # elastic autoscaling (docs/SERVING.md "Elastic
+              # autoscaling"): requests handed off a draining replica
+              # during removal/re-role (staged-KV or re-prefill resume,
+              # both lossless under greedy decoding)
+              "requests_evacuated"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -415,7 +420,15 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # admission overhaul (docs/SERVING.md "Admission and
               # preemption"): blocks the pending reservation head is
               # short of; device-block footprint of parked sequences
-              "queue_wait_blocks", "preempted_resident_blocks"):
+              "queue_wait_blocks", "preempted_resident_blocks",
+              # elastic autoscaling (docs/SERVING.md "Elastic
+              # autoscaling"): the fleet size the controller wants
+              # (static fleets pin it to the boot size), the accepting
+              # replica count per role — fleet shape pre-traffic — and
+              # the proactive (budget-burn-driven) brownout flag
+              "replicas_target", "replicas_role_prefill",
+              "replicas_role_decode", "replicas_role_mixed",
+              "brownout_proactive_active"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md

@@ -43,6 +43,12 @@ class AdmissionQueue:
         # (0 = brownout disabled, the historical behavior)
         self.brownout_threshold = float(brownout_threshold)
         self._healthy_frac = 1.0
+        # proactive brownout feed (docs/SERVING.md "Elastic
+        # autoscaling"): the autoscaler degrades this fraction on
+        # slow-window budget burn BEFORE the fast+slow alert fires; the
+        # effective capacity fraction is min(healthy, proactive). 1.0 =
+        # inactive — the historical behavior byte for byte.
+        self._proactive_frac = 1.0
         self._brownout = False
         # preemption pressure (docs/SERVING.md "Admission and
         # preemption"): set by the frontend's observability tick while
@@ -164,29 +170,57 @@ class AdmissionQueue:
                                f"/{self.max_depth}")
 
     # ------------------------------------------------------------ brownout
+    def _effective_frac(self) -> float:
+        """Capacity fraction the brownout math runs on: the router's
+        healthy fraction degraded further by any proactive
+        (budget-burn-driven) fraction the autoscaler feeds."""
+        return min(self._healthy_frac, self._proactive_frac)
+
     def _effective_depth(self) -> int:
         """Depth bound under the current health: full ``max_depth`` in
-        normal operation, shrunk proportionally to the healthy-capacity
-        fraction during brownout (a half-dead fleet gets half the
-        backlog, so queue-wait stays bounded instead of doubling)."""
+        normal operation, shrunk proportionally to the effective
+        capacity fraction during brownout (a half-dead fleet gets half
+        the backlog, so queue-wait stays bounded instead of doubling)."""
         if not self._brownout:
             return self.max_depth
-        return max(1, int(math.ceil(self.max_depth * self._healthy_frac)))
+        return max(1, int(math.ceil(self.max_depth
+                                    * self._effective_frac())))
 
-    def set_healthy_fraction(self, frac: float) -> None:
+    def set_proactive_fraction(self, frac: Optional[float]) -> None:
+        """Autoscaler feed (docs/SERVING.md "Elastic autoscaling"): a
+        degraded capacity fraction derived from slow-window error-budget
+        burn, applied BEFORE the fast+slow alert would fire. Combined
+        with the router's healthy fraction by min(); proactive brownout
+        is active whenever this fraction is below 1.0, regardless of
+        ``brownout_threshold`` (which gates only the replica-death
+        path). ``None`` or 1.0 deactivates."""
+        frac = 1.0 if frac is None else max(0.0, min(1.0, float(frac)))
+        with self._lock:
+            if frac == self._proactive_frac:
+                return
+            self._proactive_frac = frac
+            healthy = self._healthy_frac
+        self.set_healthy_fraction(healthy, _force=True)
+
+    def set_healthy_fraction(self, frac: float, _force: bool = False) -> None:
         """Router health sweep reports healthy/total replica capacity.
-        Below ``brownout_threshold`` the queue enters brownout: the depth
-        bound shrinks and already-queued lowest-urgency work is shed with
+        Below ``brownout_threshold`` — or whenever a proactive fraction
+        below 1.0 is fed — the queue enters brownout: the depth bound
+        shrinks and already-queued lowest-urgency work is shed with
         reason "brownout" — graceful degradation sacrifices the least
         important work explicitly instead of timing everything out."""
-        if self.brownout_threshold <= 0.0:
+        if self.brownout_threshold <= 0.0 and not _force \
+                and self._proactive_frac >= 1.0:
             return
         shed: List[ServingRequest] = []
         transition = None
         with self._lock:
             self._healthy_frac = max(0.0, min(1.0, float(frac)))
             was = self._brownout
-            self._brownout = self._healthy_frac < self.brownout_threshold
+            self._brownout = (
+                (self.brownout_threshold > 0.0
+                 and self._effective_frac() < self.brownout_threshold)
+                or self._proactive_frac < 1.0)
             if was != self._brownout:
                 transition = self._brownout
                 if self.metrics is not None:

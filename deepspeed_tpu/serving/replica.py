@@ -137,6 +137,12 @@ class Replica:
         self._out_prefill = 0
         self._out_decode = 0
         self._stop = threading.Event()
+        # elastic autoscaling (docs/SERVING.md "Elastic autoscaling"):
+        # set by request_evacuation() — the worker loop hands every
+        # resident request back through this callback (staged KV where
+        # exportable) so a draining replica can be removed/re-roled
+        # without waiting out its in-flight decodes
+        self._evacuate_cb: Optional[Callable] = None
         # monotonic time of the last completed loop iteration; a worker
         # stuck inside engine.put stops updating it — that's the wedge
         # signal check_health() reads (a blocked thread can't self-report)
@@ -226,6 +232,41 @@ class Replica:
         """Stop accepting; in-flight requests run to completion."""
         if self.state == ReplicaState.HEALTHY:
             self.state = ReplicaState.DRAINING
+
+    def request_evacuation(self, handback: Callable) -> None:
+        """Fast drain for removal/re-role (docs/SERVING.md "Elastic
+        autoscaling"): stop accepting AND hand every resident request
+        back through ``handback(req, payload, replica_id)`` on the next
+        worker iteration instead of waiting for its decode to finish.
+        ``payload`` is a staged-KV export (resume-by-import on the
+        destination) for fully-prefilled sequences, ``None`` otherwise
+        (the destination re-prefills prompt + delivered tokens —
+        lossless under greedy decoding either way). Runs ON the worker
+        thread: engine access stays race-free, and once everything is
+        handed back the DRAINING loop exits on its own."""
+        self.drain()
+        self._evacuate_cb = handback
+
+    def _do_evacuate(self) -> None:
+        """Worker-thread evacuation pass (see request_evacuation)."""
+        cb = self._evacuate_cb
+        for uid, req in list(self._active.items()):
+            with self._lock:
+                if uid in self._failed_uids:
+                    continue        # a failure path already took it
+                self._failed_uids.add(uid)
+                self._outstanding = max(0, self._outstanding
+                                        - req.outstanding_tokens)
+                self._discharge_locked(req)
+            self._active.pop(uid, None)
+            payload = None
+            try:
+                payload = self.scheduler.evacuate(uid)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning(f"serving replica {self.replica_id}: "
+                               f"evacuation of request {uid} failed "
+                               f"({e!r}); re-prefilling elsewhere")
+            cb(req, payload, self.replica_id)
 
     def stop(self, timeout: Optional[float] = 5.0) -> None:
         self._stop.set()
@@ -326,11 +367,16 @@ class Replica:
             # KV handoff import (docs/SERVING.md "Disaggregated
             # serving"): a staged request's prompt KV was exported by a
             # prefill-role replica — adopt the blocks and resume at the
-            # first decode token. Any import failure (representation
-            # mismatch, KV pressure, engine fault) degrades to the
-            # recompute path below: re-prefill instead of crash.
+            # first decode token. Evacuated requests (docs/SERVING.md
+            # "Elastic autoscaling") ride the same path with their KV
+            # covering prompt + delivered tokens, hence resume_prompt()
+            # below (identical to prompt_tokens for a fresh handoff).
+            # Any import failure (representation mismatch, KV pressure,
+            # engine fault) degrades to the recompute path below:
+            # re-prefill instead of crash.
             payload = req.take_staged()
             if payload is not None:
+                resume = req.resume_prompt()
                 try:
                     # reservation admission without preemption cannot
                     # repair an import over-commitment later, so the
@@ -346,7 +392,7 @@ class Replica:
                                             "admission_preemption_enabled",
                                             False)):
                         bs = ecfg.kv_block_size
-                        total = -(-(len(req.prompt_tokens)
+                        total = -(-(len(resume)
                                     + req.remaining_new_tokens) // bs)
                         if total > self.engine.reservation_headroom():
                             raise RuntimeError(
@@ -354,7 +400,7 @@ class Replica:
                                 "reservation headroom "
                                 f"({self.engine.reservation_headroom()})")
                     self.engine.import_sequence(req.uid, payload,
-                                                tokens=req.prompt_tokens)
+                                                tokens=resume)
                 except Exception as e:
                     logger.warning(
                         f"serving replica {self.replica_id}: KV handoff "
@@ -377,13 +423,18 @@ class Replica:
             req.end_span("handoff")
             if payload is not None:
                 req.handoffs += 1
-                if self.metrics is not None:
+                # evacuation-staged imports (docs/SERVING.md "Elastic
+                # autoscaling") stay out of the disagg handoff counters:
+                # the journal's handoff_staged events must keep matching
+                # handoffs_started exactly (tests/test_journal.py)
+                if self.metrics is not None \
+                        and not payload.get("evacuated"):
                     self.metrics.counter("handoffs_completed").inc()
                     if req.handoff_t is not None:
                         self.metrics.histogram("handoff_s").observe(
                             time.monotonic() - req.handoff_t)
                 self.scheduler.submit_prefilled(
-                    req.uid, req.prompt_tokens, payload["last_logits"],
+                    req.uid, resume, payload["last_logits"],
                     req.remaining_new_tokens, req.eos_token_id,
                     on_token=self._on_token, on_finish=self._on_finish,
                     trace_id=req.trace_id, shed_rank=req.shed_rank)
@@ -579,6 +630,8 @@ class Replica:
             try:
                 self._admit_inbox()
                 self._enforce_slo()
+                if self._evacuate_cb is not None:
+                    self._do_evacuate()
                 if self.scheduler.has_work:
                     self._busy_since = self._busy_since or time.monotonic()
                     if self._faults is not None:

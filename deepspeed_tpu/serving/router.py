@@ -56,6 +56,12 @@ class ReplicaRouter:
         # unweighted least-outstanding-tokens router, byte for byte.
         self.disaggregation = disaggregation
         self.replicas = list(replicas)
+        # dynamic membership (docs/SERVING.md "Elastic autoscaling"):
+        # every structural mutation of ``self.replicas`` — add, remove,
+        # restart swap — happens under this lock and rebinds/writes the
+        # list atomically, so lock-free readers (the dispatch loop, the
+        # health sweep, health_report) always see a consistent fleet
+        self._membership_lock = threading.RLock()
         self.admission = admission
         self.metrics = metrics
         # request tracing + periodic flight-recorder metric snapshots
@@ -83,12 +89,16 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------ selection
     def healthy_replicas(self) -> List[Replica]:
+        # one membership snapshot for the whole sweep: counts, gauges
+        # and the brownout fraction must describe the same fleet even
+        # while the autoscaler mutates membership concurrently
+        reps = self.replicas
         out = []
-        for r in self.replicas:
+        for r in reps:
             if r.check_health() == ReplicaState.HEALTHY:
                 out.append(r)
         if self.metrics is not None:
-            live = [r for r in self.replicas
+            live = [r for r in reps
                     if r.state not in (ReplicaState.DEAD,
                                        ReplicaState.STOPPED)]
             self.metrics.gauge("replicas_healthy").set(len(out))
@@ -98,9 +108,15 @@ class ReplicaRouter:
                 sum(r.outstanding_prefill_tokens for r in live))
             self.metrics.gauge("outstanding_decode_tokens").set(
                 sum(r.outstanding_decode_tokens for r in live))
+            # fleet-shape gauges (docs/SERVING.md "Elastic
+            # autoscaling"): accepting replicas per role, refreshed on
+            # the same sweep — and from the same membership snapshot —
+            # that feeds replicas_healthy
+            for role, n in self.role_census(reps).items():
+                self.metrics.gauge(f"replicas_role_{role}").set(n)
         # brownout feed: the queue shrinks and sheds lowest-urgency work
         # when this fraction drops below its threshold (no-op otherwise)
-        self.admission.set_healthy_fraction(len(out) / len(self.replicas))
+        self.admission.set_healthy_fraction(len(out) / max(1, len(reps)))
         return out
 
     @staticmethod
@@ -191,6 +207,21 @@ class ReplicaRouter:
                     else can_prefill)
         return accept
 
+    def role_census(self, replicas=None) -> dict:
+        """Accepting-replica count per role — the fleet-shape answer the
+        autoscaler and the ``replicas_role_{prefill,decode,mixed}``
+        gauges read (docs/SERVING.md "Elastic autoscaling"). Every role
+        key is always present (zero-valued when empty) so dashboards
+        see the fleet shape before traffic. ``replicas`` lets the
+        health sweep pass its own membership snapshot so all its gauges
+        describe the same fleet."""
+        census = {"prefill": 0, "decode": 0, "mixed": 0}
+        for r in (self.replicas if replicas is None else replicas):
+            if r.accepting:
+                role = getattr(r, "role", "mixed")
+                census[role] = census.get(role, 0) + 1
+        return census
+
     def drain_replica(self, replica_id: int) -> None:
         for r in self.replicas:
             if r.replica_id == replica_id:
@@ -198,13 +229,62 @@ class ReplicaRouter:
                 return
         raise KeyError(f"no replica {replica_id}")
 
-    def replace_replica(self, index: int, replacement: Replica) -> None:
-        """Supervisor restart hand-off: swap the replica at ``index`` and
-        start the replacement. The slot assignment is atomic (list item
-        write); in-flight iterations over ``self.replicas`` see either
-        the corpse (not accepting) or the replacement."""
-        self.replicas[index] = replacement
-        replacement.start()
+    # ----------------------------------------------------------- membership
+    def replica_by_id(self, replica_id: int) -> Optional[Replica]:
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        return None
+
+    def add_replica(self, replica: Replica) -> None:
+        """Grow the fleet by one (docs/SERVING.md "Elastic
+        autoscaling"): atomic list rebind + start. Replica ids must be
+        unique — the frontend allocates them monotonically."""
+        with self._membership_lock:
+            if self.replica_by_id(replica.replica_id) is not None:
+                raise ValueError(f"replica id {replica.replica_id} "
+                                 "already in the fleet")
+            self.replicas = self.replicas + [replica]
+        replica.start()
+
+    def remove_replica(self, replica_id: int) -> Replica:
+        """Shrink the fleet by one: atomic list rebind; the caller owns
+        draining/evacuating/stopping the removed replica. Refuses to
+        empty the fleet — all-replicas-removed is impossible by
+        construction (the ``ReplicaRouter needs at least one replica``
+        invariant holds for the fleet's whole life, not just boot)."""
+        with self._membership_lock:
+            reps = list(self.replicas)
+            for i, r in enumerate(reps):
+                if r.replica_id == replica_id:
+                    if len(reps) == 1:
+                        raise ValueError(
+                            "cannot remove the last replica — the fleet "
+                            "must keep at least one")
+                    del reps[i]
+                    self.replicas = reps
+                    return r
+        raise KeyError(f"no replica {replica_id}")
+
+    def replace_replica(self, replica_id: int,
+                        replacement: Replica) -> Optional[Replica]:
+        """Supervisor restart / re-role hand-off: swap the replica with
+        ``replica_id`` and start the replacement. The slot assignment is
+        atomic (list item write under the membership lock); in-flight
+        iterations over ``self.replicas`` see either the corpse (not
+        accepting) or the replacement. Returns the DISPLACED replica —
+        the caller must stop THAT instance, not a stale reference (a
+        concurrent restart may have swapped the slot since the caller
+        looked) — or ``None`` when the id is no longer a member (the
+        slot was retired mid-restart), in which case the caller must
+        DROP the replacement, never start it."""
+        with self._membership_lock:
+            for i, r in enumerate(self.replicas):
+                if r.replica_id == replica_id:
+                    self.replicas[i] = replacement
+                    replacement.start()
+                    return r
+        return None
 
     # ------------------------------------------------------------- dispatch
     def _dispatch(self, req: ServingRequest) -> None:

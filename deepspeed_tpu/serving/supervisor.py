@@ -40,15 +40,20 @@ from .replica import ReplicaState
 
 
 class _Slot:
-    """Supervision state for one replica position in the router."""
+    """Supervision state for one replica id in the router. Ids are the
+    stable identity (dynamic membership means list positions shift —
+    docs/SERVING.md "Elastic autoscaling"); ``retired`` marks a slot the
+    autoscaler removed, so a restart build already in flight knows to
+    drop its replacement instead of resurrecting removed capacity."""
 
-    def __init__(self, index: int, policy: RestartPolicy):
-        self.index = index
+    def __init__(self, replica_id: int, policy: RestartPolicy):
+        self.replica_id = replica_id
         self.policy = policy            # shared backoff/breaker discipline
         self.restart_at: Optional[float] = None
         self.backoff_s = 0.0
         self.restarting = False
         self.parked = False
+        self.retired = False
 
 
 class ReplicaSupervisor:
@@ -69,13 +74,11 @@ class ReplicaSupervisor:
         # become durable queryable events, not just log lines
         self.journal = journal
         self.rng = random.Random(self.config.seed)
-        cfg = self.config
-        self._slots = [
-            _Slot(i, RestartPolicy(
-                cfg.restart_backoff_s, cfg.restart_backoff_max_s,
-                cfg.restart_backoff_jitter, cfg.max_restarts_in_window,
-                cfg.restart_window_s, self.rng))
-            for i in range(len(router.replicas))]
+        # slots keyed by replica id (stable under dynamic membership);
+        # register_slot/retire_slot keep this in step with the router
+        self._slots: dict = {
+            r.replica_id: _Slot(r.replica_id, self._new_policy())
+            for r in router.replicas}
         self._lock = threading.Lock()
         # per-restart records: {"replica", "t_dead", "t_restarted",
         # "backoff_s", "attempt"} — the bench chaos phase's
@@ -93,6 +96,47 @@ class ReplicaSupervisor:
         if self.thread.is_alive():
             self.thread.join(timeout)
 
+    def _new_policy(self) -> RestartPolicy:
+        cfg = self.config
+        return RestartPolicy(
+            cfg.restart_backoff_s, cfg.restart_backoff_max_s,
+            cfg.restart_backoff_jitter, cfg.max_restarts_in_window,
+            cfg.restart_window_s, self.rng)
+
+    # ---------------------------------------------------------- membership
+    def register_slot(self, replica_id: int) -> None:
+        """Supervise a replica the autoscaler just added (fresh backoff/
+        breaker state — a new slot inherits no other slot's crash
+        history)."""
+        with self._lock:
+            if replica_id in self._slots:
+                raise ValueError(f"slot {replica_id} already supervised")
+            self._slots[replica_id] = _Slot(replica_id, self._new_policy())
+
+    def retire_slot(self, replica_id: int) -> bool:
+        """Stop supervising a replica the autoscaler is removing. Any
+        pending restart is cancelled (restart_at cleared) and a restart
+        BUILD already in flight is poisoned via ``slot.retired`` — its
+        replacement is dropped before install, so a restart can never
+        race a removal into a leaked live replica (the PR 5
+        shutdown-race guard extended to per-slot retirement). Recomputes
+        the parked gauges: a retired parked slot stops counting."""
+        with self._lock:
+            slot = self._slots.pop(replica_id, None)
+            if slot is None:
+                return False
+            slot.retired = True
+            slot.restart_at = None
+            self._refresh_parked_locked()
+        return True
+
+    def _refresh_parked_locked(self) -> None:
+        if self.metrics is None:
+            return
+        parked = sum(1 for s in self._slots.values() if s.parked)
+        self.metrics.gauge("replicas_parked").set(parked)
+        self.metrics.gauge("capacity_alarm").set(1.0 if parked else 0.0)
+
     # ------------------------------------------------------------- queries
     def recovery_pending(self) -> bool:
         """True while ANY dead capacity is expected back (a restart is
@@ -100,18 +144,28 @@ class ReplicaSupervisor:
         consults this before failing work with "no_replicas": a
         recoverable fleet holds requests instead of bouncing them."""
         with self._lock:
-            for slot in self._slots:
+            for slot in self._slots.values():
                 if slot.parked:
                     continue
                 if slot.restart_at is not None or slot.restarting:
                     return True
-                if self.router.replicas[slot.index].state == ReplicaState.DEAD:
+                replica = self.router.replica_by_id(slot.replica_id)
+                if replica is not None and \
+                        replica.state == ReplicaState.DEAD:
                     return True
         return False
 
     def parked_count(self) -> int:
         with self._lock:
-            return sum(1 for s in self._slots if s.parked)
+            return sum(1 for s in self._slots.values() if s.parked)
+
+    def parked_ids(self) -> List[int]:
+        """Replica ids of circuit-broken slots — the autoscaler's
+        preferred shrink victims (docs/SERVING.md "Elastic
+        autoscaling")."""
+        with self._lock:
+            return sorted(s.replica_id for s in self._slots.values()
+                          if s.parked)
 
     # ---------------------------------------------------------------- loop
     def _loop(self) -> None:
@@ -126,8 +180,12 @@ class ReplicaSupervisor:
 
     def tick(self, now: Optional[float] = None) -> None:
         now = now if now is not None else time.monotonic()
-        for slot in self._slots:
-            replica = self.router.replicas[slot.index]
+        with self._lock:
+            slots = list(self._slots.values())
+        for slot in slots:
+            replica = self.router.replica_by_id(slot.replica_id)
+            if replica is None or slot.retired:
+                continue            # retired mid-tick: nothing to do
             state = replica.check_health(now)
             if slot.parked or state != ReplicaState.DEAD:
                 continue
@@ -147,8 +205,8 @@ class ReplicaSupervisor:
                 return
             slot.restart_at = now + backoff
             slot.backoff_s = backoff
-        logger.warning(f"serving replica {slot.index} dead (crash {n} in "
-                       f"window); restart in {backoff:.2f}s")
+        logger.warning(f"serving replica {slot.replica_id} dead (crash "
+                       f"{n} in window); restart in {backoff:.2f}s")
 
     def _park_locked(self, slot: _Slot, n_crashes: int) -> None:
         """Circuit breaker: stop restarting a slot that keeps dying —
@@ -157,8 +215,8 @@ class ReplicaSupervisor:
         the cause and restarting the frontend."""
         slot.parked = True
         slot.restart_at = None
-        parked = sum(1 for s in self._slots if s.parked)
-        logger.error(f"serving replica {slot.index} PARKED after "
+        parked = sum(1 for s in self._slots.values() if s.parked)
+        logger.error(f"serving replica {slot.replica_id} PARKED after "
                      f"{n_crashes} crashes in "
                      f"{self.config.restart_window_s:.0f}s window "
                      f"({parked}/{len(self._slots)} slots parked)")
@@ -166,12 +224,12 @@ class ReplicaSupervisor:
             self.metrics.gauge("replicas_parked").set(parked)
             self.metrics.gauge("capacity_alarm").set(1.0)
         if self.journal is not None:
-            self.journal.emit("replica_parked", replica=slot.index,
+            self.journal.emit("replica_parked", replica=slot.replica_id,
                               crashes_in_window=n_crashes,
                               parked_total=parked)
         if self.tracer.enabled:
             self.tracer.begin("replica_parked",
-                              trace_id=f"replica-{slot.index}",
+                              trace_id=f"replica-{slot.replica_id}",
                               attrs={"crashes_in_window": n_crashes}).end()
 
     # ------------------------------------------------------------- restart
@@ -198,7 +256,12 @@ class ReplicaSupervisor:
         with self._lock:
             slot.restarting = True
             slot.restart_at = None
-        old = self.router.replicas[slot.index]
+        rid = slot.replica_id
+        old = self.router.replica_by_id(rid)
+        if old is None:
+            with self._lock:
+                slot.restarting = False
+            return                  # slot removed between tick and here
         t_dead = slot.policy.last_failure_time()
         t_dead = t_dead if t_dead is not None else now
         try:
@@ -209,11 +272,11 @@ class ReplicaSupervisor:
                 try:
                     self.recorder.snapshot_metrics()
                     self.recorder.dump(
-                        reason=f"restart_replica-{slot.index}")
+                        reason=f"restart_replica-{rid}")
                 except Exception:  # pragma: no cover - defensive
                     pass
             if self.engine_factory is not None:
-                engine = self.engine_factory(slot.index)
+                engine = self.engine_factory(rid)
             else:
                 engine = self._salvage_engine(old)
             if engine is None:
@@ -222,27 +285,40 @@ class ReplicaSupervisor:
                 return
             attempt = slot.policy.count()
             span = self.tracer.begin(
-                "replica_restart", trace_id=f"replica-{slot.index}",
+                "replica_restart", trace_id=f"replica-{rid}",
                 attrs={"attempt": attempt,
                        "backoff_s": round(getattr(slot, "backoff_s", 0.0), 4),
                        "fresh_engine": self.engine_factory is not None}) \
                 if self.tracer.enabled else None
-            replacement = self.replica_factory(slot.index, engine)
-            if self._stop.is_set():
-                # shutdown raced the (possibly long, engine-compiling)
-                # build: installing + starting now would leak a live
-                # worker past ServingFrontend.shutdown — drop it instead
+            replacement = self.replica_factory(rid, engine)
+            if self._stop.is_set() or slot.retired:
+                # shutdown OR slot retirement raced the (possibly long,
+                # engine-compiling) build: installing + starting now
+                # would leak a live worker past ServingFrontend.shutdown
+                # / resurrect capacity the autoscaler removed — drop the
+                # replacement instead (it was never started)
                 if span is not None:
                     span.end()
                 return
-            self.router.replace_replica(slot.index, replacement)
-            old.stop(timeout=0.0)
+            displaced = self.router.replace_replica(rid, replacement)
+            if displaced is None:
+                # membership changed underneath us (slot removed): the
+                # replacement has no seat — drop it, never start it
+                if span is not None:
+                    span.end()
+                return
+            # stop what the swap actually displaced (a concurrent swap
+            # could have changed the slot since ``old`` was looked up),
+            # and the looked-up corpse too if they differ
+            displaced.stop(timeout=0.0)
+            if displaced is not old:
+                old.stop(timeout=0.0)
             if span is not None:
                 span.end()
             t_up = time.monotonic()
             with self._lock:
                 self.restart_log.append({
-                    "replica": slot.index, "t_dead": t_dead,
+                    "replica": rid, "t_dead": t_dead,
                     "t_restarted": t_up,
                     "recovery_s": t_up - t_dead,
                     "backoff_s": getattr(slot, "backoff_s", 0.0),
@@ -251,17 +327,17 @@ class ReplicaSupervisor:
                 self.metrics.counter("replica_restarts").inc()
             if self.journal is not None:
                 self.journal.emit(
-                    "replica_restart", replica=slot.index, attempt=attempt,
+                    "replica_restart", replica=rid, attempt=attempt,
                     recovery_s=round(t_up - t_dead, 4),
                     backoff_s=round(getattr(slot, "backoff_s", 0.0), 4),
                     fresh_engine=self.engine_factory is not None)
-            logger.warning(f"serving replica {slot.index} restarted "
+            logger.warning(f"serving replica {rid} restarted "
                            f"(attempt {attempt}, "
                            f"{t_up - t_dead:.2f}s after death)")
         except Exception as e:
             # a failed restart (engine build blew up) counts as a crash:
             # backoff again or trip the breaker — never busy-loop
-            logger.error(f"serving replica {slot.index} restart failed: "
+            logger.error(f"serving replica {rid} restart failed: "
                          f"{e!r}")
             self._on_crash(slot, time.monotonic())
         finally:

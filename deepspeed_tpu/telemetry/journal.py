@@ -73,6 +73,16 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     # the scheduler spilled a running sequence's KV to the tier to make
     # room — blocks freed, the replica it happened on
     "sequence_preempted": frozenset({"uid", "blocks", "replica"}),
+    # elastic autoscaling (docs/SERVING.md "Elastic autoscaling"): the
+    # FleetController grew/shrank the pool (replica added/removed, the
+    # resulting fleet size, and why), flipped a replica's role, or
+    # toggled proactive brownout from slow-window budget burn. Each
+    # fires exactly once per completed controller action — the churn
+    # suite cross-checks against the controller's decision log.
+    "scale_up": frozenset({"replica", "fleet_size", "reason"}),
+    "scale_down": frozenset({"replica", "fleet_size", "reason"}),
+    "replica_reroled": frozenset({"replica", "from_role", "to_role"}),
+    "brownout_proactive": frozenset({"active", "fraction"}),
     # ----------------------------------------------------------- training
     # supervised restart (docs/TRAINING.md "Fault tolerance")
     "train_restart": frozenset({"reason", "attempt", "steps_lost",

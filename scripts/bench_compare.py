@@ -48,6 +48,10 @@ _LOWER_IS_BETTER = (
     # overload phase: sheds under preemption pressure mean the
     # oversubscribed pool ran out of graceful-degradation headroom
     "shed_preempt_pressure",
+    # autoscale phase: replica-seconds are the fleet's cost ledger
+    # (chip-seconds stand-in) — the elastic fleet's whole point is
+    # spending fewer of them at equal SLO attainment
+    "replica_seconds",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
@@ -60,6 +64,9 @@ _HIGHER_IS_BETTER = (
     # overload phase: completed-sequence throughput under sustained
     # oversubscription, and how many requests finished at all
     "completed_per_sec", "completed_on",
+    # autoscale phase: fraction of submitted requests that attained
+    # their SLO (completed under deadline, not shed/failed)
+    "slo_attainment",
 )
 
 

@@ -28,7 +28,13 @@
 #                  phase — ~10x KV overload must sustain zero wedges
 #                  under reservation admission with preempted-and-
 #                  resumed greedy parity and disabled byte-parity
-#                  asserted, while the pre-change stack deadlocks) — wires
+#                  asserted, while the pre-change stack deadlocks,
+#                  or TIER1_PHASE=autoscale for the elastic-autoscaling
+#                  phase — diurnal + bursty replay where the elastic
+#                  fleet must match/beat the static fleet's SLO
+#                  attainment on fewer replica-seconds, scaling up AND
+#                  back down, with greedy parity and autoscaler-disabled
+#                  byte-parity asserted) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

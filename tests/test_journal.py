@@ -226,6 +226,74 @@ def test_train_chaos_journal_exact_once(tmp_path):
     assert kinds.count("train_parked") == 0
 
 
+def test_autoscaling_storm_journal_exact_once():
+    """ISSUE 12 satellite: an autoscaling storm — oscillating load
+    driving hundreds of grow/shrink/re-role/brownout decisions through
+    a deterministic fake-clock controller — journals every completed
+    action EXACTLY once (1:1 against the controller's own decision log,
+    kind for kind, field for field), schema-valid, bounded, monotonic."""
+    from deepspeed_tpu.serving import AutoscalerConfig
+    from deepspeed_tpu.serving.autoscaler import FleetController
+    from deepspeed_tpu.telemetry import OpsJournal
+
+    from test_autoscaler import FakeClock, FakeFleet
+
+    clock = FakeClock()
+    fleet = FakeFleet({0: FakeFleet.rep(role="prefill"),
+                       1: FakeFleet.rep(role="decode")},
+                      disaggregated=True, prefill_cost=1.0,
+                      decode_cost=1.0)
+    journal = OpsJournal(capacity=4096, clock=clock)
+    ctl = FleetController(
+        AutoscalerConfig(enabled=True, min_replicas=1, max_replicas=4,
+                         scale_up_queue_per_replica=4.0,
+                         scale_down_queue_per_replica=0.25,
+                         scale_down_tokens_per_replica=4.0,
+                         up_stable_ticks=1, down_stable_ticks=2,
+                         scale_up_cooldown_s=1.0,
+                         scale_down_cooldown_s=1.0,
+                         rerole_stable_ticks=2, rerole_cooldown_s=3.0,
+                         brownout_burn_threshold=2.0,
+                         brownout_fraction=0.5),
+        fleet, journal=journal, clock=clock, async_actions=False)
+    rng = np.random.default_rng(0)
+    for i in range(600):
+        phase = (i // 40) % 4
+        if phase == 0:          # burst: queue pressure + hot slow burn
+            fleet.queue_depth = int(rng.integers(30, 60))
+            fleet.burn_slow = 3.0
+        elif phase == 1:        # drain: calm queue, burn recovering
+            fleet.queue_depth = 0
+            fleet.burn_slow = 0.5
+        elif phase == 2:        # prefill-heavy mix
+            fleet.queue_depth = 1
+            for r in fleet.replicas.values():
+                r.update(pre=50 if r["role"] == "prefill" else 0, dec=1)
+        else:                   # decode-heavy mix
+            fleet.queue_depth = 1
+            for r in fleet.replicas.values():
+                r.update(dec=50 if r["role"] != "prefill" else 0, pre=1)
+        ctl.tick(clock.advance(1.0))
+    evs = _journal_invariants(journal)
+    assert len(journal) <= journal.capacity
+    log = ctl.decision_log
+    assert len(log) >= 20, "storm drove too few decisions to be a test"
+    kinds = {"scale_up", "scale_down", "replica_reroled",
+             "brownout_proactive"}
+    assert {e["kind"] for e in evs} <= kinds
+    assert {d["action"] for d in log} == {e["kind"] for e in evs}
+    # exactly-once, order-preserving, field-for-field
+    assert len(evs) == len(log)
+    for ev, dec in zip(evs, log):
+        assert ev["kind"] == dec["action"]
+        for field in ev["detail"]:
+            assert ev["detail"][field] == dec[field], (ev, dec)
+    # the fleet never left its bounds, and never lost decode capability
+    assert 1 <= len(fleet.replicas) <= 4
+    assert any(r["role"] in ("decode", "mixed")
+               for r in fleet.replicas.values())
+
+
 def test_journal_stays_bounded_under_event_storm():
     """A pathological storm (far more events than capacity) keeps the
     ring at capacity with the NEWEST events, still schema-valid."""

@@ -464,7 +464,7 @@ def test_bench_schema_validator():
                          "disabled_parity": True, "kv_occupancy": occ}}
     for name in bench._STAMPED_PHASES:
         if name in ("kv_quant", "train_chaos", "disagg", "slo",
-                    "kv_tier", "overload"):
+                    "kv_tier", "overload", "autoscale"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
     good["kv_tier"] = {"tier_on_p50_ttft_ms": 10.7,
@@ -510,7 +510,28 @@ def test_bench_schema_validator():
                         "p99_interactive_tpot_ms": 2.5,
                         "preempt_parity": True, "disabled_parity": True,
                         "kv_occupancy": dict(occ)}
+    good["autoscale"] = {"n_requests": 30, "min_replicas": 1,
+                         "max_replicas": 3, "static_replicas": 3,
+                         "slo_attainment_elastic": 1.0,
+                         "slo_attainment_static": 1.0,
+                         "attainment_ok": True,
+                         "replica_seconds_elastic": 16.2,
+                         "replica_seconds_static": 21.9,
+                         "elastic_beats_static_cost": True,
+                         "scale_ups": 2, "scale_downs": 2, "reroles": 0,
+                         "peak_replicas": 3, "final_replicas": 1,
+                         "requests_evacuated": 0,
+                         "greedy_parity": True, "disabled_parity": True,
+                         "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
+    # autoscale typed checks: bool-for-int rejected, missing named
+    bad_as = dict(good)
+    bad_as["autoscale"] = {"scale_ups": True, "attainment_ok": 1}
+    problems_as = bench.validate_serving_schema(bad_as)
+    assert any("autoscale.scale_ups" in p for p in problems_as)
+    assert any("autoscale.attainment_ok" in p for p in problems_as)
+    assert any("autoscale.greedy_parity: missing" in p
+               for p in problems_as)
     # overload typed checks: bool-for-int rejected, missing fields named
     bad_ov = dict(good)
     bad_ov["overload"] = {"completed_on": True, "zero_wedges": 1}
