@@ -241,20 +241,56 @@ _OCCUPANCY_KEYS = ("total_blocks", "free_blocks", "in_use_blocks",
                    # tiered KV memory (docs/SERVING.md "KV tiering"):
                    # zeros on engines without a tier, same schema
                    "kv_blocks_host_tier", "kv_bytes_host_tier",
-                   "kv_blocks_disk_tier", "kv_bytes_disk_tier")
+                   "kv_blocks_disk_tier", "kv_bytes_disk_tier",
+                   # resident param bytes (docs/SERVING.md "Weight
+                   # quantization"): stamped next to the occupancy
+                   # fields by every phase; quantized share is zero on
+                   # full-precision engines
+                   "param_bytes_total", "param_bytes_quantized")
 _KV_QUANT_KEYS = (("max_concurrent_base", int),
                   ("max_concurrent_int8", int),
+                  # fp8_e4m3 on the reserved kv_quant.dtype surface
+                  # (ISSUE 13): same byte cut, floating relative
+                  # precision — gated on the same ppl/parity bars
+                  ("max_concurrent_fp8", int),
                   ("concurrency_ratio", (int, float)),
                   ("budget_bytes", int),
                   ("ppl_base", (int, float)),
                   ("ppl_int8", (int, float)),
+                  ("ppl_fp8", (int, float)),
                   ("ppl_ratio", (int, float)),
+                  ("ppl_ratio_fp8", (int, float)),
                   ("ppl_gate_ok", bool),
+                  ("ppl_gate_ok_fp8", bool),
                   ("greedy_parity", bool),
                   ("mean_matched_prefix_frac", (int, float)),
+                  ("mean_matched_prefix_frac_fp8", (int, float)),
                   ("disabled_parity", bool))
+# Typed shape of the weight_quant phase (docs/SERVING.md "Weight
+# quantization"): resident param bytes + replicas-per-host-byte-budget
+# on/off, decode TPOT and prefill TTFT on/off, the perplexity gate, and
+# the disabled byte-parity bit the acceptance gates read.
+_WEIGHT_QUANT_KEYS = (("param_bytes_fp32", int),
+                      ("param_bytes_int8", int),
+                      ("weight_compression_x", (int, float)),
+                      ("bytes_gate_ok", bool),
+                      ("host_byte_budget", int),
+                      ("replicas_at_budget_base", int),
+                      ("replicas_at_budget_int8", int),
+                      ("prefill_ttft_base_ms", (int, float)),
+                      ("prefill_ttft_int8_ms", (int, float)),
+                      ("decode_tpot_base_ms", (int, float)),
+                      ("decode_tpot_int8_ms", (int, float)),
+                      ("ppl_base", (int, float)),
+                      ("ppl_int8", (int, float)),
+                      ("ppl_ratio", (int, float)),
+                      ("ppl_gate_ok", bool),
+                      ("mean_matched_prefix_frac", (int, float)),
+                      ("greedy_parity", bool),
+                      ("disabled_parity", bool))
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
+                   "weight_quant",
                    "disagg", "slo", "kv_tier", "overload", "autoscale")
 # Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
 # TTFT comparison with the device pool sized below the prefix working
@@ -360,6 +396,39 @@ _TRAIN_CHAOS_KEYS = (("recovery_time_s", (int, float)),
                      ("urgent_save_s", (int, float)))
 
 
+def _matched_prefix_fracs(base_gens, other_gens):
+    """Per-stream fraction of the base greedy stream matched before the
+    first divergence — the parity-or-bounded report the kv_quant and
+    weight_quant phases share."""
+    fr = []
+    for a, b in zip(base_gens, other_gens):
+        matched = next((i for i, (x, y) in enumerate(zip(a, b))
+                        if x != y), min(len(a), len(b)))
+        fr.append(matched / max(1, len(a)))
+    return fr
+
+
+def _teacher_forced_nll(eng, toks, chunk, uid):
+    """Mean teacher-forced NLL over ``toks`` via verify_width logits —
+    the perplexity-gate measurement the kv_quant and weight_quant phases
+    share (one convention, one place to fix it)."""
+    total, count = 0.0, 0
+    for lo in range(0, len(toks), chunk):
+        ch = toks[lo:lo + chunk]
+        logits = np.asarray(eng.put([uid], [ch], verify_width=len(ch)))[0]
+        for j in range(len(ch)):
+            t = lo + j + 1
+            if t >= len(toks):
+                break
+            row = logits[j].astype(np.float64)
+            m = row.max()
+            lse = m + np.log(np.exp(row - m).sum())
+            total += lse - row[toks[t]]
+            count += 1
+    eng.flush(uid)
+    return total / count
+
+
 def _check_typed_phase(name, phase, keys, problems):
     """Typed per-key check shared by the kv_quant and train_chaos phase
     schemas: missing keys and wrong types are named; a bool where an int
@@ -385,6 +454,11 @@ def validate_serving_schema(serving: dict):
         problems.append("kv_quant: missing or not an object")
     elif "phase_skipped" not in kq:
         _check_typed_phase("kv_quant", kq, _KV_QUANT_KEYS, problems)
+    wq = serving.get("weight_quant")
+    if not isinstance(wq, dict):
+        problems.append("weight_quant: missing or not an object")
+    elif "phase_skipped" not in wq:
+        _check_typed_phase("weight_quant", wq, _WEIGHT_QUANT_KEYS, problems)
     tc = serving.get("train_chaos")
     if not isinstance(tc, dict):
         problems.append("train_chaos: missing or not an object")
@@ -954,9 +1028,10 @@ def bench_serving(on_tpu: bool):
         kq_prompts = [rng.integers(0, cfg.vocab_size, size=plen).tolist()
                       for _ in range(n_req)]
 
-        def build(quant, n_blocks):
+        def build(quant, n_blocks, dtype="int8"):
             pcfg = type(vcfg)(**vars(vcfg))
             pcfg.kv_quant_enabled = quant
+            pcfg.kv_quant_dtype = dtype
             pcfg.kv_blocks = int(n_blocks)
             # admission must be KV-bound: lift the row/token ceilings
             # past anything the pool could admit
@@ -967,8 +1042,8 @@ def bench_serving(on_tpu: bool):
             return InferenceEngineV2(engine.model, params=engine.params,
                                      config=pcfg)
 
-        def peak_concurrency(quant, uid_base):
-            eng = build(quant, nb[quant])
+        def peak_concurrency(quant, uid_base, dtype="int8"):
+            eng = build(quant, nb[quant], dtype)
             sched = ContinuousBatchingScheduler(eng)
             for i, p in enumerate(kq_prompts):
                 sched.submit(uid_base + i, p, max_new_tokens=gen)
@@ -985,34 +1060,26 @@ def bench_serving(on_tpu: bool):
 
         peak_base, blocks_base, done_base = peak_concurrency(False, 110_000)
         peak_int8, blocks_int8, done_int8 = peak_concurrency(True, 120_000)
+        # fp8_e4m3 on the reserved dtype surface (ISSUE 13): same
+        # 1-byte slabs + scale planes, so the same blocks-at-budget —
+        # must sustain the same concurrency and the same quality gates
+        peak_fp8, blocks_fp8, done_fp8 = peak_concurrency(
+            True, 125_000, dtype="fp8_e4m3")
 
         # teacher-forced NLL over one held-out sequence (verify_width
         # logits give every position's next-token distribution)
         nll_toks = rng.integers(0, cfg.vocab_size,
                                 size=4 * nll_chunk).tolist()
 
-        def seq_nll(quant, uid):
-            eng = build(quant, nb[quant])
-            total, count = 0.0, 0
-            for lo in range(0, len(nll_toks), nll_chunk):
-                ch = nll_toks[lo:lo + nll_chunk]
-                logits = np.asarray(
-                    eng.put([uid], [ch], verify_width=len(ch)))[0]
-                for j in range(len(ch)):
-                    t = lo + j + 1
-                    if t >= len(nll_toks):
-                        break
-                    row = logits[j].astype(np.float64)
-                    m = row.max()
-                    lse = m + np.log(np.exp(row - m).sum())
-                    total += lse - row[nll_toks[t]]
-                    count += 1
-            eng.flush(uid)
-            return total / count
+        def seq_nll(quant, uid, dtype="int8"):
+            return _teacher_forced_nll(build(quant, nb[quant], dtype),
+                                       nll_toks, nll_chunk, uid)
 
         ppl_base = float(np.exp(seq_nll(False, 130_000)))
         ppl_int8 = float(np.exp(seq_nll(True, 131_000)))
+        ppl_fp8 = float(np.exp(seq_nll(True, 132_000, dtype="fp8_e4m3")))
         ppl_ratio = ppl_int8 / ppl_base
+        ppl_ratio_fp8 = ppl_fp8 / ppl_base
 
         # greedy divergence (parity-or-bounded) + disabled byte-parity
         par_prompts = kq_prompts[:4]
@@ -1020,13 +1087,13 @@ def bench_serving(on_tpu: bool):
                                     uid_base=140_000, max_new_tokens=gen)
         gens_int8 = greedy_generate(build(True, nb[True]), par_prompts,
                                     uid_base=140_000, max_new_tokens=gen)
+        gens_fp8 = greedy_generate(build(True, nb[True], "fp8_e4m3"),
+                                   par_prompts,
+                                   uid_base=140_000, max_new_tokens=gen)
         gens_off = greedy_generate(build(False, nb[False]), par_prompts,
                                    uid_base=140_000, max_new_tokens=gen)
-        fracs = []
-        for a, b in zip(gens_base, gens_int8):
-            matched = next((i for i, (x, y) in enumerate(zip(a, b))
-                            if x != y), min(len(a), len(b)))
-            fracs.append(matched / max(1, len(a)))
+        fracs = _matched_prefix_fracs(gens_base, gens_int8)
+        fracs_fp8 = _matched_prefix_fracs(gens_base, gens_fp8)
         return {
             "budget_bytes": int(budget_bytes),
             "base_dtype": str(np.dtype(cfg.dtype).name
@@ -1040,16 +1107,150 @@ def bench_serving(on_tpu: bool):
             "max_new_tokens": int(gen),
             "max_concurrent_base": int(peak_base),
             "max_concurrent_int8": int(peak_int8),
+            "max_concurrent_fp8": int(peak_fp8),
             "concurrency_ratio": round(peak_int8 / max(1, peak_base), 3),
             "peak_blocks_in_use": {"base": int(blocks_base),
-                                   "int8": int(blocks_int8)},
-            "all_completed": bool(done_base == n_req == done_int8),
+                                   "int8": int(blocks_int8),
+                                   "fp8": int(blocks_fp8)},
+            "all_completed": bool(done_base == n_req == done_int8
+                                  == done_fp8),
+            "ppl_base": round(ppl_base, 4),
+            "ppl_int8": round(ppl_int8, 4),
+            "ppl_fp8": round(ppl_fp8, 4),
+            "ppl_ratio": round(ppl_ratio, 5),
+            "ppl_ratio_fp8": round(ppl_ratio_fp8, 5),
+            "ppl_gate_ok": bool(abs(ppl_ratio - 1.0) <= 0.05),
+            "ppl_gate_ok_fp8": bool(abs(ppl_ratio_fp8 - 1.0) <= 0.05),
+            "greedy_parity": bool(gens_base == gens_int8),
+            "mean_matched_prefix_frac": round(float(np.mean(fracs)), 4),
+            "mean_matched_prefix_frac_fp8": round(float(np.mean(fracs_fp8)),
+                                                  4),
+            "disabled_parity": bool(gens_base == gens_off),
+        }
+
+    def run_weight_quant_phase():
+        """int8 weight serving (docs/SERVING.md "Weight quantization"):
+        the whole param tree quantized once at engine build, every
+        matmul running from the quantized representation. Headline
+        numbers: resident param bytes (the replicas-per-host-byte-budget
+        ledger) on/off, decode TPOT + prefill TTFT on/off, the
+        teacher-forced perplexity ratio (gate <= 1.01), greedy
+        divergence, and the disabled byte-parity bit (asserted).
+
+        The phase builds its own model with a small tied embedding so
+        the matmul weights dominate resident bytes the way they do at
+        production scale — the shared bench model's embedding table
+        would otherwise mask the cut it is measuring."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.testing import greedy_generate
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+
+        if on_tpu:
+            wq_cfg = TransformerConfig(
+                vocab_size=2048, hidden_size=1024, intermediate_size=4096,
+                num_layers=8, num_heads=16, max_seq_len=1024,
+                norm="rmsnorm", activation="silu", position="rope",
+                dtype=jnp.bfloat16)
+            plen, gen_n, nll_chunk, decode_n = 256, 32, 64, 64
+            host_budget = 8 << 30           # 8 GiB of host param budget
+        else:
+            wq_cfg = TransformerConfig(
+                vocab_size=128, hidden_size=128, intermediate_size=512,
+                num_layers=4, num_heads=4, max_seq_len=256,
+                norm="rmsnorm", activation="silu", position="rope")
+            plen, gen_n, nll_chunk, decode_n = 24, 8, 16, 16
+            host_budget = 16 << 20          # 16 MiB
+        wq_model = CausalLM(wq_cfg)
+        wq_params = wq_model.init(jax.random.PRNGKey(7))
+
+        def build(wq=None):
+            """wq=None leaves the config untouched (config-absent arm);
+            True/False set the knob explicitly — the disabled-parity
+            comparison is config-absent vs enabled:false, the kv_tier
+            phase idiom, so the gate is not a tautology."""
+            pcfg = type(vcfg)(**vars(vcfg))
+            if wq is not None:
+                pcfg.weight_quant_enabled = wq
+            return InferenceEngineV2(wq_model, params=wq_params,
+                                     config=pcfg)
+
+        eng_base, eng_int8 = build(), build(True)
+        pb_base = int(eng_base.param_stats()["param_bytes_total"])
+        pb_int8 = int(eng_int8.param_stats()["param_bytes_total"])
+        compression = pb_base / max(1, pb_int8)
+
+        def timed(eng, uid_base):
+            """median chunked-prefill TTFT + decode TPOT, warm."""
+            chunk_w = vcfg.max_chunk_tokens
+            ttfts = []
+            for i in range(3):
+                uid = uid_base + i
+                prompt = rng.integers(0, wq_cfg.vocab_size,
+                                      size=plen).tolist()
+                t0 = time.perf_counter()
+                for lo in range(0, plen, chunk_w):
+                    logits = eng.put([uid], [prompt[lo:lo + chunk_w]])
+                np.asarray(logits)
+                ttfts.append(time.perf_counter() - t0)
+            uids = [uid_base + i for i in range(3)]
+            nxt = [[int(rng.integers(0, wq_cfg.vocab_size))] for _ in uids]
+            t0 = time.perf_counter()
+            for _ in range(decode_n):
+                logits = eng.put(uids, nxt)
+            np.asarray(logits)
+            tpot = (time.perf_counter() - t0) / decode_n
+            for uid in uids:
+                eng.flush(uid)
+            # drop the compile-bearing first sample: median of the rest
+            return float(np.median(ttfts[1:])), tpot
+
+        timed(eng_base, 200_000)            # warm both compile caches
+        timed(eng_int8, 210_000)
+        ttft_base, tpot_base = timed(eng_base, 220_000)
+        ttft_int8, tpot_int8 = timed(eng_int8, 230_000)
+
+        nll_toks = rng.integers(0, wq_cfg.vocab_size,
+                                size=4 * nll_chunk).tolist()
+        ppl_base = float(np.exp(_teacher_forced_nll(eng_base, nll_toks,
+                                                    nll_chunk, 240_000)))
+        ppl_int8 = float(np.exp(_teacher_forced_nll(eng_int8, nll_toks,
+                                                    nll_chunk, 241_000)))
+        ppl_ratio = ppl_int8 / ppl_base
+
+        par_prompts = [rng.integers(0, wq_cfg.vocab_size,
+                                    size=plen).tolist() for _ in range(4)]
+        gens_base = greedy_generate(build(), par_prompts,
+                                    uid_base=250_000, max_new_tokens=gen_n)
+        gens_int8 = greedy_generate(build(True), par_prompts,
+                                    uid_base=250_000, max_new_tokens=gen_n)
+        gens_off = greedy_generate(build(False), par_prompts,
+                                   uid_base=250_000, max_new_tokens=gen_n)
+        fracs = _matched_prefix_fracs(gens_base, gens_int8)
+        # the acceptance gates (asserted, not just reported): bytes cut
+        # >= 3.5x vs fp32, ppl ratio <= 1.01, and config-absent vs
+        # enabled:false greedy byte-parity (distinct config arms)
+        assert gens_base == gens_off, \
+            "weight_quant enabled:false diverged from the config-absent " \
+            "engine (disabled byte-parity broken)"
+        return {
+            "param_bytes_fp32": pb_base,
+            "param_bytes_int8": pb_int8,
+            "weight_compression_x": round(compression, 3),
+            "bytes_gate_ok": bool(compression >= 3.5),
+            "host_byte_budget": int(host_budget),
+            "replicas_at_budget_base": int(host_budget // pb_base),
+            "replicas_at_budget_int8": int(host_budget // pb_int8),
+            "prefill_ttft_base_ms": round(ttft_base * 1e3, 3),
+            "prefill_ttft_int8_ms": round(ttft_int8 * 1e3, 3),
+            "decode_tpot_base_ms": round(tpot_base * 1e3, 3),
+            "decode_tpot_int8_ms": round(tpot_int8 * 1e3, 3),
             "ppl_base": round(ppl_base, 4),
             "ppl_int8": round(ppl_int8, 4),
             "ppl_ratio": round(ppl_ratio, 5),
-            "ppl_gate_ok": bool(abs(ppl_ratio - 1.0) <= 0.05),
-            "greedy_parity": bool(gens_base == gens_int8),
+            "ppl_gate_ok": bool(abs(ppl_ratio - 1.0) <= 0.01),
             "mean_matched_prefix_frac": round(float(np.mean(fracs)), 4),
+            "greedy_parity": bool(gens_base == gens_int8),
             "disabled_parity": bool(gens_base == gens_off),
         }
 
@@ -2022,7 +2223,16 @@ def bench_serving(on_tpu: bool):
     # phase-resumable dispatch: per-phase budgets + artifact cache +
     # skip/degrade stamps (PhaseRunner docstring); every result carries
     # the shared engine's KV occupancy snapshot
-    runner = PhaseRunner(stamp=lambda: engine.occupancy())
+    def stamp():
+        # KV occupancy + resident param bytes (docs/SERVING.md "Weight
+        # quantization"): every phase's record carries both ledgers
+        occ = engine.occupancy()
+        ps = engine.param_stats()
+        occ["param_bytes_total"] = int(ps["param_bytes_total"])
+        occ["param_bytes_quantized"] = int(ps["param_bytes_quantized"])
+        return occ
+
+    runner = PhaseRunner(stamp=stamp)
     result = {}
     result.update(runner.run("base", run_base_phase))
     result["ragged"] = runner.run("ragged", run_ragged_wrapped)
@@ -2048,6 +2258,12 @@ def bench_serving(on_tpu: bool):
     # int8 KV quantization phase (docs/SERVING.md "KV quantization"):
     # concurrency at a fixed KV byte budget + perplexity/parity gates
     result["kv_quant"] = runner.run("kv_quant", run_kv_quant_phase)
+    # int8/fp8 weight serving phase (docs/SERVING.md "Weight
+    # quantization"): resident param bytes + replicas-per-host-budget
+    # on/off, decode TPOT + prefill TTFT, ppl gate <= 1.01, disabled
+    # byte-parity asserted
+    result["weight_quant"] = runner.run("weight_quant",
+                                        run_weight_quant_phase)
     # disaggregated prefill/decode phase (docs/SERVING.md "Disaggregated
     # serving"): mixed long-prefill + interactive traffic, 2 prefill +
     # 2 decode vs 4 mixed — p95 interactive TTFT/TPOT on/off, handoff

@@ -33,6 +33,10 @@ class RaggedInferenceEngineConfig:
                  kv_quant_enabled: bool = False,
                  kv_quant_dtype: str = "int8",
                  kv_quant_scale_granularity: str = "block",
+                 weight_quant_enabled: bool = False,
+                 weight_quant_dtype: str = "int8",
+                 weight_quant_block: int = 128,
+                 weight_quant_skip: Optional[Sequence[str]] = None,
                  kv_tier_enabled: bool = False,
                  kv_tier_host_bytes: int = 64 * 1024 * 1024,
                  kv_tier_disk_path: Optional[str] = None,
@@ -58,6 +62,16 @@ class RaggedInferenceEngineConfig:
         self.kv_quant_enabled = kv_quant_enabled
         self.kv_quant_dtype = kv_quant_dtype
         self.kv_quant_scale_granularity = kv_quant_scale_granularity
+        # int8/fp8 weight serving (docs/SERVING.md "Weight
+        # quantization"): the CausalLM param tree is quantized ONCE at
+        # engine build (inference/v2/weight_quant.py) and every matmul
+        # runs from the quantized tree — ~3.9x fewer resident param
+        # bytes vs fp32 and the per-step HBM weight stream cut with it
+        self.weight_quant_enabled = weight_quant_enabled
+        self.weight_quant_dtype = weight_quant_dtype
+        self.weight_quant_block = weight_quant_block
+        self.weight_quant_skip = (list(weight_quant_skip)
+                                  if weight_quant_skip is not None else [])
         # tiered KV memory (docs/SERVING.md "KV tiering"): spill evicted
         # prefix-cache blocks to a bounded host-RAM tier (optionally
         # overflowing to disk) and restore them on a later prefix match
@@ -108,28 +122,50 @@ class InferenceEngineV2:
         cache_sharding = None
         scale_sharding = None
         jmesh = None
+        tp = 1
         if mesh is not None:
             from ...parallel import topology as topo_mod
-            from ...parallel.sharding import ZeroShardingPlan
-            from jax.sharding import NamedSharding, PartitionSpec as P
 
             topo_obj = (mesh if isinstance(mesh, topo_mod.MeshTopology)
                         else topo_mod.MeshTopology(mesh))
             jmesh = topo_obj.mesh
             # raw meshes may lack a tensor axis entirely → unsharded serving
-            if dict(jmesh.shape).get("tensor", 1) > 1:
-                spec_tree = (model.param_specs()
-                             if hasattr(model, "param_specs") else None)
-                plan = ZeroShardingPlan(topo_obj, 0, spec_tree)
-                shardings = plan.params(jax.eval_shape(lambda: params))
-                params = jax.tree.map(jax.device_put, params, shardings)
-                cache_sharding = NamedSharding(
-                    jmesh, P(None, None, "tensor", None, None))
-                # kv_quant scale planes [L, NB, KH] follow the pools'
-                # kv-head split (paged_model extends the shard_map specs)
-                scale_sharding = NamedSharding(jmesh, P(None, None, "tensor"))
-            else:
+            tp = dict(jmesh.shape).get("tensor", 1)
+            if tp <= 1:
                 jmesh = None
+                tp = 1
+        # int8/fp8 weight serving (docs/SERVING.md "Weight
+        # quantization"): quantize the param tree ONCE, before TP
+        # placement — so the scale planes are computed from the full
+        # weights and then shard with their weight shards (the per-leaf
+        # block divides the per-shard width; weight_quant.py).
+        self._weight_quant_stats = None
+        if self.config.weight_quant_enabled:
+            from .weight_quant import quantize_weights
+
+            params, self._weight_quant_stats = quantize_weights(
+                model.cfg, params, dtype=self.config.weight_quant_dtype,
+                block=self.config.weight_quant_block,
+                skip=self.config.weight_quant_skip, tp=tp)
+        if jmesh is not None:
+            from ...parallel.sharding import ZeroShardingPlan
+            from .weight_quant import expand_spec_tree
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec_tree = (model.param_specs()
+                         if hasattr(model, "param_specs") else None)
+            # quantized-weight nodes carry their spec onto both the
+            # payload and the scale plane (the PR 6 KV scale-plane
+            # treatment applied to weights)
+            spec_tree = expand_spec_tree(spec_tree, params)
+            plan = ZeroShardingPlan(topo_obj, 0, spec_tree)
+            shardings = plan.params(jax.eval_shape(lambda: params))
+            params = jax.tree.map(jax.device_put, params, shardings)
+            cache_sharding = NamedSharding(
+                jmesh, P(None, None, "tensor", None, None))
+            # kv_quant scale planes [L, NB, KH] follow the pools'
+            # kv-head split (paged_model extends the shard_map specs)
+            scale_sharding = NamedSharding(jmesh, P(None, None, "tensor"))
         self.params = params
 
         cfg = model.cfg
@@ -158,6 +194,7 @@ class InferenceEngineV2:
             enable_prefix_cache=self.config.enable_prefix_cache,
             prefix_cache_max_blocks=self.config.prefix_cache_max_blocks,
             kv_quant=self.config.kv_quant_enabled,
+            kv_quant_dtype=self.config.kv_quant_dtype,
             scale_sharding=self._scale_sharding,
             kv_tier_enabled=self.config.kv_tier_enabled,
             kv_tier_host_bytes=self.config.kv_tier_host_bytes,
@@ -467,6 +504,68 @@ class InferenceEngineV2:
         self.config.kv_quant_dtype = dtype
         self.config.kv_quant_scale_granularity = scale_granularity
         self.state_manager = self._build_state_manager()
+
+    # ------------------------------------------------------- weight serving
+    def configure_weight_quant(self, enabled: bool, dtype: str = "int8",
+                               block: int = 128,
+                               skip: Optional[Sequence[str]] = None) -> None:
+        """Quantize this engine's weights in place — the serving layer's
+        config-driven hook (``ServingConfig.weight_quant``; see
+        docs/SERVING.md "Weight quantization"). Like ``configure_kv_quant``
+        this is only legal before traffic (no tracked sequences): the
+        compiled forward changes with the param pytree. Unlike KV pools,
+        quantized weights cannot be un-quantized (the original values are
+        gone — keeping a full-precision copy would defeat the byte cut),
+        so disabling or re-coding an already-quantized engine raises:
+        rebuild from the factory instead (what the frontend's replica
+        paths do)."""
+        skip_list = (list(skip) if skip is not None else [])
+        already = self.config.weight_quant_enabled
+        if already and enabled and dtype == self.config.weight_quant_dtype:
+            # idempotent: an engine quantized at build meets the serving
+            # config's apply with the same representation (block/skip
+            # differences cannot be honored post-hoc — the full-precision
+            # values are gone — and are advisory at this point)
+            return
+        if already:
+            raise RuntimeError(
+                "weights are already quantized "
+                f"({self.config.weight_quant_dtype}) — quantization is "
+                "lossy and cannot be reconfigured in place; rebuild the "
+                "engine from its factory")
+        if not enabled:
+            return                      # off -> off: nothing to do
+        if self.state_manager.tracked_sequences:
+            raise RuntimeError(
+                "cannot quantize weights with "
+                f"{len(self.state_manager.tracked_sequences)} sequences "
+                "tracked — mid-stream logits would shift under the "
+                "requests' feet")
+        from .weight_quant import quantize_weights
+
+        self.params, self._weight_quant_stats = quantize_weights(
+            self.model.cfg, self.params, dtype=dtype, block=int(block),
+            skip=skip_list, tp=self.paged.tp)
+        self.config.weight_quant_enabled = True
+        self.config.weight_quant_dtype = dtype
+        self.config.weight_quant_block = int(block)
+        self.config.weight_quant_skip = skip_list
+
+    def param_stats(self) -> Dict[str, object]:
+        """Resident param-byte accounting (total + quantized share) — the
+        single source the ``param_bytes_total``/``param_bytes_quantized``
+        serving gauges and the bench phase stamps read; cheap (pure
+        shape/dtype metadata, computed lazily once per param tree)."""
+        if self._weight_quant_stats is None:
+            from .weight_quant import param_stats
+
+            self._weight_quant_stats = param_stats(
+                self.params,
+                dtype=(self.config.weight_quant_dtype
+                       if self.config.weight_quant_enabled else ""),
+                block=(self.config.weight_quant_block
+                       if self.config.weight_quant_enabled else 0))
+        return dict(self._weight_quant_stats)
 
     @property
     def free_blocks(self) -> int:

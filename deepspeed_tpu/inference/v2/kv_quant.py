@@ -1,4 +1,4 @@
-"""int8 KV-cache quantization for the paged ragged engine.
+"""int8/fp8 KV-cache quantization for the paged ragged engine.
 
 The paged KV pool (``ragged/manager.py``: ``[L, NB, KH, bs, D]``) is the
 HBM tensor that caps servable concurrency per chip — at production batch
@@ -46,6 +46,8 @@ from typing import Dict
 
 import jax.numpy as jnp
 
+from ...ops.quantizer import _HAS_FP8, FP8_MAX
+
 # Symmetric int8: values in [-127, 127] (−128 unused, keeps the code
 # symmetric around zero) with scale = amax / 127.
 Q_MAX = 127.0
@@ -53,18 +55,44 @@ Q_MAX = 127.0
 # any real activation scale.
 SCALE_EPS = 1e-8
 
-SUPPORTED_DTYPES = ("int8",)
+#: quantized KV representations: int8 (PR 6) and float8_e4m3fn on the
+#: reserved ``kv_quant.dtype`` surface — same pool/scale machinery, the
+#: pool dtype and the qmax the scale maps amax onto are the only
+#: differences (scale = amax / 448 spreads each block over e4m3's
+#: dynamic range; the floating mantissa keeps small values' relative
+#: precision where int8 spends its codes uniformly).
+SUPPORTED_DTYPES = ("int8", "fp8_e4m3")
 SUPPORTED_GRANULARITIES = ("block",)
 
 
+def pool_dtype(dtype: str):
+    """The jnp dtype KV pool slabs are stored as for a quantized
+    representation name (both are 1 byte/element — the 2x/4x byte cut
+    vs bf16/fp32 is identical; fp8 trades int8's uniform code spacing
+    for floating relative precision)."""
+    if dtype == "fp8_e4m3":
+        return jnp.float8_e4m3fn
+    return jnp.int8
+
+
+def qmax_of(dtype) -> float:
+    """Symmetric range limit the per-block scale maps amax onto, from a
+    representation name or a pool dtype."""
+    if "float8" in str(dtype) or str(dtype) == "fp8_e4m3":
+        return FP8_MAX
+    return Q_MAX
+
+
 def validate_kv_quant(dtype: str, scale_granularity: str) -> None:
-    """Reject config combinations this implementation does not encode.
-    ``dtype``/``scale_granularity`` exist on the config surface so fp8 /
-    coarser scales can land without an API break; today only
-    ``int8`` x ``block`` (per block x kv-head x layer) is real."""
+    """Reject config combinations this implementation does not encode:
+    ``int8``/``fp8_e4m3`` x ``block`` (per block x kv-head x layer) are
+    real; coarser scale granularities remain reserved."""
     if dtype not in SUPPORTED_DTYPES:
         raise ValueError(f"kv_quant.dtype {dtype!r} not supported "
                          f"(implemented: {SUPPORTED_DTYPES})")
+    if dtype == "fp8_e4m3" and not _HAS_FP8:
+        raise ValueError("kv_quant.dtype 'fp8_e4m3' needs a JAX build "
+                         "with float8_e4m3fn")
     if scale_granularity not in SUPPORTED_GRANULARITIES:
         raise ValueError(
             f"kv_quant.scale_granularity {scale_granularity!r} not "
@@ -149,13 +177,20 @@ def touched_block_plan(block_tables, start_pos, n_tokens, chunk: int,
 
 
 def quantized_block_write(pool, scale, new_vals, plan):
-    """Merge new K or V rows into an int8 pool (the quantized counterpart
-    of the reference ``linear_blocked_kv_rotary`` scatter).
+    """Merge new K or V rows into a quantized pool (the quantized
+    counterpart of the reference ``linear_blocked_kv_rotary`` scatter).
 
-    ``pool`` [NB, KH, bs, D] int8; ``scale`` [NB, KH] f32;
-    ``new_vals`` [N*C, KH, D] (row order matches ``plan``'s flattened
-    token coordinates). Returns the updated (pool, scale).
+    ``pool`` [NB, KH, bs, D] int8 or float8_e4m3fn — the representation
+    is derived from ``pool.dtype``, so the paged forward needs no extra
+    plumbing; ``scale`` [NB, KH] f32; ``new_vals`` [N*C, KH, D] (row
+    order matches ``plan``'s flattened token coordinates). Returns the
+    updated (pool, scale). The monotone-scale rule keeps steady-state
+    decode exact for both representations: while the scale is unchanged,
+    dequantize→requantize round-trips the stored code bit-for-bit
+    (int8: ``round(q·s/s) = q``; fp8: the nearest-e4m3 cast of
+    ``q·s/s`` is ``q``).
     """
+    qmax = qmax_of(pool.dtype)
     deq = (pool[plan["gather_ids"]].astype(jnp.float32)
            * scale[plan["gather_ids"]][:, :, :, None, None])
     deq = jnp.where(plan["live_slots"][:, :, None, :, None], deq, 0.0)
@@ -164,9 +199,14 @@ def quantized_block_write(pool, scale, new_vals, plan):
     amax = jnp.max(jnp.abs(deq), axis=(3, 4))                    # [N, TB, KH]
     prior = jnp.where(plan["has_prior"][:, :, None],
                       scale[plan["gather_ids"]], 0.0)
-    new_scale = jnp.maximum(jnp.maximum(amax / Q_MAX, prior), SCALE_EPS)
-    q = jnp.clip(jnp.round(deq / new_scale[:, :, :, None, None]),
-                 -Q_MAX, Q_MAX).astype(jnp.int8)
+    new_scale = jnp.maximum(jnp.maximum(amax / qmax, prior), SCALE_EPS)
+    scaled = deq / new_scale[:, :, :, None, None]
+    if pool.dtype == jnp.int8:
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(jnp.int8)
+    else:
+        # float8: the cast rounds to nearest representable — no integer
+        # rounding step, and the clip keeps inf out of the pool
+        q = jnp.clip(scaled, -qmax, qmax).astype(pool.dtype)
     pool = pool.at[plan["scatter_ids"]].set(q, mode="drop")
     scale = scale.at[plan["scatter_ids"]].set(new_scale, mode="drop")
     return pool, scale

@@ -15,7 +15,15 @@ function processes a mixed prefill/decode ragged batch with static shapes:
   sequence's block table directly — no dense [N, max_ctx, KH, D] gather,
   no GQA ``jnp.repeat`` (the XLA gather formulation remains as the
   off-TPU fallback inside ``paged_attention``);
-- returns logits only at each sequence's last valid token (logits_gather).
+- returns logits only at each sequence's last valid token (logits_gather);
+- weight serving (``weight_quant.py``): when the param tree holds
+  blockwise-quantized ``{"qw", "qs"}`` nodes, every projection/MLP/unembed
+  matmul here runs straight from the int8/fp8 representation through
+  ``models/transformer._linear``'s structural dispatch →
+  ``ops/quantizer.quantized_matmul`` (dequantize-in-kernel on the Pallas
+  path, fused dequant-then-dot on XLA, fp32 accumulation) —
+  ``forward``/``forward_verify``/prefill all ride the same quantized tree,
+  and an unquantized tree compiles the historical program byte for byte.
 """
 
 from __future__ import annotations

@@ -63,7 +63,8 @@ class DSStateManager:
                  dtype=None, sharding=None,
                  enable_prefix_cache: bool = False,
                  prefix_cache_max_blocks: Optional[int] = None,
-                 kv_quant: bool = False, scale_sharding=None,
+                 kv_quant: bool = False, kv_quant_dtype: str = "int8",
+                 scale_sharding=None,
                  kv_tier_enabled: bool = False,
                  kv_tier_host_bytes: int = 64 * 1024 * 1024,
                  kv_tier_disk_path: Optional[str] = None,
@@ -74,11 +75,13 @@ class DSStateManager:
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.max_tracked_sequences = max_tracked_sequences
-        # int8 KV quantization (docs/SERVING.md "KV quantization"): pools
-        # stored as symmetric int8 with per-(layer, block, kv-head) f32
-        # scale planes — half the HBM bytes per block vs bf16, so a fixed
-        # byte budget buys ~2x the blocks (inference/v2/kv_quant.py)
+        # quantized KV (docs/SERVING.md "KV quantization"): pools stored
+        # as symmetric int8 or float8_e4m3fn (``kv_quant_dtype``) with
+        # per-(layer, block, kv-head) f32 scale planes — half the HBM
+        # bytes per block vs bf16, so a fixed byte budget buys ~2x the
+        # blocks (inference/v2/kv_quant.py)
         self.kv_quant = bool(kv_quant)
+        self.kv_quant_dtype = str(kv_quant_dtype)
         self.allocator = BlockedAllocator(
             num_blocks,
             bytes_per_block=kv_bytes_per_block(model_cfg, block_size,
@@ -131,7 +134,9 @@ class DSStateManager:
         # axis (TP serving — reference v2 sharding/qkv.py:166 head split).
         shape = (model_cfg.num_layers, num_blocks, model_cfg.kv_heads,
                  block_size, model_cfg.head_dim)
-        pool_dt = jnp.int8 if self.kv_quant else dt
+        from ..kv_quant import pool_dtype as _pool_dtype
+
+        pool_dt = _pool_dtype(self.kv_quant_dtype) if self.kv_quant else dt
 
         def _alloc(shp, adt, shard):
             if shard is None:
@@ -275,6 +280,7 @@ class DSStateManager:
         return {"seen_tokens": seq.seen_tokens,
                 "block_size": self.block_size,
                 "kv_quant": self.kv_quant,
+                "kv_quant_dtype": self.kv_quant_dtype,
                 "n_blocks": len(seq.kv_blocks),
                 "slabs": {name: np.asarray(a) for name, a in arrs.items()}}
 
@@ -307,6 +313,15 @@ class DSStateManager:
             raise ValueError(
                 f"KV import representation mismatch: payload kv_quant="
                 f"{payload['kv_quant']} vs pool kv_quant={self.kv_quant}")
+        # dtype axis of the representation check (int8 vs fp8_e4m3):
+        # pre-dtype payloads default to int8, the only representation
+        # that existed when they were written
+        pay_dt = str(payload.get("kv_quant_dtype", "int8"))
+        if self.kv_quant and pay_dt != self.kv_quant_dtype:
+            raise ValueError(
+                f"KV import representation mismatch: payload "
+                f"kv_quant_dtype={pay_dt!r} vs pool "
+                f"{self.kv_quant_dtype!r}")
         if set(slabs) != set(self.kv_cache):
             raise ValueError(f"KV import slab keys {sorted(slabs)} != "
                              f"pool keys {sorted(self.kv_cache)}")
@@ -452,6 +467,9 @@ class DSStateManager:
         they stay in host RAM on this store."""
         meta = {k: payload[k] for k in ("seen_tokens", "block_size",
                                         "kv_quant", "n_blocks")}
+        # representation dtype axis (int8/fp8_e4m3) — absent only in
+        # pre-dtype payloads, which were int8 by construction
+        meta["kv_quant_dtype"] = payload.get("kv_quant_dtype", "int8")
         if self._tier is not None:
             # not a prefix-cache spill: keep the per-block tier counters
             # honest (sequences_preempted counts these instead)

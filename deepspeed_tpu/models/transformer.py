@@ -244,8 +244,22 @@ TINY_TEST = TransformerConfig(vocab_size=256, hidden_size=64,
 # ------------------------------------------------------------------ primitives
 
 def _linear(x, w, b, dt):
-    """x @ w (+ b) in compute dtype; b may be None (bias-free families)."""
-    y = x @ w.astype(dt)
+    """x @ w (+ b) in compute dtype; b may be None (bias-free families).
+
+    ``w`` may be a blockwise-quantized ``{"qw", "qs"}`` node
+    (int8/fp8 weight serving — inference/v2/weight_quant.py): the matmul
+    then runs straight from the quantized representation through
+    ``ops/quantizer.quantized_matmul`` (dequantize-in-kernel on the
+    Pallas path, fused dequant-then-dot on XLA, fp32 accumulation). An
+    array weight takes the historical path byte for byte — the dispatch
+    is on pytree structure at trace time, so the unquantized program is
+    untouched."""
+    if isinstance(w, dict):
+        from ..ops.quantizer import quantized_matmul
+
+        y = quantized_matmul(x, w["qw"], w["qs"], out_dtype=dt)
+    else:
+        y = x @ w.astype(dt)
     return y if b is None else y + b.astype(dt)
 
 
@@ -1120,7 +1134,15 @@ class CausalLM:
         cfg = self.cfg
         if cfg.tie_embeddings:
             return x @ params["embed"]["wte"].T.astype(cfg.dtype)
-        y = x @ params["lm_head"]["w"].astype(cfg.dtype)
+        w = params["lm_head"]["w"]
+        if isinstance(w, dict):
+            # blockwise-quantized lm_head (weight serving) — same
+            # dispatch as _linear
+            from ..ops.quantizer import quantized_matmul
+
+            y = quantized_matmul(x, w["qw"], w["qs"], out_dtype=cfg.dtype)
+        else:
+            y = x @ w.astype(cfg.dtype)
         if "b" in params.get("lm_head", {}):
             y = y + params["lm_head"]["b"].astype(cfg.dtype)
         return y

@@ -15,13 +15,25 @@ quantization along the last dim, used by
   the reference's swizzled int4 layouts reduce to this on TPU since block
   layout is the compiler's job.
 
-Format: for ``x[..., N]`` with block size ``B | N``, ``q[..., N]`` int8 and
-``scales[..., N/B]`` f32 with ``x ≈ q * scales`` (symmetric, zero-point
-free — the TPU-friendly choice: dequant is one fused multiply).
+Format: for ``x[..., N]`` with block size ``B``, ``q[..., N]`` int8 and
+``scales[..., ceil(N/B)]`` f32 with ``x ≈ q * scales`` (symmetric,
+zero-point free — the TPU-friendly choice: dequant is one fused
+multiply). Ragged tails (``N % B != 0``) are handled by zero-padding the
+last group internally; the stored arrays keep the logical N.
 
-A Pallas kernel handles the (quantize, dequantize) hot pair on TPU (tested
-in interpret mode off-TPU); the XLA formulation is the fallback and
-reference.
+``dtype="fp8_e4m3"`` stores ``q`` as ``float8_e4m3fn`` instead of int8
+(same byte width, floating mantissa): ``scale = amax / 448`` maps each
+group onto e4m3's dynamic range. Weight serving
+(``inference/v2/weight_quant.py``) and fp8 KV pools
+(``inference/v2/kv_quant.py``) both ride this entry point.
+
+A Pallas kernel handles the (quantize, dequantize) hot pair on TPU
+(tested in interpret mode off-TPU); the XLA formulation is the fallback
+and reference. :func:`quantized_matmul` is the serving hot op: matmul
+straight from the quantized representation — the weight tile is
+dequantized in VMEM right after its DMA on the Pallas path, and the XLA
+fallback fuses the dequant multiply into the dot's operand read; both
+accumulate in fp32.
 """
 
 from __future__ import annotations
@@ -31,6 +43,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .pallas_utils import HAS_PALLAS as _HAS_PALLAS
 from .pallas_utils import on_tpu as _on_tpu
@@ -39,6 +52,20 @@ if _HAS_PALLAS:
     from jax.experimental.pallas import tpu as pltpu
 
 _FORCE_INTERPRET = False    # test hook (same pattern as flash_attention.py)
+
+#: max finite magnitude of float8_e4m3fn — the fp8 counterpart of
+#: ``qmax(8)``; group scale = amax / FP8_MAX maps each quant group onto
+#: the format's full dynamic range.
+FP8_MAX = 448.0
+_HAS_FP8 = hasattr(jnp, "float8_e4m3fn")
+
+
+def fp8_dtype():
+    """``jnp.float8_e4m3fn`` (raises on JAX builds without fp8 — callers
+    validate via the config surface first, so this is a backstop)."""
+    if not _HAS_FP8:
+        raise RuntimeError("this JAX build has no float8_e4m3fn dtype")
+    return jnp.float8_e4m3fn
 
 
 def qmax(bits: int) -> int:
@@ -54,25 +81,48 @@ def choose_block(n: int, want: int = 128) -> int:
     return b
 
 
+def _pad_tail(x, block: int):
+    """Zero-pad the last dim up to a multiple of ``block`` (ragged-tail
+    support): padding is zeros, so it can neither inflate a group's amax
+    nor survive the round-trip slice back to the logical width."""
+    n = x.shape[-1]
+    rem = n % block
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, block - rem)]
+    return jnp.pad(x, pad)
+
+
 # ----------------------------------------------------------------- XLA path
 
-def _quantize_xla(x, bits: int, block: int):
-    *lead, n = x.shape
-    nb = n // block
-    xb = x.astype(jnp.float32).reshape(*lead, nb, block)
+def _quantize_xla(x, bits: int, block: int, dtype: str = "int8"):
+    n = x.shape[-1]
+    xp = _pad_tail(x.astype(jnp.float32), block)
+    *lead, np_ = xp.shape
+    nb = np_ // block
+    xb = xp.reshape(*lead, nb, block)
     amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
-    scale = amax / qmax(bits)
-    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
-    q = jnp.clip(jnp.round(xb * inv), -qmax(bits), qmax(bits)).astype(jnp.int8)
-    return q.reshape(x.shape), scale[..., 0].reshape(*lead, nb)
+    if dtype == "fp8_e4m3":
+        scale = amax / FP8_MAX
+        inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+        q = jnp.clip(xb * inv, -FP8_MAX, FP8_MAX).astype(fp8_dtype())
+    else:
+        scale = amax / qmax(bits)
+        inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+        q = jnp.clip(jnp.round(xb * inv), -qmax(bits),
+                     qmax(bits)).astype(jnp.int8)
+    q = q.reshape(*lead, np_)[..., :n]
+    return q, scale[..., 0].reshape(*lead, nb)
 
 
 def _dequantize_xla(q, scales, block: int, dtype):
-    *lead, n = q.shape
-    nb = n // block
-    xb = q.reshape(*lead, nb, block).astype(jnp.float32)
+    n = q.shape[-1]
+    qp = _pad_tail(q.astype(jnp.float32), block)
+    *lead, np_ = qp.shape
+    nb = np_ // block
+    xb = qp.reshape(*lead, nb, block)
     out = xb * scales.reshape(*lead, nb, 1)
-    return out.reshape(q.shape).astype(dtype)
+    return out.reshape(*lead, np_)[..., :n].astype(dtype)
 
 
 # -------------------------------------------------------------- Pallas path
@@ -138,12 +188,39 @@ def _dequantize_pallas(q2, s2, block: int, dtype):
 
 # ------------------------------------------------------------------- public
 
-def quantize_blockwise(x, bits: int = 8,
-                       block: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """x[..., N] → (q int8 [..., N], scales f32 [..., N/B]).
+def _infer_block(n: int, n_groups: int, block: Optional[int]) -> int:
+    """Resolve the block size for a (q, scales) pair.
 
-    int4 keeps one value per int8 slot in [-7, 7]; use :func:`pack_int4`
-    to halve storage/wire bytes.
+    Inference assumes the canonical divisor layout (``B = N / groups``,
+    what ``quantize_blockwise`` produces whenever its block tiles the
+    dim — including the ``block=None`` default). A layout quantized with
+    an explicit RAGGED block (``N % B != 0``) must pass the same
+    ``block=`` back: the group count alone cannot reconstruct it, and
+    when ``groups`` happens to divide ``N`` a wrong divisor would be
+    inferred silently. The detectable half (``N % groups != 0``) is
+    refused here; the contract covers the rest."""
+    if block:
+        return block
+    if n % n_groups != 0:
+        raise ValueError(
+            f"cannot infer block size for N={n} with {n_groups} scale "
+            "groups (ragged-tail layout) — pass the block= it was "
+            "quantized with")
+    return n // n_groups
+
+
+def quantize_blockwise(x, bits: int = 8, block: Optional[int] = None,
+                       dtype: str = "int8") -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x[..., N] → (q [..., N], scales f32 [..., ceil(N/B)]).
+
+    ``dtype="int8"`` (default): symmetric int8 (or int4 via ``bits=4`` —
+    one value per int8 slot in [-7, 7]; use :func:`pack_int4` to halve
+    storage/wire bytes). ``dtype="fp8_e4m3"``: float8_e4m3fn payload with
+    ``scale = amax / 448``. Ragged tails (``N % B != 0``) quantize the
+    short last group against its own amax — such layouts only arise from
+    an explicit ragged ``block=``, and the SAME block must be passed to
+    ``dequantize_blockwise``/``quantized_matmul`` (group count alone
+    cannot reconstruct a ragged block; see ``_infer_block``).
     """
     n = x.shape[-1]
     block = block or choose_block(n)
@@ -151,26 +228,106 @@ def quantize_blockwise(x, bits: int = 8,
     rows = 1
     for d in lead:
         rows *= d
-    if rows > 0 and _pallas_2d_ok(rows, n, block):
+    if (dtype == "int8" and rows > 0
+            and _pallas_2d_ok(rows, n, block)):
         q2, s2 = _quantize_pallas(x.reshape(rows, n), bits, block)
         return q2.reshape(x.shape), s2.reshape(*lead, n // block)
-    return _quantize_xla(x, bits, block)
+    return _quantize_xla(x, bits, block, dtype)
 
 
 def dequantize_blockwise(q, scales, block: Optional[int] = None,
                          dtype=jnp.float32):
     n = q.shape[-1]
-    block = block or (n // scales.shape[-1])
+    block = _infer_block(n, scales.shape[-1], block)
     lead = q.shape[:-1]
     rows = 1
     for d in lead:
         rows *= d
-    if rows > 0 and _pallas_2d_ok(rows, n, block):
+    if (q.dtype == jnp.int8 and rows > 0
+            and _pallas_2d_ok(rows, n, block)):
         out2 = _dequantize_pallas(q.reshape(rows, n),
                                   scales.reshape(rows, n // block),
                                   block, dtype)
         return out2.reshape(q.shape)
     return _dequantize_xla(q, scales, block, dtype)
+
+
+# ------------------------------------------------- quantized matmul (serving)
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, block: int):
+    """One (i, j) grid step: ``x`` tile [bm, K] × weight tile [K, bn].
+    The quantized weight tile is dequantized in VMEM right after its DMA
+    (q · broadcast scale) and the dot accumulates in fp32 — HBM only
+    ever holds the 1-byte payload + the f32 scale plane."""
+    x = x_ref[...].astype(jnp.float32)                       # [bm, K]
+    qw = q_ref[...].astype(jnp.float32)                      # [K, bn]
+    s = s_ref[...]                                           # [K, bn/B]
+    k, bn = qw.shape
+    w = (qw.reshape(k, bn // block, block)
+         * s[:, :, None]).reshape(k, bn)
+    o_ref[...] = lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _qmm_pallas_ok(m: int, k: int, n: int, block: int) -> bool:
+    return (_HAS_PALLAS and (_on_tpu() or _FORCE_INTERPRET)
+            and n % block == 0 and n % 128 == 0 and k % 8 == 0
+            and m % 8 == 0)
+
+
+def _qmm_pallas(x2, q, s, block: int, out_dtype):
+    m, k = x2.shape
+    n = q.shape[-1]
+    bm = min(m, 256)
+    while m % bm != 0:
+        bm -= 8
+    bn = 128
+    while bn % block != 0:          # scale groups must tile the N tile
+        bn += 128
+    bn = min(bn, n)
+    kern = functools.partial(_qmm_kernel, block=block)
+    return pl.pallas_call(
+        kern,
+        grid=(m // bm, n // bn),
+        in_specs=[pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+                  pl.BlockSpec((k, bn), lambda i, j: (0, j)),
+                  pl.BlockSpec((k, bn // block), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        interpret=_FORCE_INTERPRET or not _on_tpu(),
+    )(x2, q, s)
+
+
+def quantized_matmul(x, q, scales, block: Optional[int] = None,
+                     out_dtype=None):
+    """``x[..., K] @ dequant(q[K, N], scales[K, ceil(N/B)])`` with fp32
+    accumulation — the weight-serving hot op (int8/fp8 weights,
+    ``inference/v2/weight_quant.py``).
+
+    Pallas path: tiled matmul whose weight tile dequantizes in VMEM
+    (HBM traffic is the 1-byte payload — the point of weight
+    quantization on memory-bound decode). XLA fallback: dequantize-
+    then-dot, where the dequant multiply fuses into the dot's operand
+    read. Both paths produce identical values (dequantization is exact
+    and both accumulate in fp32).
+    """
+    out_dtype = out_dtype or x.dtype
+    kdim, n = q.shape
+    block = _infer_block(n, scales.shape[-1], block)
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    if m > 0 and n % block == 0 and _qmm_pallas_ok(m, kdim, n, block):
+        out2 = _qmm_pallas(x.reshape(m, kdim), q,
+                           scales.astype(jnp.float32), block, out_dtype)
+        return out2.reshape(*lead, n)
+    w = _dequantize_xla(q, scales.astype(jnp.float32), block, jnp.float32)
+    y = lax.dot_general(x.astype(jnp.float32), w,
+                        (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+    return y.astype(out_dtype)
 
 
 def pack_int4(q):

@@ -23,7 +23,7 @@ from .config_utils import AUTO, DSConfigModel, dict_raise_error_on_duplicate_key
 from .resilience import ResilienceConfig
 from ..serving.config import (AdmissionConfig, KVQuantConfig, KVTierConfig,
                               PrefixCacheConfig, ServingConfig,
-                              SpeculativeConfig)
+                              SpeculativeConfig, WeightQuantConfig)
 from ..telemetry.config import TelemetryConfig
 from ..utils.logging import logger
 
@@ -350,9 +350,13 @@ class DeepSpeedTpuConfig(DSConfigModel):
     # speculative decoding for the v2 ragged engine (docs/SERVING.md
     # "Speculative decoding"); also reachable as ``serving.speculative``
     speculative: SpeculativeConfig = Field(default_factory=SpeculativeConfig)
-    # int8 KV-cache quantization for the v2 ragged engine (docs/SERVING.md
-    # "KV quantization"); also reachable as ``serving.kv_quant``
+    # int8/fp8 KV-cache quantization for the v2 ragged engine
+    # (docs/SERVING.md "KV quantization"); also reachable as
+    # ``serving.kv_quant``
     kv_quant: KVQuantConfig = Field(default_factory=KVQuantConfig)
+    # int8/fp8 weight serving for the v2 ragged engine (docs/SERVING.md
+    # "Weight quantization"); also reachable as ``serving.weight_quant``
+    weight_quant: WeightQuantConfig = Field(default_factory=WeightQuantConfig)
     # tiered KV memory for the v2 ragged engine (docs/SERVING.md
     # "KV tiering"); also reachable as ``serving.kv_tier``
     kv_tier: KVTierConfig = Field(default_factory=KVTierConfig)

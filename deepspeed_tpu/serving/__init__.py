@@ -19,7 +19,7 @@ from .config import (AdmissionConfig, AutoscalerConfig,  # noqa: F401
                      ClassPolicy, DisaggregationConfig, FaultsConfig,
                      FaultToleranceConfig, HandoffConfig, KVQuantConfig,
                      KVTierConfig, PreemptionConfig, PrefixCacheConfig,
-                     ServingConfig, SpeculativeConfig)
+                     ServingConfig, SpeculativeConfig, WeightQuantConfig)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .handoff import HandoffStager  # noqa: F401
 from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
@@ -53,6 +53,7 @@ def __getattr__(name):
 
 
 __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
+           "WeightQuantConfig",
            "KVTierConfig", "AdmissionConfig", "PreemptionConfig",
            "AutoscalerConfig", "FleetController", "FleetSignals",
            "ReplicaInfo",

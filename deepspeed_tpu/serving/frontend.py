@@ -219,6 +219,19 @@ class ServingFrontend:
         the constructor path AND the supervisor's restart path, so a
         restarted replica is indistinguishable from a first-boot one
         (prefix cache applied, proposer built, telemetry attached)."""
+        if self.config.weight_quant.enabled:
+            # config-driven int8/fp8 weight serving: applied FIRST and
+            # BEFORE any traffic (quantizing is lossy and retraces the
+            # forward, both only legal with no tracked sequences — true
+            # on every build path: boot, supervisor restart, autoscaler
+            # grow). Engines the caller quantized directly are left
+            # alone when the block is off; configure_weight_quant
+            # no-ops on an engine already quantized with these settings.
+            configure = getattr(engine, "configure_weight_quant", None)
+            if configure is not None:
+                wq = self.config.weight_quant
+                configure(True, dtype=wq.dtype, block=wq.block,
+                          skip=list(wq.skip))
         if self.config.kv_quant.enabled:
             # config-driven int8 KV quantization: applied BEFORE any
             # traffic reaches the engine (configure_kv_quant re-allocates
@@ -859,6 +872,7 @@ class ServingFrontend:
         self._refresh_admission_gauges()
         blocks = total_bytes = 0
         host_blocks = host_bytes = disk_blocks = disk_bytes = 0
+        pbytes_total = pbytes_quant = 0
         role_blocks: dict = {}
         found = False
         for rep in self.router.replicas:
@@ -870,6 +884,18 @@ class ServingFrontend:
             except Exception:
                 continue
             found = True
+            # resident param bytes (docs/SERVING.md "Weight
+            # quantization"): fleet-summed from engine.param_stats(),
+            # the replicas-per-host capacity ledger weight quantization
+            # moves — zero quantized share on full-precision engines
+            stats_fn = getattr(rep.engine, "param_stats", None)
+            if stats_fn is not None:
+                try:
+                    ps = stats_fn()
+                    pbytes_total += int(ps.get("param_bytes_total", 0))
+                    pbytes_quant += int(ps.get("param_bytes_quantized", 0))
+                except Exception:
+                    pass
             blocks += occ.get("in_use_blocks", 0)
             total_bytes += occ.get("bytes_in_use", 0)
             # tiered KV residency (docs/SERVING.md "KV tiering"); zero
@@ -888,6 +914,8 @@ class ServingFrontend:
             self.metrics.gauge("kv_blocks_disk_tier").set(disk_blocks)
             self.metrics.gauge("kv_tier_bytes_host").set(host_bytes)
             self.metrics.gauge("kv_tier_bytes_disk").set(disk_bytes)
+            self.metrics.gauge("param_bytes_total").set(pbytes_total)
+            self.metrics.gauge("param_bytes_quantized").set(pbytes_quant)
             # per-role split (docs/SERVING.md "Disaggregated serving"):
             # handoff pressure — decode pools filling while prefill
             # pools stay light — is visible in flight-recorder metric

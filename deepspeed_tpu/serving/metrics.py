@@ -417,6 +417,11 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # occupancy snapshot (docs/SERVING.md "KV tiering")
               "kv_blocks_host_tier", "kv_blocks_disk_tier",
               "kv_tier_bytes_host", "kv_tier_bytes_disk",
+              # resident model-weight bytes, fleet-summed from
+              # ``engine.param_stats()`` (docs/SERVING.md "Weight
+              # quantization"): total drops ~3.9x per replica under
+              # int8/fp8 weight serving; quantized = the converted share
+              "param_bytes_total", "param_bytes_quantized",
               # admission overhaul (docs/SERVING.md "Admission and
               # preemption"): blocks the pending reservation head is
               # short of; device-block footprint of parked sequences

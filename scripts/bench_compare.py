@@ -52,6 +52,14 @@ _LOWER_IS_BETTER = (
     # (chip-seconds stand-in) — the elastic fleet's whole point is
     # spending fewer of them at equal SLO attainment
     "replica_seconds",
+    # weight_quant phase: resident param bytes are what cap replicas
+    # per host — fewer is better (the int8/total numbers regressing UP
+    # mean the quantizer stopped covering leaves). Deliberately NOT the
+    # bare "param_bytes": param_bytes_quantized (the converted share,
+    # stamped into every phase) legitimately RISES when coverage grows
+    # and must stay informational, and param_bytes_fp32 is a constant
+    # baseline.
+    "param_bytes_int8", "param_bytes_total",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
@@ -67,6 +75,9 @@ _HIGHER_IS_BETTER = (
     # autoscale phase: fraction of submitted requests that attained
     # their SLO (completed under deadline, not shed/failed)
     "slo_attainment",
+    # weight_quant phase: replicas a fixed host byte budget can hold,
+    # and the fp32/int8 resident-byte compression factor
+    "replicas_at_budget", "compression",
 )
 
 

@@ -29,6 +29,12 @@
 #                  under reservation admission with preempted-and-
 #                  resumed greedy parity and disabled byte-parity
 #                  asserted, while the pre-change stack deadlocks,
+#                  or TIER1_PHASE=weight_quant for the int8/fp8
+#                  weight-serving phase — int8 weights must cut param
+#                  bytes >= 3.5x vs fp32 with ppl ratio <= 1.01 and
+#                  enabled:false greedy byte-parity asserted (the
+#                  kv_quant phase additionally carries the fp8_e4m3 KV
+#                  dtype axis: ppl_gate_ok_fp8 on the same bars),
 #                  or TIER1_PHASE=autoscale for the elastic-autoscaling
 #                  phase — diurnal + bursty replay where the elastic
 #                  fleet must match/beat the static fleet's SLO

@@ -86,27 +86,31 @@ def disagg_config(roles, **over):
 
 
 # ------------------------------------------------- export/import round trip
-@pytest.mark.parametrize("quant", [False, True],
-                         ids=["fp", "int8"])
-def test_export_import_roundtrip_byte_parity(quant):
+@pytest.mark.parametrize("quant,qdtype",
+                         [(False, "int8"), (True, "int8"),
+                          (True, "fp8_e4m3")],
+                         ids=["fp", "int8", "fp8"])
+def test_export_import_roundtrip_byte_parity(quant, qdtype):
     """Imported KV must be byte-identical to the exported blocks (slab
     compare) AND resume decoding byte-losslessly (greedy compare vs an
-    uninterrupted run)."""
+    uninterrupted run) — the ISSUE 13 dtype axis (fp8_e4m3 pools) rides
+    the same test, not a copy."""
     prompt = prompts(1, seed=1, lo=20, hi=21)[0]
-    ref_eng = tiny_engine(kv_quant_enabled=quant)
+    ref_eng = tiny_engine(kv_quant_enabled=quant, kv_quant_dtype=qdtype)
     sref = ContinuousBatchingScheduler(ref_eng)
     sref.submit(1, prompt, max_new_tokens=8)
     sref.run_to_completion()
     ref = sref.finished[1].generated
 
-    src = tiny_engine(kv_quant_enabled=quant)
+    src = tiny_engine(kv_quant_enabled=quant, kv_quant_dtype=qdtype)
     payload = prefill_to_payload(src, 2, prompt)
     assert payload["kv_quant"] is quant
     assert payload["seen_tokens"] == len(prompt)
     if quant:
+        assert payload["kv_quant_dtype"] == qdtype
         assert "k_scale" in payload["slabs"] and "v_scale" in payload["slabs"]
 
-    dst = tiny_engine(kv_quant_enabled=quant)
+    dst = tiny_engine(kv_quant_enabled=quant, kv_quant_dtype=qdtype)
     dst.import_sequence(3, payload, tokens=prompt)
     # slab-level byte parity: re-export from the destination
     back = dst.export_sequence(3)
@@ -134,6 +138,14 @@ def test_import_rejects_representation_mismatches():
     with pytest.raises(ValueError, match="representation"):
         tiny_engine(kv_quant_enabled=True).import_sequence(
             2, payload, tokens=prompt)
+    # dtype mismatch within kv_quant (int8 payload into fp8 pools —
+    # a heterogeneous fleet must recompute instead)
+    qpayload = prefill_to_payload(tiny_engine(kv_quant_enabled=True),
+                                  7, prompt)
+    with pytest.raises(ValueError, match="kv_quant_dtype"):
+        tiny_engine(kv_quant_enabled=True,
+                    kv_quant_dtype="fp8_e4m3").import_sequence(
+            8, qpayload, tokens=prompt)
     # block-size mismatch
     with pytest.raises(ValueError, match="block_size"):
         tiny_engine(kv_block_size=16).import_sequence(

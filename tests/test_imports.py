@@ -20,6 +20,7 @@ MODULES = [
     "deepspeed_tpu.inference.v2.kv_quant",
     "deepspeed_tpu.inference.v2.kv_tier",
     "deepspeed_tpu.inference.v2.paged_model",
+    "deepspeed_tpu.inference.v2.weight_quant",
     "deepspeed_tpu.inference.v2.ragged.blocked_allocator",
     "deepspeed_tpu.inference.v2.ragged.manager",
     "deepspeed_tpu.inference.v2.scheduler",

@@ -39,12 +39,19 @@ def model_and_params():
 
 
 def make_engine(model, params, quant=True, kv_blocks=64, max_seqs=8,
-                **cfg_kw):
+                qdtype="int8", **cfg_kw):
     vcfg = RaggedInferenceEngineConfig(
         max_ragged_batch_size=256, max_ragged_sequence_count=max_seqs,
         max_chunk_tokens=32, kv_blocks=kv_blocks, kv_block_size=BS,
-        max_tracked_sequences=64, kv_quant_enabled=quant, **cfg_kw)
+        max_tracked_sequences=64, kv_quant_enabled=quant,
+        kv_quant_dtype=qdtype, **cfg_kw)
     return InferenceEngineV2(model, params=params, config=vcfg)
+
+
+# the representation axis (ISSUE 13): the PR 6 suite runs for both the
+# int8 pools and fp8_e4m3 on the reserved ``kv_quant.dtype`` surface —
+# same scale machinery, different payload dtype
+KV_DTYPES = ("int8", "fp8_e4m3")
 
 
 def rand_prompt(rng, n):
@@ -52,12 +59,14 @@ def rand_prompt(rng, n):
 
 
 # ------------------------------------------------------------ state + bytes
-def test_quantized_pools_and_scale_planes(model_and_params):
+@pytest.mark.parametrize("qdtype", KV_DTYPES)
+def test_quantized_pools_and_scale_planes(model_and_params, qdtype):
     model, params = model_and_params
-    eng = make_engine(model, params, quant=True)
+    eng = make_engine(model, params, quant=True, qdtype=qdtype)
     kv = eng.state_manager.kv_cache
     L, KH, D = model.cfg.num_layers, model.cfg.kv_heads, model.cfg.head_dim
-    assert kv["k"].dtype == jnp.int8 and kv["v"].dtype == jnp.int8
+    want = jnp.int8 if qdtype == "int8" else jnp.float8_e4m3fn
+    assert kv["k"].dtype == want and kv["v"].dtype == want
     assert kv["k_scale"].shape == (L, 64, KH)
     assert kv["k_scale"].dtype == jnp.float32
     # quant-off: no scale planes at all (the forward branches on the
@@ -86,8 +95,9 @@ def test_bytes_per_block_and_budget(model_and_params):
 
 def test_validate_kv_quant_rejects_unknown():
     validate_kv_quant("int8", "block")
+    validate_kv_quant("fp8_e4m3", "block")    # ISSUE 13: now real
     with pytest.raises(ValueError, match="dtype"):
-        validate_kv_quant("fp8", "block")
+        validate_kv_quant("fp8", "block")     # the short spelling is not
     with pytest.raises(ValueError, match="scale_granularity"):
         validate_kv_quant("int8", "tensor")
 
@@ -138,13 +148,15 @@ def test_disabled_greedy_stream_identical(model_and_params):
 
 
 # ------------------------------------------------- quality gates (quant on)
-def test_bounded_divergence_and_logit_error(model_and_params):
+@pytest.mark.parametrize("qdtype", KV_DTYPES)
+def test_bounded_divergence_and_logit_error(model_and_params, qdtype):
     model, params = model_and_params
     rng = np.random.default_rng(2)
     prompts = [rand_prompt(rng, 30) for _ in range(3)]
     g_off = greedy_generate(make_engine(model, params, quant=False),
                             prompts, uid_base=1, max_new_tokens=16)
-    g_on = greedy_generate(make_engine(model, params, quant=True),
+    g_on = greedy_generate(make_engine(model, params, quant=True,
+                                       qdtype=qdtype),
                            prompts, uid_base=1, max_new_tokens=16)
     fracs = []
     for a, b in zip(g_off, g_on):
@@ -157,14 +169,17 @@ def test_bounded_divergence_and_logit_error(model_and_params):
     # teacher-forced logits stay close
     p = prompts[0]
     la = np.asarray(make_engine(model, params, quant=False).put([9], [p]))
-    lb = np.asarray(make_engine(model, params, quant=True).put([9], [p]))
+    lb = np.asarray(make_engine(model, params, quant=True,
+                                qdtype=qdtype).put([9], [p]))
     rel = np.max(np.abs(la - lb)) / (np.max(np.abs(la)) + 1e-9)
     assert rel < 0.05, f"relative logit error {rel}"
 
 
-def test_perplexity_delta_gate(model_and_params):
-    """Teacher-forced perplexity of the int8 engine within 5% of the
-    unquantized engine (the bench kv_quant phase's gate, in miniature)."""
+@pytest.mark.parametrize("qdtype", KV_DTYPES)
+def test_perplexity_delta_gate(model_and_params, qdtype):
+    """Teacher-forced perplexity of the quantized engine within 5% of
+    the unquantized engine (the bench kv_quant phase's gate, in
+    miniature) — both the int8 and fp8_e4m3 representations."""
     model, params = model_and_params
     rng = np.random.default_rng(3)
     toks = rand_prompt(rng, 64)
@@ -187,18 +202,20 @@ def test_perplexity_delta_gate(model_and_params):
         return total / count
 
     ppl_off = np.exp(nll(make_engine(model, params, quant=False), 1))
-    ppl_on = np.exp(nll(make_engine(model, params, quant=True), 1))
+    ppl_on = np.exp(nll(make_engine(model, params, quant=True,
+                                    qdtype=qdtype), 1))
     assert abs(ppl_on / ppl_off - 1.0) <= 0.05, (ppl_off, ppl_on)
 
 
 # ------------------------------------------------------------- composition
-def test_trim_across_block_boundary_requantizes(model_and_params):
+@pytest.mark.parametrize("qdtype", KV_DTYPES)
+def test_trim_across_block_boundary_requantizes(model_and_params, qdtype):
     """Speculative rollback across a block boundary: the freed block
     returns to the pool, the partial block re-quantizes on the next
-    write, and decoding continues."""
+    write, and decoding continues — both representations."""
     model, params = model_and_params
     rng = np.random.default_rng(4)
-    eng = make_engine(model, params, quant=True)
+    eng = make_engine(model, params, quant=True, qdtype=qdtype)
     uid = 7
     eng.put([uid], [rand_prompt(rng, 30)])       # seen=30 (2 blocks)
     eng.put([uid], [rand_prompt(rng, 5)])        # seen=35 (3 blocks)
@@ -216,19 +233,22 @@ def test_trim_across_block_boundary_requantizes(model_and_params):
     assert eng.occupancy()["in_use_blocks"] == 0
 
 
-def test_spec_decode_composes_bounded(model_and_params):
+@pytest.mark.parametrize("qdtype", KV_DTYPES)
+def test_spec_decode_composes_bounded(model_and_params, qdtype):
     """Speculation over a quantized cache: mechanically sound (propose/
     verify/trim) and bounded-divergent vs plain greedy on the SAME
     quantized engine config (byte-losslessness is a bf16-cache guarantee
-    — trim cannot roll back a monotone scale, documented)."""
+    — trim cannot roll back a monotone scale, documented). The dtype
+    axis exercises spec-decode trim over fp8 pools too."""
     model, params = model_and_params
     rng = np.random.default_rng(5)
     motif = rand_prompt(rng, 5)
     prompts = [motif * 5 + rand_prompt(rng, 3) for _ in range(2)]
-    plain = greedy_generate(make_engine(model, params, quant=True),
+    plain = greedy_generate(make_engine(model, params, quant=True,
+                                        qdtype=qdtype),
                             prompts, uid_base=1, max_new_tokens=20)
     sched = ContinuousBatchingScheduler(
-        make_engine(model, params, quant=True),
+        make_engine(model, params, quant=True, qdtype=qdtype),
         proposer=NGramProposer(ngram_max=3), max_draft_tokens=4)
     spec = greedy_generate(prompts=prompts, uid_base=1, max_new_tokens=20,
                            scheduler=sched)
@@ -242,16 +262,17 @@ def test_spec_decode_composes_bounded(model_and_params):
     assert np.mean(fracs) >= 0.5, f"spec divergence too large: {fracs}"
 
 
-def test_prefix_shared_blocks_share_scales(model_and_params):
-    """A prefix-cache hit under kv_quant shares the int8 blocks AND their
-    scale-plane entries (scales are indexed by pool block id): the second
-    request re-prefills only the tail and still matches the uncached
-    quantized engine's stream exactly."""
+@pytest.mark.parametrize("qdtype", KV_DTYPES)
+def test_prefix_shared_blocks_share_scales(model_and_params, qdtype):
+    """A prefix-cache hit under kv_quant shares the quantized blocks AND
+    their scale-plane entries (scales are indexed by pool block id): the
+    second request re-prefills only the tail and still matches the
+    uncached quantized engine's stream exactly."""
     model, params = model_and_params
     rng = np.random.default_rng(6)
     sysp = rand_prompt(rng, 40)
     tail_a, tail_b = rand_prompt(rng, 7), rand_prompt(rng, 7)
-    cached = make_engine(model, params, quant=True,
+    cached = make_engine(model, params, quant=True, qdtype=qdtype,
                          enable_prefix_cache=True)
     g_warm = greedy_generate(cached, [sysp + tail_a], uid_base=100,
                              max_new_tokens=8)
@@ -264,7 +285,7 @@ def test_prefix_shared_blocks_share_scales(model_and_params):
     # same prompts through a cache-less quantized engine: identical
     # streams — dequantizing a shared block with its shared scale is
     # exactly what the writer stored
-    plain = make_engine(model, params, quant=True)
+    plain = make_engine(model, params, quant=True, qdtype=qdtype)
     p_warm = greedy_generate(plain, [sysp + tail_a], uid_base=100,
                              max_new_tokens=8)
     p_hit = greedy_generate(plain, [sysp + tail_b], uid_base=200,
@@ -344,6 +365,11 @@ def test_configure_kv_quant_toggle_and_guard(model_and_params):
     assert set(eng.state_manager.kv_cache) == {"k", "v"}
     with pytest.raises(ValueError, match="dtype"):
         eng.configure_kv_quant(True, dtype="fp8")
+    # the reserved dtype surface is now real: int8 -> fp8_e4m3 rebuilds
+    # the pools at the new representation (legal while drained)
+    eng.configure_kv_quant(True, dtype="fp8_e4m3")
+    assert eng.state_manager.kv_cache["k"].dtype == jnp.float8_e4m3fn
+    assert eng.state_manager.kv_quant_dtype == "fp8_e4m3"
 
 
 # -------------------------------------------------- serving config + gauges
@@ -457,14 +483,29 @@ def test_bench_schema_validator():
     bench = importlib.import_module("bench")
     occ = {k: 1 for k in bench._OCCUPANCY_KEYS}
     good = {"kv_quant": {"max_concurrent_base": 8, "max_concurrent_int8": 16,
+                         "max_concurrent_fp8": 16,
                          "concurrency_ratio": 2.0, "budget_bytes": 1024,
-                         "ppl_base": 1.0, "ppl_int8": 1.0, "ppl_ratio": 1.0,
-                         "ppl_gate_ok": True, "greedy_parity": True,
+                         "ppl_base": 1.0, "ppl_int8": 1.0, "ppl_fp8": 1.0,
+                         "ppl_ratio": 1.0, "ppl_ratio_fp8": 1.0,
+                         "ppl_gate_ok": True, "ppl_gate_ok_fp8": True,
+                         "greedy_parity": True,
                          "mean_matched_prefix_frac": 1.0,
+                         "mean_matched_prefix_frac_fp8": 1.0,
                          "disabled_parity": True, "kv_occupancy": occ}}
+    good["weight_quant"] = {
+        "param_bytes_fp32": 4096, "param_bytes_int8": 1024,
+        "weight_compression_x": 4.0, "bytes_gate_ok": True,
+        "host_byte_budget": 1 << 20,
+        "replicas_at_budget_base": 2, "replicas_at_budget_int8": 8,
+        "prefill_ttft_base_ms": 9.0, "prefill_ttft_int8_ms": 8.0,
+        "decode_tpot_base_ms": 2.0, "decode_tpot_int8_ms": 1.8,
+        "ppl_base": 1.0, "ppl_int8": 1.0, "ppl_ratio": 1.0,
+        "ppl_gate_ok": True, "mean_matched_prefix_frac": 1.0,
+        "greedy_parity": True, "disabled_parity": True,
+        "kv_occupancy": dict(occ)}
     for name in bench._STAMPED_PHASES:
-        if name in ("kv_quant", "train_chaos", "disagg", "slo",
-                    "kv_tier", "overload", "autoscale"):
+        if name in ("kv_quant", "weight_quant", "train_chaos", "disagg",
+                    "slo", "kv_tier", "overload", "autoscale"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
     good["kv_tier"] = {"tier_on_p50_ttft_ms": 10.7,
@@ -587,6 +628,14 @@ def test_bench_schema_validator():
     bad4["kv_quant"] = dict(good["kv_quant"], max_concurrent_base=True)
     assert any("kv_quant.max_concurrent_base" in p
                for p in bench.validate_serving_schema(bad4))
+    # weight_quant typed checks: bool-for-int rejected, missing named
+    bad_wq = dict(good)
+    bad_wq["weight_quant"] = {"param_bytes_fp32": True, "bytes_gate_ok": 1}
+    problems_wq = bench.validate_serving_schema(bad_wq)
+    assert any("weight_quant.param_bytes_fp32" in p for p in problems_wq)
+    assert any("weight_quant.bytes_gate_ok" in p for p in problems_wq)
+    assert any("weight_quant.disabled_parity: missing" in p
+               for p in problems_wq)
     # slo typed checks: missing/mistyped fields named; a journal that
     # failed validate_events is a schema problem in its own right
     bad5 = dict(good)

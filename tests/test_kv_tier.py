@@ -40,13 +40,13 @@ def model_and_params():
 
 
 def make_engine(model, params, tier=True, kv_blocks=14, quant=False,
-                dtype=None, host_bytes=64 << 20, disk_path=None,
-                disk_bytes=0, prefix=True, max_seqs=4):
+                qdtype="int8", dtype=None, host_bytes=64 << 20,
+                disk_path=None, disk_bytes=0, prefix=True, max_seqs=4):
     vcfg = RaggedInferenceEngineConfig(
         max_ragged_batch_size=128, max_ragged_sequence_count=max_seqs,
         max_chunk_tokens=32, kv_blocks=kv_blocks, kv_block_size=BS,
         max_tracked_sequences=64, enable_prefix_cache=prefix,
-        kv_quant_enabled=quant)
+        kv_quant_enabled=quant, kv_quant_dtype=qdtype)
     eng = InferenceEngineV2(model, params=params, config=vcfg)
     if tier:
         eng.configure_kv_tier(True, host_bytes=host_bytes,
@@ -377,13 +377,18 @@ def test_tier_pressure_baseline_survives_transient_stats_failure():
 
 
 # --------------------------------------------------- spill/restore invariants
-@pytest.mark.parametrize("quant", [False, True])
-def test_spill_restore_byte_roundtrip(model_and_params, quant):
-    """An evicted block's slabs (int8 + scale planes under kv_quant)
-    must come back bit-identical when the prefix is matched again."""
+@pytest.mark.parametrize("quant,qdtype",
+                         [(False, "int8"), (True, "int8"),
+                          (True, "fp8_e4m3")],
+                         ids=["fp", "int8", "fp8"])
+def test_spill_restore_byte_roundtrip(model_and_params, quant, qdtype):
+    """An evicted block's slabs (int8/fp8 + scale planes under kv_quant)
+    must come back bit-identical when the prefix is matched again — the
+    ISSUE 13 dtype axis rides the same test, not a copy."""
     model, params = model_and_params
     rng = np.random.default_rng(7)
-    eng = make_engine(model, params, quant=quant, kv_blocks=16)
+    eng = make_engine(model, params, quant=quant, qdtype=qdtype,
+                      kv_blocks=16)
     prompt = rand_prompt(rng, 3 * BS + 2)
     eng.put([1], [prompt])
     sm = eng.state_manager

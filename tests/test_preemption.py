@@ -41,13 +41,14 @@ def model_and_params():
 
 
 def make_engine(model, params, kv_blocks=14, max_seqs=8, prefix=False,
-                quant=False, tier=False, reservation=False, preempt=False,
-                factor=1.0, policy="lowest_class", max_preempts=2):
+                quant=False, qdtype="int8", tier=False, reservation=False,
+                preempt=False, factor=1.0, policy="lowest_class",
+                max_preempts=2):
     vcfg = RaggedInferenceEngineConfig(
         max_ragged_batch_size=256, max_ragged_sequence_count=max_seqs,
         max_chunk_tokens=32, kv_blocks=kv_blocks, kv_block_size=BS,
         max_tracked_sequences=64, enable_prefix_cache=prefix,
-        kv_quant_enabled=quant,
+        kv_quant_enabled=quant, kv_quant_dtype=qdtype,
         admission_reservation=reservation,
         admission_oversubscription_factor=factor,
         admission_preemption_enabled=preempt,
@@ -63,10 +64,14 @@ def rand_prompt(rng, n):
     return rng.integers(0, VOCAB, size=n).tolist()
 
 
-def reference_streams(model, params, jobs, uid_base=90_000):
+def reference_streams(model, params, jobs, uid_base=90_000,
+                      quant=False, qdtype="int8"):
     """Uncontended sequential greedy streams (big pool, old admission)
-    — the parity baseline. ``jobs`` = [(prompt, max_new), ...]."""
-    eng = make_engine(model, params, kv_blocks=256, max_seqs=8)
+    — the parity baseline, at the SAME KV representation as the engine
+    under test (spill/resume is lossless relative to its own pools).
+    ``jobs`` = [(prompt, max_new), ...]."""
+    eng = make_engine(model, params, kv_blocks=256, max_seqs=8,
+                      quant=quant, qdtype=qdtype)
     sched = ContinuousBatchingScheduler(eng)
     out = []
     for i, (p, mn) in enumerate(jobs):
@@ -250,16 +255,22 @@ def test_admission_preempts_only_lower_urgency(model_and_params):
 # -------------------------------------------------- spill/resume round-trip
 @pytest.mark.parametrize("quant", [False, True],
                          ids=["fp32", "int8+scales"])
-def test_preempt_spill_resume_byte_roundtrip(model_and_params, quant):
+@pytest.mark.parametrize("qdtype", ["int8", "fp8_e4m3"])
+def test_preempt_spill_resume_byte_roundtrip(model_and_params, quant,
+                                             qdtype):
     """A preempted sequence's KV round-trips the spill store exactly —
-    pool slabs (and the int8 scale planes under kv_quant) byte-equal
+    pool slabs (and the int8/fp8 scale planes under kv_quant) byte-equal
     after resume, and the resumed greedy stream is byte-identical to an
-    uncontended run (the spilled logits are the decode state)."""
+    uncontended run at the same representation (the spilled logits are
+    the decode state). The ISSUE 13 dtype axis rides this same test."""
+    if not quant and qdtype != "int8":
+        pytest.skip("dtype axis only exists under kv_quant")
     model, params = model_and_params
     rng = np.random.default_rng(5)
     prompts = [rand_prompt(rng, 60), rand_prompt(rng, 60)]
     eng = make_engine(model, params, prefix=True, tier=True, quant=quant,
-                      reservation=True, preempt=True, factor=3.0)
+                      qdtype=qdtype, reservation=True, preempt=True,
+                      factor=3.0)
     sched = ContinuousBatchingScheduler(eng)
     sched.submit(500, prompts[0], max_new_tokens=16, shed_rank=1)
     for _ in range(4):
@@ -300,9 +311,10 @@ def test_preempt_spill_resume_byte_roundtrip(model_and_params, quant):
                                               "across spill/resume")
     fin = sched.run_to_completion(max_steps=2000)
     ref = reference_streams(model, params,
-                            [(prompts[0], 16), (prompts[1], 4)])
+                            [(prompts[0], 16), (prompts[1], 4)],
+                            quant=quant, qdtype=qdtype)
     assert_greedy_parity(ref, [fin[500].generated, fin[501].generated],
-                         f"preempt round-trip (quant={quant})")
+                         f"preempt round-trip (quant={quant}/{qdtype})")
 
 
 def test_resume_falls_back_to_reprefill_when_payload_dropped(

@@ -1,0 +1,165 @@
+"""ops/quantizer.py hardening (ISSUE 13 satellite): ragged-tail
+round-trips, Pallas-vs-XLA quant/dequant parity, int4 pack/unpack
+coverage, fp8_e4m3 groups, and the quantized_matmul serving hot op —
+all previously untested in tier-1."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import quantizer as Q
+
+RNG = np.random.default_rng(0)
+
+
+def randf(*shape):
+    return jnp.asarray(RNG.normal(size=shape), jnp.float32)
+
+
+# ---------------------------------------------------------- ragged tails
+class TestRaggedTail:
+    @pytest.mark.parametrize("n,block", [(100, 32), (7, 4), (130, 128),
+                                         (33, 32)])
+    def test_int8_round_trip(self, n, block):
+        x = randf(3, n)
+        q, s = Q.quantize_blockwise(x, block=block)
+        assert q.shape == x.shape and q.dtype == jnp.int8
+        assert s.shape == (3, -(-n // block))
+        xr = Q.dequantize_blockwise(q, s, block=block)
+        # worst-case step is amax/127 per group
+        bound = float(jnp.max(jnp.abs(x))) / 127 + 1e-6
+        assert float(jnp.max(jnp.abs(xr - x))) <= bound
+
+    def test_tail_group_scales_against_own_amax(self):
+        # big values in the body, tiny tail: a shared scale would crush
+        # the tail to zero — its own group must preserve it
+        x = jnp.concatenate([100.0 * randf(1, 64),
+                             0.01 * randf(1, 5)], axis=-1)
+        q, s = Q.quantize_blockwise(x, block=64)
+        xr = np.asarray(Q.dequantize_blockwise(q, s, block=64))
+        tail = np.asarray(x)[0, 64:]
+        np.testing.assert_allclose(xr[0, 64:], tail,
+                                   atol=np.abs(tail).max() / 100)
+
+    def test_block_inference_refuses_ragged(self):
+        x = randf(2, 33)
+        q, s = Q.quantize_blockwise(x, block=32)  # groups = 2, 33 % 2 != 0
+        with pytest.raises(ValueError, match="ragged"):
+            Q.dequantize_blockwise(q, s)          # block not inferable
+        # divisible case still infers
+        q2, s2 = Q.quantize_blockwise(randf(2, 96), block=32)
+        assert Q.dequantize_blockwise(q2, s2).shape == (2, 96)
+
+    def test_ragged_layout_needs_its_block_back(self):
+        """The undetectable ragged subcase (group count divides N):
+        inference would silently assume the divisor layout, so ragged
+        layouts must round-trip their explicit block — passing it back
+        is exact, and the divisor-layout inference on the SAME shapes
+        is a different (wrong for this data) segmentation."""
+        x = jnp.asarray([[8.0, 8.0, 8.0, 8.0, 0.5, 0.5]], jnp.float32)
+        q, s = Q.quantize_blockwise(x, block=4)   # groups = 2, 6 % 2 == 0
+        exact = np.asarray(Q.dequantize_blockwise(q, s, block=4))
+        np.testing.assert_allclose(exact, np.asarray(x), atol=8 / 127 + 1e-6)
+        # inference assumes block = 3: element 3 (an 8.0 in the true
+        # group 0) lands in the inferred tail group and dequantizes with
+        # the 0.5-amax scale — materially wrong, which is why the
+        # contract requires the explicit block
+        inferred = np.asarray(Q.dequantize_blockwise(q, s))
+        assert abs(inferred[0, 3] - 8.0) > 1.0
+
+    def test_int4_ragged_round_trip(self):
+        x = randf(2, 50)
+        q, s = Q.quantize_blockwise(x, bits=4, block=16)
+        assert int(jnp.max(jnp.abs(q))) <= 7
+        xr = Q.dequantize_blockwise(q, s, block=16)
+        bound = float(jnp.max(jnp.abs(x))) / 7 + 1e-6
+        assert float(jnp.max(jnp.abs(xr - x))) <= bound
+
+
+# ------------------------------------------------- Pallas-vs-XLA parity
+class TestPallasParity:
+    def test_quant_dequant_parity(self, monkeypatch):
+        """The Pallas (quantize, dequantize) pair in interpret mode must
+        match the XLA formulation bit for bit — same rounding, same
+        scale math."""
+        x = randf(16, 256)
+        qx, sx = Q._quantize_xla(x, 8, 128)
+        monkeypatch.setattr(Q, "_FORCE_INTERPRET", True)
+        qp, sp = Q.quantize_blockwise(x, block=128)
+        np.testing.assert_array_equal(np.asarray(qp), np.asarray(qx))
+        # scales agree to the ulp (the kernel's amax/qmax association
+        # may differ from XLA's by one rounding)
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(sx),
+                                   rtol=1e-6)
+        op = Q.dequantize_blockwise(qp, sp, block=128)
+        monkeypatch.setattr(Q, "_FORCE_INTERPRET", False)
+        ox = Q.dequantize_blockwise(qx, sx, block=128)
+        np.testing.assert_allclose(np.asarray(op), np.asarray(ox),
+                                   atol=1e-7)
+
+    def test_quantized_matmul_parity(self, monkeypatch):
+        w = randf(64, 256)
+        qw, qs = Q.quantize_blockwise(w, block=128)
+        x = randf(8, 64)
+        ref = Q.quantized_matmul(x, qw, qs)                     # XLA
+        monkeypatch.setattr(Q, "_FORCE_INTERPRET", True)
+        out = Q.quantized_matmul(x, qw, qs)                     # Pallas
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        # and both equal the explicit dequant-then-dot reference
+        dense = (x.astype(jnp.float32)
+                 @ Q.dequantize_blockwise(qw, qs, block=128))
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
+
+
+# ------------------------------------------------------------ int4 pack
+class TestInt4Pack:
+    def test_round_trip(self):
+        q = jnp.asarray(RNG.integers(-7, 8, size=(3, 32)), jnp.int8)
+        p = Q.pack_int4(q)
+        assert p.dtype == jnp.uint8 and p.shape == (3, 16)
+        np.testing.assert_array_equal(np.asarray(Q.unpack_int4(p)),
+                                      np.asarray(q))
+
+    def test_sign_extension_extremes(self):
+        q = jnp.asarray([[-7, 7, 0, -1]], jnp.int8)
+        np.testing.assert_array_equal(
+            np.asarray(Q.unpack_int4(Q.pack_int4(q))), np.asarray(q))
+
+    def test_quantize_pack_dequantize_chain(self):
+        x = randf(4, 64)
+        q, s = Q.quantize_blockwise(x, bits=4, block=32)
+        q2 = Q.unpack_int4(Q.pack_int4(q))
+        xr = Q.dequantize_blockwise(q2, s, block=32)
+        bound = float(jnp.max(jnp.abs(x))) / 7 + 1e-6
+        assert float(jnp.max(jnp.abs(xr - x))) <= bound
+
+
+# ------------------------------------------------------------------- fp8
+class TestFP8:
+    def test_round_trip_relative_error(self):
+        x = randf(4, 128)
+        q, s = Q.quantize_blockwise(x, block=64, dtype="fp8_e4m3")
+        assert q.dtype == jnp.float8_e4m3fn
+        xr = Q.dequantize_blockwise(q, s, block=64)
+        # e4m3: ~2^-3 relative step near amax
+        rel = float(jnp.max(jnp.abs(xr - x)) / jnp.max(jnp.abs(x)))
+        assert rel <= 0.07, rel
+
+    def test_fp8_matmul_matches_dequant_reference(self):
+        w = randf(32, 128)
+        qw, qs = Q.quantize_blockwise(w, block=128, dtype="fp8_e4m3")
+        x = randf(4, 32)
+        out = Q.quantized_matmul(x, qw, qs)
+        dense = (x.astype(jnp.float32)
+                 @ Q.dequantize_blockwise(qw, qs, block=128))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_zero_group_is_exact(self):
+        x = jnp.zeros((2, 64), jnp.float32)
+        q, s = Q.quantize_blockwise(x, block=32, dtype="fp8_e4m3")
+        assert float(jnp.max(jnp.abs(
+            Q.dequantize_blockwise(q, s, block=32)))) == 0.0
