@@ -54,6 +54,7 @@ import numpy as np
 
 from pydantic import Field
 
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from ..utils.restart import RestartPolicy
 from .config_utils import DSConfigModel
@@ -174,6 +175,10 @@ class TrainFaultInjector:
     the gradient accumulator). ``at_step_range: [lo, hi]`` draws the step
     from the seeded RNG at construction — same seed, same failure story."""
 
+    # ``events`` is immutable after construction; the firing ledger is
+    # multi-writer (docs/CONCURRENCY.md)
+    _GUARDED_BY = {"fired_log": "_lock"}
+
     def __init__(self, schedule: List[Dict[str, Any]], seed: int = 0):
         self.seed = int(seed)
         self.rng = random.Random(self.seed)
@@ -192,7 +197,7 @@ class TrainFaultInjector:
                 raise ValueError(f"{ev.kind} fault needs at_step "
                                  "(or at_step_range)")
             self.events.append(ev)
-        self._lock = threading.Lock()
+        self._lock = RankedLock("train.faults")
         self.fired_log: List[tuple] = []   # (kind, step, monotonic t)
 
     def _take(self, step: int) -> List[TrainFaultEvent]:
@@ -248,6 +253,10 @@ class StepWatchdog:
     once); recovery is the supervisor's job — the wedged thread is stuck
     inside a device call nobody can interrupt."""
 
+    # the duration ring is the only cross-thread structure; the step
+    # bracket (``_step_started``) is a single-writer watermark
+    _GUARDED_BY = {"_durations": "_dur_lock"}
+
     def __init__(self, poll_s: float = 0.5, step_timeout_s: float = 0.0,
                  factor: float = 10.0, min_samples: int = 5,
                  on_wedge: Optional[Callable[[float], None]] = None,
@@ -262,7 +271,7 @@ class StepWatchdog:
         # thread medians — an unguarded sort over a mutating deque
         # raises and would silently kill the watchdog (the one thread
         # that must not die quietly)
-        self._dur_lock = threading.Lock()
+        self._dur_lock = RankedLock("train.watchdog.durations")
         self._step_started: Optional[float] = None
         self.wedged = threading.Event()
         self._stop = threading.Event()

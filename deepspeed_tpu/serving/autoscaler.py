@@ -57,6 +57,7 @@ import time
 from collections import deque
 from typing import Optional, Tuple
 
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from .config import AutoscalerConfig
 
@@ -110,6 +111,19 @@ class FleetController:
         set_proactive_brownout(fraction | None) -> None
     """
 
+    # lock discipline (docs/CONCURRENCY.md): the decision ledger and the
+    # replica-seconds accounting are shared between the router-tick
+    # thread and stats()/health_report() readers. The hysteresis streaks
+    # and cooldown anchors are deliberately unguarded: tick-thread-
+    # confined (one decision round at a time by construction).
+    _GUARDED_BY = {
+        "decision_log": "_lock",
+        "_action_counts": "_lock",
+        "_replica_seconds": "_lock",
+        "_peak_replicas": "_lock",
+        "_last_wall": "_lock",
+    }
+
     def __init__(self, config: AutoscalerConfig, fleet,
                  metrics=None, journal=None, clock=time.monotonic,
                  async_actions: bool = True):
@@ -118,7 +132,7 @@ class FleetController:
         self.metrics = metrics
         self.journal = journal
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = RankedLock("serving.autoscaler")
         # completed actions, exactly one entry per journal event — the
         # churn suite cross-checks the two (tests/test_journal.py).
         # Bounded like the journal ring (a long-lived elastic fleet

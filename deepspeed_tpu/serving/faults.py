@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..utils.locks import RankedLock
+
 KINDS = ("crash", "wedge", "put_error", "slow_forward")
 _STEP_KINDS = ("crash", "wedge")
 _PUT_KINDS = ("put_error", "slow_forward")
@@ -69,6 +71,10 @@ class FaultInjector:
     RNG at construction — a *seeded schedule*: different seeds explore
     different failure points, the same seed replays exactly."""
 
+    # ``events`` is immutable after construction (schedule built in
+    # __init__); only the firing ledger is multi-writer
+    _GUARDED_BY = {"fired_log": "_lock"}
+
     def __init__(self, schedule: List[Dict[str, Any]], seed: int = 0):
         self.seed = int(seed)
         self.rng = random.Random(self.seed)
@@ -89,7 +95,7 @@ class FaultInjector:
             if ev.kind in _PUT_KINDS and ev.at_put is None:
                 raise ValueError(f"{ev.kind} fault needs at_put")
             self.events.append(ev)
-        self._lock = threading.Lock()
+        self._lock = RankedLock("serving.faults")
         # (kind, replica, index, monotonic t) per firing — what the chaos
         # tests and the bench chaos phase assert against / report
         self.fired_log: List[tuple] = []

@@ -25,6 +25,7 @@ from ..telemetry import FlightRecorder  # noqa: F401  (re-export surface)
 from ..telemetry.journal import OpsJournal
 from ..telemetry.slo import AlertEngine
 from ..telemetry.windowed import WindowedMetrics
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from .config import ServingConfig
 from .metrics import MetricsRegistry, serving_metrics
@@ -36,6 +37,17 @@ from .router import ReplicaRouter
 
 
 class ServingFrontend:
+    # lock discipline (docs/CONCURRENCY.md): membership admin state is
+    # written under the fleet lock. ``_closed`` and ``_role_overrides``
+    # are writes-only guarded — their readers (submit's fast-path check,
+    # the supervisor's restart-time role lookup) take lock-free
+    # last-write-wins snapshots by design.
+    _GUARDED_BY = {
+        "_closed": "_fleet_lock:writes",
+        "_next_replica_id": "_fleet_lock",
+        "_role_overrides": "_fleet_lock:writes",
+    }
+
     def __init__(self, engines: Sequence, config: Optional[ServingConfig] = None,
                  sample_fn: Optional[Callable] = None,
                  metrics: Optional[MetricsRegistry] = None,
@@ -107,7 +119,7 @@ class ServingFrontend:
         self._engine_factory = engine_factory
         self._next_replica_id = len(engines)
         self._role_overrides: dict = {}
-        self._fleet_lock = threading.Lock()
+        self._fleet_lock = RankedLock("serving.frontend.fleet")
         # evacuated KV rides the same bounded host-RAM staging budget
         # as disagg handoffs (built lazily when no handoff stager
         # exists) — a removal of a fully-loaded replica must not

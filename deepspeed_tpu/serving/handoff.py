@@ -24,14 +24,19 @@ request can never pin the buffer.
 
 from __future__ import annotations
 
-import threading
+from ..utils.locks import RankedLock
 
 
 class HandoffStager:
+    # lock discipline (docs/CONCURRENCY.md): the staged-uid set is hit
+    # from prefill workers (stage), decode workers (consume) and every
+    # terminal path (release via ServingRequest.finish).
+    _GUARDED_BY = {"_staged": "_lock"}
+
     def __init__(self, max_staged: int, metrics=None):
         self.max_staged = max(1, int(max_staged))
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = RankedLock("serving.handoff")
         self._staged: set = set()        # uids holding a staged payload
 
     def __len__(self) -> int:

@@ -24,10 +24,21 @@ DEFAULT_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 # Queue-depth style buckets (counts).
 DEFAULT_DEPTH_BUCKETS = (0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
                          256.0, 512.0, 1024.0)
+# Lock-hold buckets (seconds): healthy holds are microseconds; the tail
+# is what the RankedLock debug mode (docs/CONCURRENCY.md) pages on.
+LOCK_HOLD_BUCKETS = (1e-6, 1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05,
+                     0.1, 0.5, 1.0, 5.0, 30.0)
 
 
 class Counter:
     """Monotonic counter."""
+
+    # series locks stay plain threading.Lock (the observe hot path);
+    # the rank hint ties them into the concurrency lint's order graph
+    _LOCK_RANKS = {"_lock": "serving.metrics.series"}
+    # value reads are lock-free by design: a float read is atomic under
+    # the GIL and monotonic publication tolerates staleness
+    _GUARDED_BY = {"_value": "_lock:writes"}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -44,6 +55,9 @@ class Counter:
 
 class Gauge:
     """Last-write-wins instantaneous value."""
+
+    _LOCK_RANKS = {"_lock": "serving.metrics.series"}
+    _GUARDED_BY = {"_value": "_lock:writes"}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -67,6 +81,12 @@ class Gauge:
 
 class Histogram:
     """Fixed-bucket histogram (cumulative counts per upper bound + +Inf)."""
+
+    _LOCK_RANKS = {"_lock": "serving.metrics.series"}
+    # bucket counts must be read under the lock (buckets_snapshot is the
+    # sanctioned reader); sum/count properties are lock-free snapshots
+    _GUARDED_BY = {"_counts": "_lock", "_sum": "_lock:writes",
+                   "_count": "_lock:writes"}
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
         self.bounds = tuple(sorted(float(b) for b in buckets))
@@ -188,6 +208,10 @@ class MetricsRegistry:
     tuples the :class:`deepspeed_tpu.monitor.Monitor` backends consume;
     ``publish(monitor, step)`` writes them through any object with the
     ``write_events`` API (e.g. ``MonitorMaster``)."""
+
+    _LOCK_RANKS = {"_lock": "serving.metrics.registry"}
+    _GUARDED_BY = {"_counters": "_lock", "_gauges": "_lock",
+                   "_histograms": "_lock"}
 
     def __init__(self, prefix: str = "serving"):
         self.prefix = prefix
@@ -447,6 +471,9 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # (docs/SERVING.md "Admission and preemption")
               "preempt_spill_s", "preempt_resume_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
+    # RankedLock debug-mode hold times (docs/CONCURRENCY.md): zero
+    # samples unless enable_lock_debug() attached this registry
+    reg.histogram("lock_hold_s", LOCK_HOLD_BUCKETS)
     # per-class series (docs/SERVING.md "Disaggregated serving",
     # docs/OBSERVABILITY.md "SLOs and burn-rate alerts"): latency splits,
     # queue depth, submit/shed counters — the SLO engine's raw material

@@ -22,15 +22,31 @@ from __future__ import annotations
 
 import heapq
 import math
-import threading
 import time
 from typing import List, Optional
 
+from ..utils.locks import RankedCondition
 from .metrics import MetricsRegistry
 from .request import Rejected, RequestState, ServingRequest, FinishReason
 
 
 class AdmissionQueue:
+    # lock discipline (docs/CONCURRENCY.md): every queue structure and
+    # every brownout/pressure input moves under the admission condition.
+    # ``_preempt_pressure`` is writes-only guarded: the reader side is a
+    # last-write-wins advisory label on shed accounting (a stale read
+    # mislabels one shed; a torn structure would corrupt the heap).
+    _GUARDED_BY = {
+        "_heap": "_lock",
+        "_class_depth": "_lock",
+        "_earliest_deadline": "_lock",
+        "_closed": "_lock",
+        "_brownout": "_lock",
+        "_healthy_frac": "_lock",
+        "_proactive_frac": "_lock",
+        "_preempt_pressure": "_lock:writes",
+    }
+
     def __init__(self, max_depth: int, metrics: Optional[MetricsRegistry] = None,
                  brownout_threshold: float = 0.0, journal=None):
         self.max_depth = int(max_depth)
@@ -58,7 +74,7 @@ class AdmissionQueue:
         # shed because the KV pool is oversubscribed" is a different
         # incident than "we shed because replicas died" (brownout).
         self._preempt_pressure = False
-        self._lock = threading.Condition()
+        self._lock = RankedCondition("serving.queue")
         self._heap: List[tuple] = []      # (order_key, ServingRequest)
         # per-request-class depth (docs/SERVING.md "Disaggregated
         # serving"): published as queue_depth_class_<cls> gauges; shed
@@ -106,8 +122,12 @@ class AdmissionQueue:
         """Frontend tick hook: preemption/reservation pressure somewhere
         in the fleet. Labels subsequent overload sheds (no effect on
         admission itself — reservation pressure is resolved by the
-        schedulers, not by shrinking the queue)."""
-        self._preempt_pressure = bool(active)
+        schedulers, not by shrinking the queue). The write takes the
+        lock (concurrency lint, guarded-field): the tick thread races
+        shedding pops, and the write side of a guarded flag is where
+        the ordering with those sheds is pinned down."""
+        with self._lock:
+            self._preempt_pressure = bool(active)
 
     def offer(self, req: ServingRequest, block: bool = False,
               timeout: Optional[float] = None) -> None:
@@ -208,14 +228,22 @@ class AdmissionQueue:
         below 1.0 is fed — the queue enters brownout: the depth bound
         shrinks and already-queued lowest-urgency work is shed with
         reason "brownout" — graceful degradation sacrifices the least
-        important work explicitly instead of timing everything out."""
-        if self.brownout_threshold <= 0.0 and not _force \
-                and self._proactive_frac >= 1.0:
-            return
+        important work explicitly instead of timing everything out.
+
+        Every read of the brownout inputs happens under the lock
+        (concurrency lint, guarded-field): the early-exit check used to
+        read ``_proactive_frac`` lock-free and the journal line below
+        used to re-read ``_healthy_frac`` after release — a concurrent
+        ``set_proactive_fraction`` could journal a transition with a
+        fraction that never caused it."""
         shed: List[ServingRequest] = []
         transition = None
         with self._lock:
+            if self.brownout_threshold <= 0.0 and not _force \
+                    and self._proactive_frac >= 1.0:
+                return
             self._healthy_frac = max(0.0, min(1.0, float(frac)))
+            healthy_now = self._healthy_frac
             was = self._brownout
             self._brownout = (
                 (self.brownout_threshold > 0.0
@@ -238,7 +266,7 @@ class AdmissionQueue:
         if transition is not None and self.journal is not None:
             self.journal.emit(
                 "brownout_enter" if transition else "brownout_exit",
-                healthy_fraction=round(self._healthy_frac, 4),
+                healthy_fraction=round(healthy_now, 4),
                 shed_now=len(shed))
         for req in shed:
             self._count_shed(req, FinishReason.BROWNOUT)

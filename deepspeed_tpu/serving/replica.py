@@ -33,6 +33,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..inference.v2.scheduler import ContinuousBatchingScheduler
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from .metrics import MetricsRegistry
 from .request import FinishReason, RequestState, ServingRequest
@@ -46,6 +47,19 @@ class ReplicaState(enum.Enum):
 
 
 class Replica:
+    # lock discipline (docs/CONCURRENCY.md): the load split and the
+    # failure-detach gate are multi-writer (worker loop, router
+    # dispatch, supervisor, admin drain) and must only move under the
+    # replica lock. ``_active`` is deliberately NOT guarded: writes are
+    # worker-thread-confined and the cross-thread readers (check_health,
+    # stop) take racy snapshots settled by the ``_failed_uids`` gate.
+    _GUARDED_BY = {
+        "_outstanding": "_lock",
+        "_out_prefill": "_lock",
+        "_out_decode": "_lock",
+        "_failed_uids": "_lock",
+    }
+
     def __init__(self, replica_id: int, engine,
                  metrics: Optional[MetricsRegistry] = None,
                  sample_fn: Optional[Callable] = None,
@@ -125,7 +139,7 @@ class Replica:
         # the same request; exactly one may fail over / finish it (a
         # double requeue would split one stream across two replicas)
         self._failed_uids: set = set()
-        self._lock = threading.Lock()
+        self._lock = RankedLock("serving.replica")
         self._outstanding = 0             # token-weighted load estimate
         # phase-split load (docs/SERVING.md "Disaggregated serving"):
         # prefill tokens still to process vs decode tokens still owed.

@@ -18,6 +18,8 @@ import threading
 import time
 from typing import Iterator, List, Optional, Union
 
+from ..utils.locks import RankedLock
+
 
 class Priority(enum.IntEnum):
     """Lower value = served first (heap order)."""
@@ -78,7 +80,7 @@ StreamEvent = Union[TokenEvent, DoneEvent]
 class ServingRequest:
     """Internal per-request record; user code holds the RequestHandle."""
 
-    _seq_lock = threading.Lock()
+    _seq_lock = RankedLock("serving.request.seq")
     _seq = 0
 
     def __init__(self, prompt_tokens: List[int], max_new_tokens: int,

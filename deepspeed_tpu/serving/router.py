@@ -22,6 +22,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from .metrics import MetricsRegistry
 from .queue import AdmissionQueue
@@ -40,6 +41,12 @@ DECODE_CAPABLE = ("decode", "mixed")
 
 
 class ReplicaRouter:
+    # lock discipline (docs/CONCURRENCY.md): the replica list is the
+    # rebind-under-lock / lock-free-snapshot-read publication pattern —
+    # every structural WRITE holds the membership lock; readers take
+    # ``self.replicas`` as an immutable snapshot (writes-only mode).
+    _GUARDED_BY = {"replicas": "_membership_lock:writes"}
+
     def __init__(self, replicas: List[Replica], admission: AdmissionQueue,
                  metrics: Optional[MetricsRegistry] = None,
                  poll_interval_s: float = 0.05,
@@ -61,7 +68,8 @@ class ReplicaRouter:
         # restart swap — happens under this lock and rebinds/writes the
         # list atomically, so lock-free readers (the dispatch loop, the
         # health sweep, health_report) always see a consistent fleet
-        self._membership_lock = threading.RLock()
+        self._membership_lock = RankedLock("serving.router.membership",
+                                           reentrant=True)
         self.admission = admission
         self.metrics = metrics
         # request tracing + periodic flight-recorder metric snapshots

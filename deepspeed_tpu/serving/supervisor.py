@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from ..utils.restart import RestartPolicy
 from .config import FaultToleranceConfig
@@ -57,6 +58,11 @@ class _Slot:
 
 
 class ReplicaSupervisor:
+    # lock discipline (docs/CONCURRENCY.md): the slot table and the
+    # restart ledger are shared between the supervisor loop, the
+    # autoscaler's retire path and the frontend's membership admin.
+    _GUARDED_BY = {"_slots": "_lock", "restart_log": "_lock"}
+
     def __init__(self, router, replica_factory: Callable,
                  engine_factory: Optional[Callable],
                  config: Optional[FaultToleranceConfig] = None,
@@ -79,7 +85,7 @@ class ReplicaSupervisor:
         self._slots: dict = {
             r.replica_id: _Slot(r.replica_id, self._new_policy())
             for r in router.replicas}
-        self._lock = threading.Lock()
+        self._lock = RankedLock("serving.supervisor")
         # per-restart records: {"replica", "t_dead", "t_restarted",
         # "backoff_s", "attempt"} — the bench chaos phase's
         # recovery_time_s = t_restarted - t_dead

@@ -25,11 +25,23 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 from .tracer import Tracer, chrome_trace
 
 
 class FlightRecorder:
+    # lock discipline (docs/CONCURRENCY.md): snapshot ring, dump
+    # sequence and the rate-limiter window are shared between the
+    # router tick, replica death paths and on-demand dumps.
+    # ``_providers`` is append-at-wiring-time, read-only afterwards.
+    _GUARDED_BY = {
+        "_snapshots": "_lock",
+        "_last_snapshot_t": "_lock",
+        "_dump_seq": "_lock",
+        "_error_dump_times": "_lock",
+    }
+
     def __init__(self, tracer: Tracer, max_snapshots: int = 32,
                  dump_dir: Optional[str] = None, max_error_dumps: int = 3,
                  error_dump_window_s: float = 3600.0):
@@ -42,7 +54,7 @@ class FlightRecorder:
         self.error_dump_window_s = float(error_dump_window_s)
         self._providers: List[tuple] = []       # (name, fn() -> dict)
         self._snapshots: "deque[Dict[str, Any]]" = deque(maxlen=max_snapshots)
-        self._lock = threading.Lock()
+        self._lock = RankedLock("telemetry.recorder")
         self._last_snapshot_t = 0.0
         self._dump_seq = 0
         self._error_dump_times: "deque[float]" = deque()
@@ -69,11 +81,20 @@ class FlightRecorder:
     def maybe_snapshot(self, interval_s: float = 1.0) -> None:
         """Periodic-snapshot hook for polling loops (the serving router
         calls this each iteration); cheap no-op when disabled or within
-        the interval."""
+        the interval. The cadence check CLAIMS the watermark in the
+        same locked section it reads it (concurrency lint,
+        guarded-field): the router tick and the supervisor's
+        restart-dump path race here, and a check-then-snapshot that
+        isn't atomic lets both pass the interval test and snapshot back
+        to back."""
         if not self.tracer.enabled:
             return
-        if self.tracer.clock() - self._last_snapshot_t >= interval_s:
-            self.snapshot_metrics()
+        now = self.tracer.clock()
+        with self._lock:
+            if now - self._last_snapshot_t < interval_s:
+                return
+            self._last_snapshot_t = now       # claim: the loser skips
+        self.snapshot_metrics()
 
     # ---------------------------------------------------------------- dumps
     def record(self) -> Dict[str, Any]:

@@ -38,6 +38,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 
 #: kind -> required detail-field names. Extra fields are welcome (they
@@ -138,6 +139,19 @@ def validate_events(events: Sequence[dict]) -> List[str]:
 
 
 class OpsJournal:
+    # lock discipline (docs/CONCURRENCY.md): ring, seq counter and sink
+    # accounting move together under one lock — seq order in the ring
+    # and in the JSONL sink must agree (see emit). The sink write under
+    # the lock is a BASELINED blocking-while-locked exception: it is the
+    # documented durability contract, bounded to one line per event.
+    _GUARDED_BY = {
+        "_ring": "_lock",
+        "_seq": "_lock",
+        "_emitted": "_lock",
+        "_file_bytes": "_lock",
+        "_file_capped": "_lock",
+    }
+
     def __init__(self, capacity: int = 512, source: str = "serving",
                  path: Optional[str] = None,
                  max_file_bytes: int = 8 * 1024 * 1024,
@@ -147,7 +161,7 @@ class OpsJournal:
         self.path = path
         self.max_file_bytes = int(max_file_bytes)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = RankedLock("telemetry.journal")
         self._ring: "deque[dict]" = deque(maxlen=self.capacity)
         self._seq = 0
         self._emitted = 0                   # total ever (ring evicts)

@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 from pydantic import Field
 
 from ..runtime.config_utils import DSConfigModel
+from ..utils.locks import RankedLock
 from ..utils.logging import logger
 
 #: error budget implied by a pXX latency target: p95 ⇒ 5% may exceed
@@ -121,6 +122,12 @@ class AlertState:
 
 
 class AlertEngine:
+    # lock discipline (docs/CONCURRENCY.md): rule states are read by
+    # health_report/fleet_signals threads while evaluate mutates.
+    # ``_last_eval`` stays unguarded: single evaluator by construction
+    # (the router tick), and a stale read only double-evaluates.
+    _GUARDED_BY = {"_states": "_lock"}
+
     def __init__(self, config: SLOConfig, windowed, metrics=None,
                  journal=None, recorder=None, clock=time.monotonic):
         self.config = config
@@ -129,7 +136,7 @@ class AlertEngine:
         self.journal = journal
         self.recorder = recorder
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = RankedLock("telemetry.slo")
         self._last_eval = 0.0
         self.rules: List[AlertRule] = []
         for cls, target in sorted(config.classes.items()):

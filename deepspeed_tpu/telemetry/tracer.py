@@ -36,6 +36,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from ..utils.locks import RankedLock
+
 
 class _NoopSpan:
     """Shared do-nothing span returned by a disabled tracer. One instance
@@ -132,6 +134,11 @@ class Tracer:
     the form for intervals that start and finish on different threads.
     Both return :data:`NOOP_SPAN` when disabled."""
 
+    # lock discipline (docs/CONCURRENCY.md): the span rings are written
+    # from every instrumented thread; the thread-local nesting stack
+    # needs no lock by construction
+    _GUARDED_BY = {"_spans": "_lock", "_open": "_lock"}
+
     def __init__(self, enabled: bool = True, max_spans: int = 8192,
                  clock=time.monotonic, xla_annotations: bool = False):
         self.enabled = bool(enabled)
@@ -142,7 +149,7 @@ class Tracer:
         # open (started, un-ended) spans, so crash dumps show in-flight
         # work; insertion-ordered for the leak cap below
         self._open: Dict[int, Span] = {}
-        self._lock = threading.Lock()
+        self._lock = RankedLock("telemetry.tracer")
         self._ids = itertools.count(1)
         self._local = threading.local()
 

@@ -30,9 +30,10 @@ is passive — nothing here mutates the registry.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Sequence
+
+from ..utils.locks import RankedLock
 
 
 def _percentile_from(bounds, counts, q):
@@ -54,6 +55,10 @@ def _fraction_over_from(bounds, counts, threshold):
 
 
 class WindowedMetrics:
+    # lock discipline (docs/CONCURRENCY.md): uncoordinated tickers (the
+    # router loop + every health_report caller) mutate the ring
+    _GUARDED_BY = {"_ring": "_lock"}
+
     def __init__(self, registry, bucket_s: float = 1.0,
                  history_s: float = 900.0,
                  clock=time.monotonic):
@@ -61,7 +66,7 @@ class WindowedMetrics:
         self.bucket_s = max(0.05, float(bucket_s))
         self.max_snapshots = max(2, int(float(history_s) / self.bucket_s))
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = RankedLock("telemetry.windowed")
         # ring of {"t": monotonic, "counters": {...}, "hists": {...}}
         # snapshots; each snapshot is immutable after append
         self._ring: List[dict] = []

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""Concurrency lint CLI — the tier-1 gate front-end (docs/CONCURRENCY.md).
+
+Runs the static analyzer (deepspeed_tpu/analysis/): guarded-field
+discipline, lock-order graph + rank inversions, blocking-while-locked,
+and the declared-name audits (metric names, journal kinds), filtered
+through the audited baseline. Exit 0 = clean (baselined exceptions
+excluded); non-zero = findings, printed one per line prefixed LINT (the
+tier-1 failure digest greps for that prefix).
+
+    scripts/lint_concurrency.py                    # the gate
+    scripts/lint_concurrency.py --no-baseline      # raw findings
+    scripts/lint_concurrency.py --update-baseline  # rewrite baseline;
+        # existing justifications survive, new entries get an UNAUDITED
+        # placeholder a reviewer must replace
+"""
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from deepspeed_tpu.analysis import (  # noqa: E402
+    DEFAULT_BASELINE, DEFAULT_PATHS, analyze, apply_baseline,
+    check_declared_names, load_baseline, render_baseline, run_repo)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="analysis roots (default: the threaded modules)")
+    ap.add_argument("--root", default=_REPO)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline path, repo-relative")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to cover current findings "
+                         "(preserving existing justifications)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline:
+        if args.paths:
+            # a scoped regeneration would silently drop every audited
+            # entry covering files outside the given paths
+            print("lint_concurrency: --update-baseline only works "
+                  "full-scope (no path arguments)", file=sys.stderr)
+            return 2
+        findings = analyze(args.root, DEFAULT_PATHS)
+        findings += check_declared_names(args.root)
+        entries, _ = load_baseline(args.root, args.baseline)
+        text = render_baseline(findings, entries)
+        with open(os.path.join(args.root, args.baseline), "w") as fh:
+            fh.write(text)
+        print(f"lint_concurrency: wrote {len(findings)} entries to "
+              f"{args.baseline} — audit every UNAUDITED justification")
+        return 0
+
+    active, suppressed = run_repo(
+        args.root, paths=args.paths or None,
+        baseline_path=args.baseline,
+        use_baseline=not args.no_baseline)
+    if not args.quiet:
+        for f in sorted(active, key=lambda f: (f.path, f.line)):
+            print(f.render())
+    print(f"lint_concurrency: {len(active)} finding(s), "
+          f"{len(suppressed)} baselined exception(s)")
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

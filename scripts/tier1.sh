@@ -69,16 +69,30 @@ TARGET="tests/"
 if [ -n "${TIER1_CHAOS_TRAIN:-}" ] && [ "${TIER1_CHAOS_TRAIN}" != "0" ]; then
     TARGET="tests/test_train_resilience.py"
 fi
+# Concurrency lint (docs/CONCURRENCY.md): gates every PR alongside the
+# tests — guarded-field/lock-order/blocking-while-locked over the
+# threaded serving/telemetry modules plus the metric-name/journal-kind
+# audits, baselined exceptions in deepspeed_tpu/analysis/baseline.toml.
+python scripts/lint_concurrency.py 2>&1 | tee -a "$LOG"
+lint_rc=${PIPESTATUS[0]}
 timeout -k 10 "${TIER1_TIMEOUT:-1800}" env JAX_PLATFORMS=cpu \
     python -m pytest "$TARGET" -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
-    -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee "$LOG"
+    -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee -a "$LOG"
 rc=${PIPESTATUS[0]}
+if [ "$rc" -eq 0 ] && [ "$lint_rc" -ne 0 ]; then
+    rc=$lint_rc
+fi
 if [ "$rc" -ne 0 ]; then
-    # failure digest: the last 20 failed/errored test ids, so a
-    # regression is diagnosable from this log alone (no re-run needed)
+    # failure digest: the last 20 failed/errored test ids plus any
+    # concurrency-lint findings, so a regression is diagnosable from
+    # this log alone (no re-run needed)
     echo "=== FAILURE DIGEST (last 20 failed test ids) ==="
     grep -aE '^(FAILED|ERROR) ' "$LOG" | tail -20
+    if [ "$lint_rc" -ne 0 ]; then
+        echo "--- concurrency lint findings ---"
+        grep -a '^LINT ' "$LOG" | tail -20
+    fi
     echo "=== END DIGEST (full log: $LOG) ==="
 fi
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" \
