@@ -291,7 +291,37 @@ _WEIGHT_QUANT_KEYS = (("param_bytes_fp32", int),
 _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
                    "weight_quant",
-                   "disagg", "slo", "kv_tier", "overload", "autoscale")
+                   "disagg", "slo", "kv_tier", "overload", "autoscale",
+                   "fabric")
+# Typed shape of the fabric phase (docs/SERVING.md "Multi-host
+# serving"): in-process vs subprocess-replica latency, per-RPC
+# transport overhead, the cross-process handoff count, and the parity
+# bits the acceptance gates read (subprocess byte-parity + fabric
+# block disabled byte-parity, both asserted in-phase).
+_FABRIC_KEYS = (("replicas", int),
+                ("n_requests", int),
+                ("prompt_len", int),
+                ("max_new", int),
+                ("chunk_blocks", int),
+                ("local_p50_ttft_ms", (int, float)),
+                ("local_p95_ttft_ms", (int, float)),
+                ("local_p50_tpot_ms", (int, float)),
+                ("local_p95_tpot_ms", (int, float)),
+                ("fabric_p50_ttft_ms", (int, float)),
+                ("fabric_p95_ttft_ms", (int, float)),
+                ("fabric_p50_tpot_ms", (int, float)),
+                ("fabric_p95_tpot_ms", (int, float)),
+                ("rpc_calls", int),
+                ("rpc_p50_ms", (int, float)),
+                ("rpc_p95_ms", (int, float)),
+                ("rpc_overhead_p50_ttft_ms", (int, float)),
+                ("handoffs_completed_local", int),
+                ("handoffs_completed_fabric", int),
+                ("handoff_fallbacks_fabric", int),
+                ("handle_disconnects", int),
+                ("parity", bool),
+                ("disabled_parity", bool),
+                ("zero_wedges", bool))
 # Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
 # TTFT comparison with the device pool sized below the prefix working
 # set, spill/restore counts, and the parity bits the acceptance gates
@@ -484,6 +514,11 @@ def validate_serving_schema(serving: dict):
         problems.append("autoscale: missing or not an object")
     elif "phase_skipped" not in a:
         _check_typed_phase("autoscale", a, _AUTOSCALE_KEYS, problems)
+    fb = serving.get("fabric")
+    if not isinstance(fb, dict):
+        problems.append("fabric: missing or not an object")
+    elif "phase_skipped" not in fb:
+        _check_typed_phase("fabric", fb, _FABRIC_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -2220,6 +2255,181 @@ def bench_serving(on_tpu: bool):
             "prompt_lens": sorted(lens),
         }
 
+    def run_fabric_phase():
+        """Cross-process serving fabric (docs/SERVING.md "Multi-host
+        serving"): the same 1-prefill + 1-decode disaggregated fleet run
+        three ways — (a) in-process, (b) in-process with the ``fabric``
+        block present but DISABLED (asserted byte-for-byte (a)), and
+        (c) as two REAL subprocess replica servers
+        (scripts/serve_replica.py, each its own JAX runtime) adopted
+        over the RPC transport. Greedy byte-parity across all three is
+        asserted (with cross-process handoffs > 0 so it isn't vacuous),
+        every request must finish (zero wedges), and the RPC transport
+        overhead is measured and stamped (per-call rpc_call_s
+        percentiles + the TTFT delta vs in-process)."""
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+
+        # self-contained seeded model: the subprocess servers rebuild
+        # IDENTICAL weights from the spec (model kwargs + seed), which
+        # is what makes local-vs-subprocess byte-parity meaningful
+        model_kw = dict(vocab_size=512, hidden_size=128,
+                        intermediate_size=256, num_layers=2, num_heads=4,
+                        max_seq_len=256, norm="rmsnorm",
+                        activation="silu", position="rope")
+        eng_kw = dict(max_ragged_batch_size=256,
+                      max_ragged_sequence_count=8, max_chunk_tokens=32,
+                      kv_blocks=64, kv_block_size=16,
+                      max_tracked_sequences=32)
+        n_req, plen, max_new = (16, 64, 12) if on_tpu else (8, 24, 8)
+        seed = 0
+        fmodel = CausalLM(TransformerConfig(**model_kw))
+        fparams = fmodel.init(jax.random.PRNGKey(seed))
+
+        def engine_factory(i=0):
+            return InferenceEngineV2(
+                fmodel, params=fparams,
+                config=RaggedInferenceEngineConfig(**eng_kw))
+
+        disagg = {"enabled": True, "roles": ["prefill", "decode"],
+                  "handoff": {"enabled": True, "max_staged": 16,
+                              "chunk_blocks": 1}}
+        ps = [rng.integers(0, model_kw["vocab_size"],
+                           size=plen).tolist() for _ in range(n_req)]
+
+        def run(fe):
+            warm = [fe.submit(ps[0], max_new_tokens=2)
+                    for _ in range(2)]
+            fe.wait_all(warm, timeout=600)
+            hs = [fe.submit(p, max_new_tokens=max_new) for p in ps]
+            completed = fe.wait_all(hs, timeout=600)
+            ttfts, gaps, gens = [], [], []
+            for h in hs:
+                evs = h.drain()
+                gens.append([ev.token for ev in evs])
+                if evs:
+                    ttfts.append(evs[0].t - h._req.arrival_t)
+                    gaps.extend(b.t - a.t for a, b in zip(evs, evs[1:]))
+            finished = all(h.state == RequestState.FINISHED for h in hs)
+            snap = fe.metrics_snapshot()
+            return {"completed": bool(completed and finished),
+                    "gens": gens, "ttfts": ttfts, "gaps": gaps,
+                    "snap": snap}
+
+        def run_local(fabric_block):
+            extra = ({"fabric": fabric_block}
+                     if fabric_block is not None else {})
+            fe = ServingFrontend(
+                [engine_factory(0), engine_factory(1)],
+                ServingConfig(max_queue_depth=64, disaggregation=disagg,
+                              **extra),
+                engine_factory=engine_factory)
+            try:
+                return run(fe)
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        local = run_local(None)
+        disabled = run_local({"enabled": False})
+
+        # subprocess fleet: N real replica server processes on localhost
+        spec = {"model": model_kw, "engine": eng_kw, "seed": seed,
+                "serving": {"disaggregation": disagg}}
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "serve_replica.py")
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as fh:
+            json.dump(spec, fh)
+            spec_path = fh.name
+        env = dict(os.environ, JAX_PLATFORMS="cpu") if not on_tpu \
+            else dict(os.environ)
+        procs, addrs = [], []
+        try:
+            for i in range(2):
+                p = subprocess.Popen(
+                    [_sys.executable, script, "--spec", spec_path,
+                     "--listen", "127.0.0.1:0", "--replica-id", str(i),
+                     "--loopback-ok"],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, env=env)
+                procs.append(p)
+            for p in procs:
+                line = p.stdout.readline()      # blocks until jax is up
+                if not line.startswith("FABRIC_LISTENING "):
+                    raise RuntimeError(
+                        f"replica server never listened: {line!r}")
+                addrs.append(line.split()[1])
+            fe = ServingFrontend([], ServingConfig(
+                max_queue_depth=64, disaggregation=disagg,
+                fabric={"enabled": True, "peers": addrs,
+                        "heartbeat_s": 0.5, "rpc_timeout_s": 120.0}))
+            try:
+                fab = run(fe)
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            try:
+                os.unlink(spec_path)
+            except OSError:
+                pass
+
+        assert local["completed"] and disabled["completed"] \
+            and fab["completed"], "fabric phase left unfinished requests"
+        assert disabled["gens"] == local["gens"], \
+            "fabric.enabled=false diverged from the in-process stack"
+        assert fab["snap"]["handoffs_completed"] > 0, \
+            "no cross-process handoff completed — parity would be vacuous"
+        assert fab["gens"] == local["gens"], \
+            "cross-process serving broke greedy byte-parity"
+        pct = lambda xs, q: (round(float(np.percentile(xs, q)) * 1e3, 3)  # noqa: E731
+                             if xs else -1.0)
+        rpc = fab["snap"]["rpc_call_s"]
+        return {
+            "replicas": 2, "roles": ["prefill", "decode"],
+            "n_requests": int(n_req), "prompt_len": int(plen),
+            "max_new": int(max_new), "chunk_blocks": 1,
+            "local_p50_ttft_ms": pct(local["ttfts"], 50),
+            "local_p95_ttft_ms": pct(local["ttfts"], 95),
+            "local_p50_tpot_ms": pct(local["gaps"], 50),
+            "local_p95_tpot_ms": pct(local["gaps"], 95),
+            "fabric_p50_ttft_ms": pct(fab["ttfts"], 50),
+            "fabric_p95_ttft_ms": pct(fab["ttfts"], 95),
+            "fabric_p50_tpot_ms": pct(fab["gaps"], 50),
+            "fabric_p95_tpot_ms": pct(fab["gaps"], 95),
+            # transport overhead two ways: the per-RPC wall-time
+            # distribution, and the end-to-end TTFT delta vs in-process
+            "rpc_calls": int(rpc["count"]),
+            "rpc_p50_ms": round(rpc["p50"] * 1e3, 3),
+            "rpc_p95_ms": round(rpc["p95"] * 1e3, 3),
+            "rpc_overhead_p50_ttft_ms": round(
+                pct(fab["ttfts"], 50) - pct(local["ttfts"], 50), 3),
+            "handoffs_completed_local": int(
+                local["snap"]["handoffs_completed"]),
+            "handoffs_completed_fabric": int(
+                fab["snap"]["handoffs_completed"]),
+            "handoff_fallbacks_fabric": int(
+                fab["snap"]["handoff_fallbacks"]),
+            "handle_disconnects": int(fab["snap"]["handle_disconnects"]),
+            "parity": bool(fab["gens"] == local["gens"]),
+            "disabled_parity": bool(disabled["gens"] == local["gens"]),
+            "zero_wedges": bool(local["completed"] and fab["completed"]),
+        }
+
     # phase-resumable dispatch: per-phase budgets + artifact cache +
     # skip/degrade stamps (PhaseRunner docstring); every result carries
     # the shared engine's KV occupancy snapshot
@@ -2291,6 +2501,11 @@ def bench_serving(on_tpu: bool):
     # match/beat the static fleet's SLO attainment on fewer
     # replica-seconds, with greedy + disabled byte-parity asserted
     result["autoscale"] = runner.run("autoscale", run_autoscale_phase)
+    # cross-process serving fabric (docs/SERVING.md "Multi-host
+    # serving"): frontend + subprocess replica servers on localhost vs
+    # the same fleet in-process — greedy byte-parity, cross-process
+    # handoff count, and the RPC transport overhead stamped
+    result["fabric"] = runner.run("fabric", run_fabric_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

@@ -316,14 +316,17 @@ class InferenceEngineV2:
             self.state_manager.record_tokens(seq, tokens)
 
     # ----------------------------------------------------------- KV handoff
-    def export_sequence(self, uid: int) -> Optional[Dict[str, object]]:
+    def export_sequence(self, uid: int,
+                        chunk_blocks: int = 0) -> Optional[Dict[str, object]]:
         """Host-RAM snapshot of a sequence's KV blocks (pool slabs +
         kv_quant scale planes + metadata) for disaggregated
         prefill→decode handoff — see
-        :meth:`DSStateManager.export_sequence`. The sequence stays
+        :meth:`DSStateManager.export_sequence` (``chunk_blocks`` > 0 =
+        the block-granularity streamed form). The sequence stays
         tracked; the caller :meth:`flush`\\ es once the payload is
         staged."""
-        return self.state_manager.export_sequence(uid)
+        return self.state_manager.export_sequence(uid,
+                                                  chunk_blocks=chunk_blocks)
 
     def import_sequence(self, uid: int, payload: Dict[str, object],
                         tokens: Sequence[int]) -> None:

@@ -251,7 +251,8 @@ class DSStateManager:
         return len(dropped)
 
     # -- KV handoff (disaggregated prefill/decode) --------------------------
-    def export_sequence(self, uid: int) -> Optional[Dict[str, object]]:
+    def export_sequence(self, uid: int,
+                        chunk_blocks: int = 0) -> Optional[Dict[str, object]]:
         """Host-RAM snapshot of a sequence's KV state for cross-engine
         handoff (docs/SERVING.md "Disaggregated serving"): every pool
         slab the sequence's block table references — K and V, plus the
@@ -265,10 +266,49 @@ class DSStateManager:
         like private ones (content copy; the source's refcounts are
         untouched). Returns ``None`` for unknown/empty sequences. The
         source sequence keeps its state — the caller flushes after the
-        payload is staged."""
+        payload is staged.
+
+        ``chunk_blocks`` > 0 switches to the block-granularity streamed
+        form (docs/SERVING.md "Multi-host serving"): the payload carries
+        ``"chunks"`` — a list of per-chunk slab dicts covering at most
+        ``chunk_blocks`` blocks each. Every chunk's device→host copy is
+        dispatched BEFORE any chunk materializes (so the copies
+        overlap), and the payload holds host numpy arrays — staged
+        payloads pin host RAM only, never device HBM — in units a
+        consumer (the wire codec, the import scatter) can stream one at
+        a time, overlapping a long-context handoff's transfer with
+        ongoing decode. Byte content is identical to the whole-slab
+        form (tests assert)."""
         seq = self._seqs.get(uid)
         if seq is None or not seq.kv_blocks:
             return None
+        meta = {"seen_tokens": seq.seen_tokens,
+                "block_size": self.block_size,
+                "kv_quant": self.kv_quant,
+                "kv_quant_dtype": self.kv_quant_dtype,
+                "n_blocks": len(seq.kv_blocks)}
+        if chunk_blocks and chunk_blocks > 0:
+            device_chunks = []
+            for s in range(0, len(seq.kv_blocks), int(chunk_blocks)):
+                ids = jnp.asarray(seq.kv_blocks[s:s + int(chunk_blocks)],
+                                  dtype=jnp.int32)
+                arrs = {name: jnp.take(pool, ids, axis=1)
+                        for name, pool in self.kv_cache.items()}
+                for a in arrs.values():
+                    try:
+                        a.copy_to_host_async()
+                    except Exception:   # backend without async host copy
+                        pass
+                device_chunks.append(arrs)
+            # materialize AFTER every copy was dispatched (each asarray
+            # waits only for its own chunk's transfer) — the device
+            # buffers are released here, so a staged payload pins host
+            # RAM, not HBM
+            meta["chunk_blocks"] = int(chunk_blocks)
+            meta["chunks"] = [{name: np.asarray(a)
+                               for name, a in c.items()}
+                              for c in device_chunks]
+            return meta
         ids = jnp.asarray(seq.kv_blocks, dtype=jnp.int32)
         arrs = {name: jnp.take(pool, ids, axis=1)
                 for name, pool in self.kv_cache.items()}
@@ -277,12 +317,8 @@ class DSStateManager:
                 a.copy_to_host_async()
             except Exception:   # backend without async host copy
                 pass
-        return {"seen_tokens": seq.seen_tokens,
-                "block_size": self.block_size,
-                "kv_quant": self.kv_quant,
-                "kv_quant_dtype": self.kv_quant_dtype,
-                "n_blocks": len(seq.kv_blocks),
-                "slabs": {name: np.asarray(a) for name, a in arrs.items()}}
+        meta["slabs"] = {name: np.asarray(a) for name, a in arrs.items()}
+        return meta
 
     def import_sequence(self, uid: int, payload: Dict[str, object],
                         tokens: Sequence[int]) -> None:
@@ -303,8 +339,17 @@ class DSStateManager:
         heterogeneous fleet must recompute instead), on a uid that
         already has state, and on insufficient capacity (after LRU
         prefix-cache eviction). Failure leaves the manager untouched —
-        the caller falls back to re-prefilling."""
-        slabs = payload["slabs"]
+        the caller falls back to re-prefilling.
+
+        Accepts BOTH payload forms: whole-slab (``"slabs"``) and the
+        block-granularity streamed form (``"chunks"`` — see
+        :meth:`export_sequence`); chunked payloads scatter one chunk at
+        a time, so the first chunks land while later ones are still
+        materializing/arriving."""
+        chunks = payload.get("chunks")
+        slabs = (payload["slabs"] if chunks is None
+                 else {k: None for k in chunks[0]} if chunks
+                 else {k: None for k in self.kv_cache})
         if int(payload["block_size"]) != self.block_size:
             raise ValueError(
                 f"KV import block_size mismatch: payload "
@@ -335,6 +380,12 @@ class DSStateManager:
             raise ValueError(f"cannot import into sequence {uid}: it "
                              "already has KV state")
         n = int(payload["n_blocks"])
+        if chunks is not None:
+            got = sum(int(np.shape(next(iter(c.values())))[1])
+                      for c in chunks)
+            if got != n:
+                raise ValueError(f"KV import chunks cover {got} blocks, "
+                                 f"payload claims {n}")
         short = n - self.allocator.free_blocks
         if short > 0 and self.prefix_cache_enabled:
             self._evict(short)
@@ -345,10 +396,24 @@ class DSStateManager:
         seq = self.get_or_create_sequence(uid)
         blocks = self.allocator.allocate(n)
         try:
-            ids = jnp.asarray(blocks, dtype=jnp.int32)
-            for name, pool in self.kv_cache.items():
-                self.kv_cache[name] = pool.at[:, ids].set(
-                    jnp.asarray(slabs[name], dtype=pool.dtype))
+            if chunks is not None:
+                # streamed form: glue the chunks per slab and scatter
+                # ONCE per pool tensor — a per-chunk `.at[].set` would
+                # copy the whole pool per chunk (O(chunks x pool
+                # bytes)), the exact long-context case chunking exists
+                # to help. The streaming benefit already happened
+                # upstream (per-chunk host copies / wire frames).
+                ids = jnp.asarray(blocks, dtype=jnp.int32)
+                for name, pool in self.kv_cache.items():
+                    glued = np.concatenate(
+                        [np.asarray(c[name]) for c in chunks], axis=1)
+                    self.kv_cache[name] = pool.at[:, ids].set(
+                        jnp.asarray(glued, dtype=pool.dtype))
+            else:
+                ids = jnp.asarray(blocks, dtype=jnp.int32)
+                for name, pool in self.kv_cache.items():
+                    self.kv_cache[name] = pool.at[:, ids].set(
+                        jnp.asarray(slabs[name], dtype=pool.dtype))
             seq.kv_blocks.extend(blocks)
             seq.seen_tokens = seen
             # prefix-index coherence: rebuild the hash chain over the

@@ -16,7 +16,8 @@ from ..telemetry.slo import (AlertEngine, SLOClassTarget,  # noqa: F401
                              SLOConfig)
 from ..telemetry.windowed import WindowedMetrics  # noqa: F401
 from .config import (AdmissionConfig, AutoscalerConfig,  # noqa: F401
-                     ClassPolicy, DisaggregationConfig, FaultsConfig,
+                     ClassPolicy, DisaggregationConfig, FabricConfig,
+                     FaultsConfig,
                      FaultToleranceConfig, HandoffConfig, KVQuantConfig,
                      KVTierConfig, PreemptionConfig, PrefixCacheConfig,
                      ServingConfig, SpeculativeConfig, WeightQuantConfig)
@@ -40,6 +41,13 @@ _LAZY = {
     "ReplicaRouter": ("deepspeed_tpu.serving.router", "ReplicaRouter"),
     "ReplicaSupervisor": ("deepspeed_tpu.serving.supervisor",
                           "ReplicaSupervisor"),
+    # cross-process serving fabric (docs/SERVING.md "Multi-host serving")
+    "LocalHandle": ("deepspeed_tpu.serving.fabric.handle", "LocalHandle"),
+    "RemoteHandle": ("deepspeed_tpu.serving.fabric.remote", "RemoteHandle"),
+    "ReplicaServer": ("deepspeed_tpu.serving.fabric.server",
+                      "ReplicaServer"),
+    "HANDLE_SURFACE": ("deepspeed_tpu.serving.fabric.handle",
+                       "HANDLE_SURFACE"),
 }
 
 
@@ -68,4 +76,6 @@ __all__ = ["ServingConfig", "PrefixCacheConfig", "KVQuantConfig",
            "DoneEvent", "FinishReason", "ServingFrontend", "Replica",
            "ReplicaState", "ReplicaRouter",
            "SLOConfig", "SLOClassTarget", "AlertEngine", "OpsJournal",
-           "WindowedMetrics"]
+           "WindowedMetrics",
+           "FabricConfig", "LocalHandle", "RemoteHandle", "ReplicaServer",
+           "HANDLE_SURFACE"]

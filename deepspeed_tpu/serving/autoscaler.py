@@ -76,6 +76,11 @@ class ReplicaInfo:
     parked: bool
     outstanding_prefill_tokens: float
     outstanding_decode_tokens: float
+    # fabric peer (docs/SERVING.md "Multi-host serving"): remote
+    # capacity is owned by its server process — shrinking it only drops
+    # the connection, the chips stay allocated — so local replicas are
+    # preferred shrink victims at equal load
+    remote: bool = False
 
     @property
     def outstanding(self) -> float:
@@ -313,11 +318,14 @@ class FleetController:
             candidates.append(r)
         if not candidates:
             return None
-        # least loaded first; ties broken toward the NEWEST replica
-        # (highest id) — the most recently added capacity goes first,
-        # which keeps long-lived replicas' warm caches around
+        # least loaded first, preferring LOCAL capacity at equal load
+        # (removing a fabric peer only drops the connection — its
+        # server process keeps the chips); ties broken toward the
+        # NEWEST replica (highest id) — the most recently added
+        # capacity goes first, which keeps long-lived replicas' warm
+        # caches around
         best = min(candidates,
-                   key=lambda r: (r.outstanding, -r.replica_id))
+                   key=lambda r: (r.outstanding, r.remote, -r.replica_id))
         return best.replica_id
 
     def _decide_rerole(self, signals: FleetSignals,

@@ -260,6 +260,15 @@ class HandoffConfig(DSConfigModel):
     # that handoff to the recompute fallback (the request re-prefills on
     # a decode-capable replica) instead of blocking the prefill replica
     max_staged: int = 8
+    # block-granularity streamed handoff (docs/SERVING.md "Multi-host
+    # serving"): export payloads carry per-chunk slab groups of this
+    # many KV blocks instead of one whole-prompt slab — every chunk's
+    # device->host copy is dispatched before any materializes
+    # (overlapped copies; the staged payload is host RAM, never pinned
+    # HBM), and over the fabric each chunk rides its own wire frame so
+    # a long-context transfer overlaps with ongoing decode. 0 (the
+    # default) keeps the whole-payload export byte for byte.
+    chunk_blocks: int = 0
 
 
 class DisaggregationConfig(DSConfigModel):
@@ -377,6 +386,59 @@ class AutoscalerConfig(DSConfigModel):
                      "rerole_stable_ticks"):
             if getattr(self, name) < 1:
                 raise ValueError(f"autoscaler.{name} must be >= 1")
+        return self
+
+
+class FabricConfig(DSConfigModel):
+    """``fabric: {...}`` block (docs/CONFIG.md, docs/SERVING.md
+    "Multi-host serving"): the cross-process serving fabric. With
+    ``enabled`` and a ``peers`` list, the frontend adopts each peer —
+    a replica server process (``scripts/serve_replica.py``) hosting a
+    (possibly TP-sharded, multi-chip) engine — as a
+    :class:`~deepspeed_tpu.serving.fabric.remote.RemoteHandle` replica:
+    routing, KV handoff, kv_tier restore, preemption resume and
+    autoscaler evacuation all work across the process boundary, and a
+    dead connection is handled exactly like a dead replica thread
+    (failover + supervisor restart/reconnect). Disabled (the default)
+    builds only in-process replicas — byte for byte the single-process
+    stack."""
+
+    enabled: bool = False
+    # this process's server bind address when IT serves replicas
+    # (host:port; port 0 = ephemeral). The ADVERTISED address rides
+    # ``comm._routable_ip`` for wildcard/loopback binds — never
+    # 127.0.0.1 when a route exists (fabric/transport.advertised_address)
+    listen: str = "127.0.0.1:0"
+    # replica server addresses ("host:port") this frontend adopts as
+    # remote replicas, ids allocated after the local engines
+    peers: List[str] = Field(default_factory=list)
+    # client ping cadence; a peer silent for max(10s, 3 heartbeats) is
+    # presumed dead (transport-loss failover fires). The 10s floor
+    # keeps a peer stalled in an XLA compile from reading as dead — a
+    # CLOSED socket is detected instantly regardless
+    heartbeat_s: float = 1.0
+    # per-RPC deadline (hello/assign/evacuate)
+    rpc_timeout_s: float = 30.0
+    # hard bound on one wire frame; an oversized KV payload degrades to
+    # the re-prefill fallback (typed FrameTooLarge, never a crash)
+    max_frame_bytes: int = 64 * 1024 * 1024
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.enabled:
+            if self.heartbeat_s <= 0:
+                raise ValueError("fabric.heartbeat_s must be > 0 — the "
+                                 "heartbeat is the transport-loss signal")
+            if self.rpc_timeout_s <= 0:
+                raise ValueError("fabric.rpc_timeout_s must be > 0")
+            if self.max_frame_bytes < 1 << 16:
+                raise ValueError("fabric.max_frame_bytes must be at least "
+                                 "64 KiB — RPC envelopes must always fit")
+            for addr in self.peers:
+                host, sep, port = str(addr).rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise ValueError(f"fabric.peers entry {addr!r} is not "
+                                     "host:port")
         return self
 
 
@@ -518,6 +580,10 @@ class ServingConfig(DSConfigModel):
     # autoscaling"): grow/shrink/re-role the replica pool + proactive
     # brownout; disabled = the static fleet byte for byte
     autoscaler: AutoscalerConfig = Field(default_factory=AutoscalerConfig)
+    # cross-process serving fabric (docs/SERVING.md "Multi-host
+    # serving"): adopt replica server processes as RemoteHandle
+    # replicas; disabled = the in-process stack byte for byte
+    fabric: FabricConfig = Field(default_factory=FabricConfig)
     # test-only deterministic fault injection (chaos suite / bench chaos
     # phase); disabled = no injection hooks anywhere on the hot path
     faults: FaultsConfig = Field(default_factory=FaultsConfig)

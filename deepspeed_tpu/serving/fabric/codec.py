@@ -1,0 +1,281 @@
+"""Versioned wire codec for the serving fabric (docs/SERVING.md
+"Multi-host serving").
+
+Everything that crosses a replica-process boundary — RPC envelopes,
+:class:`~deepspeed_tpu.serving.request.ServingRequest` state, KV export
+payloads (pool slabs + scale planes + dtype stamps, whole or in
+per-block chunks), ``last_logits`` — is encoded by this module into one
+self-describing binary frame::
+
+    u32 header_len | header JSON (utf-8) | buf_0 | buf_1 | ...
+
+The header carries the codec version, a JSON tree in which every array
+was replaced by a ``{"__buf__": i}`` placeholder, and per-buffer
+``(dtype name, shape, nbytes)`` descriptors. Arrays are shipped as raw
+row-major bytes, so int8/fp8/bf16/fp32 slabs round-trip **byte-exactly**
+— the hinge of cross-process KV handoff parity. Non-numpy dtypes
+(``bfloat16``, ``float8_e4m3fn``) resolve through ``ml_dtypes`` (a JAX
+dependency, so always present wherever an engine runs).
+
+Failure surface is typed, never a crash: a frame from a different codec
+generation raises :class:`VersionMismatch`, a frame over the configured
+byte bound raises :class:`FrameTooLarge` (on encode AND decode — the
+receiver refuses before allocating), and anything malformed raises
+:class:`CodecError`. Callers degrade (drop a payload to the re-prefill
+fallback, kill a connection) instead of propagating garbage.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: bump when the frame layout or placeholder scheme changes; both ends
+#: of a connection verify it in the hello exchange AND per frame
+CODEC_VERSION = 1
+
+_HEADER_FMT = ">I"
+_HEADER_LEN = struct.calcsize(_HEADER_FMT)
+
+
+class CodecError(Exception):
+    """Malformed or unencodable fabric frame."""
+
+
+class VersionMismatch(CodecError):
+    """Frame written by a different codec generation — the peer must be
+    upgraded/downgraded, not guessed at. ``detail`` carries a remote
+    peer's own refusal text verbatim (it names BOTH versions — the one
+    diagnostic the operator needs)."""
+
+    def __init__(self, got=None, want: int = CODEC_VERSION,
+                 detail: Optional[str] = None):
+        self.got, self.want = got, want
+        super().__init__(detail or
+                         f"fabric codec version mismatch: frame v={got!r}, "
+                         f"this process speaks v={want}")
+
+
+class FrameTooLarge(CodecError):
+    """Frame over the configured ``max_frame_bytes`` bound."""
+
+    def __init__(self, size: int, limit: int):
+        self.size, self.limit = int(size), int(limit)
+        super().__init__(f"fabric frame of {size} bytes exceeds the "
+                         f"{limit}-byte max_frame_bytes bound")
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, falling back to ml_dtypes for the non-numpy
+    representations JAX serves (bfloat16, float8_e4m3fn, ...)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError):
+        raise CodecError(f"unknown array dtype {name!r} in fabric frame")
+
+
+def _encode_tree(obj: Any, bufs: List[np.ndarray]) -> Any:
+    """JSON-safe mirror of ``obj`` with arrays hoisted into ``bufs``."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise CodecError(f"fabric frames need string dict keys, "
+                                 f"got {type(k).__name__}")
+            out[k] = _encode_tree(v, bufs)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_encode_tree(v, bufs) for v in obj]
+    if isinstance(obj, np.generic):            # numpy scalar -> python
+        return obj.item()
+    # anything array-like (numpy OR jax — np.asarray materializes the
+    # device value, waiting only for ITS async host copy, which is what
+    # lets chunked handoff payloads overlap materialization with wire
+    # writes of earlier chunks)
+    try:
+        arr = np.ascontiguousarray(np.asarray(obj))
+    except Exception:
+        raise CodecError(f"unencodable value of type "
+                         f"{type(obj).__name__} in fabric frame")
+    if arr.dtype == object or arr.dtype.hasobject:
+        # np.asarray boxes arbitrary python objects into 0-d object
+        # arrays instead of failing — refuse them explicitly
+        raise CodecError(f"unencodable value of type "
+                         f"{type(obj).__name__} in fabric frame")
+    bufs.append(arr)
+    return {"__buf__": len(bufs) - 1}
+
+
+def _decode_tree(obj: Any, arrays: List[np.ndarray]) -> Any:
+    if isinstance(obj, dict):
+        if set(obj) == {"__buf__"}:
+            i = obj["__buf__"]
+            if not isinstance(i, int) or not 0 <= i < len(arrays):
+                raise CodecError(f"fabric frame references buffer {i!r} "
+                                 f"of {len(arrays)}")
+            return arrays[i]
+        return {k: _decode_tree(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_tree(v, arrays) for v in obj]
+    return obj
+
+
+def encode_frame(obj: Any, max_frame_bytes: int = 0) -> bytes:
+    """One self-describing frame for ``obj`` (raises the typed errors
+    above; ``max_frame_bytes`` 0 = unbounded)."""
+    bufs: List[np.ndarray] = []
+    meta = _encode_tree(obj, bufs)
+    descs = [[a.dtype.name, list(a.shape), int(a.nbytes)] for a in bufs]
+    try:
+        header = json.dumps({"v": CODEC_VERSION, "meta": meta,
+                             "bufs": descs}).encode("utf-8")
+    except (TypeError, ValueError) as e:
+        raise CodecError(f"fabric frame header not JSON-serializable: {e}")
+    total = _HEADER_LEN + len(header) + sum(d[2] for d in descs)
+    if max_frame_bytes and total > max_frame_bytes:
+        raise FrameTooLarge(total, max_frame_bytes)
+    parts = [struct.pack(_HEADER_FMT, len(header)), header]
+    parts.extend(a.tobytes() for a in bufs)
+    return b"".join(parts)
+
+
+def decode_frame(data: bytes, max_frame_bytes: int = 0) -> Any:
+    """Inverse of :func:`encode_frame`. Arrays come back as read-only
+    numpy views over the frame's bytes (zero-copy; ``jnp.asarray``
+    copies on device transfer anyway)."""
+    if max_frame_bytes and len(data) > max_frame_bytes:
+        raise FrameTooLarge(len(data), max_frame_bytes)
+    if len(data) < _HEADER_LEN:
+        raise CodecError(f"fabric frame truncated ({len(data)} bytes)")
+    (hlen,) = struct.unpack_from(_HEADER_FMT, data, 0)
+    if _HEADER_LEN + hlen > len(data):
+        raise CodecError("fabric frame truncated inside its header")
+    try:
+        header = json.loads(data[_HEADER_LEN:_HEADER_LEN + hlen]
+                            .decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise CodecError(f"fabric frame header unparsable: {e}")
+    if not isinstance(header, dict):
+        raise CodecError("fabric frame header is not an object")
+    if header.get("v") != CODEC_VERSION:
+        raise VersionMismatch(header.get("v"))
+    arrays: List[np.ndarray] = []
+    off = _HEADER_LEN + hlen
+    for desc in header.get("bufs", ()):
+        try:
+            name, shape, nbytes = desc
+        except (TypeError, ValueError):
+            raise CodecError(f"malformed buffer descriptor {desc!r}")
+        dtype = _np_dtype(name)
+        try:
+            if off + nbytes > len(data):
+                raise CodecError("fabric frame truncated inside a buffer")
+            arr = np.frombuffer(data, dtype=dtype,
+                                count=nbytes // dtype.itemsize,
+                                offset=off).reshape(shape)
+            off += nbytes
+        except CodecError:
+            raise
+        except Exception as e:
+            # inconsistent descriptors (nbytes vs shape/itemsize, bogus
+            # shapes) raise numpy ValueError/TypeError — the contract is
+            # a TYPED refusal, so the transport can kill the connection
+            # cleanly instead of losing its reader thread
+            raise CodecError(f"inconsistent buffer descriptor "
+                             f"{desc!r}: {e}")
+        arrays.append(arr)
+    return _decode_tree(header.get("meta"), arrays)
+
+
+# ------------------------------------------------------- request wiring
+def request_to_wire(req) -> Dict[str, Any]:
+    """The resumable cross-process image of a ServingRequest: identity,
+    contract (budget/deadline/class), and delivery state — everything a
+    replica server needs to continue the stream byte-losslessly, nothing
+    process-local (events queue, spans, staging slot)."""
+    import time
+
+    remaining = (None if req.deadline_t is None
+                 else max(0.0, req.deadline_t - time.monotonic()))
+    return {
+        "uid": int(req.uid),
+        "prompt_tokens": [int(t) for t in req.prompt_tokens],
+        "max_new_tokens": int(req.max_new_tokens),
+        "priority": int(req.priority),
+        "deadline_remaining_s": remaining,
+        "eos_token_id": (int(req.eos_token_id)
+                         if req.eos_token_id is not None else None),
+        "request_class": req.request_class,
+        "shed_rank": int(req.shed_rank),
+        "generated_tokens": [int(t) for t in req.generated_tokens],
+        "attempts": int(req.attempts),
+        "no_prefill": bool(req.no_prefill),
+    }
+
+
+def request_from_wire(d: Dict[str, Any]):
+    """Rebuild a server-side ServingRequest from its wire image. The uid
+    is adopted verbatim (the frontend owns uid allocation; the server
+    only ever sees wire requests, so collisions are impossible)."""
+    from ..request import ServingRequest
+
+    req = ServingRequest(
+        list(d["prompt_tokens"]), int(d["max_new_tokens"]),
+        int(d["priority"]), d.get("deadline_remaining_s"),
+        d.get("eos_token_id"),
+        request_class=d.get("request_class", "interactive"),
+        shed_rank=int(d.get("shed_rank", 0)))
+    req.uid = int(d["uid"])
+    for t in d.get("generated_tokens", ()):
+        # replay through push_token so n_generated / first_token_t stay
+        # internally consistent (the timestamps are server-local and
+        # only feed server-private metrics)
+        req.push_token(int(t))
+    # drain the replayed events: they were already delivered to the real
+    # stream by a previous replica; the pump must not re-send them
+    while not req._events.empty():
+        req._events.get_nowait()
+    req.attempts = int(d.get("attempts", 1))
+    req.no_prefill = bool(d.get("no_prefill", False))
+    return req
+
+
+def payload_chunks(payload: Optional[dict]) -> Tuple[Optional[dict],
+                                                     List[dict]]:
+    """Split a KV export payload into (metadata, chunk list) for chunked
+    wire transfer. Whole-slab payloads yield one chunk; chunked exports
+    (``DSStateManager.export_sequence(chunk_blocks=...)``) yield one per
+    chunk. ``(None, [])`` for a missing payload."""
+    if payload is None:
+        return None, []
+    meta = {k: v for k, v in payload.items()
+            if k not in ("slabs", "chunks")}
+    if "chunks" in payload:
+        return meta, [{"slabs": c} for c in payload["chunks"]]
+    return meta, [{"slabs": payload["slabs"]}]
+
+
+def payload_from_chunks(meta: Optional[dict],
+                        chunks: List[dict]) -> Optional[dict]:
+    """Reassemble what :func:`payload_chunks` split. A single chunk
+    restores the whole-slab form; several restore the chunked form —
+    ``import_sequence`` accepts both."""
+    if meta is None:
+        return None
+    payload = dict(meta)
+    if len(chunks) == 1 and not meta.get("chunk_blocks"):
+        payload["slabs"] = chunks[0]["slabs"]
+    else:
+        payload["chunks"] = [c["slabs"] for c in chunks]
+    return payload

@@ -1,0 +1,426 @@
+"""Replica server: one (possibly TP-sharded) engine behind the fabric RPC.
+
+``scripts/serve_replica.py`` runs this in its own process (its own JAX
+runtime, so the engine may be a mesh slice spanning that host's chips —
+the multichip dryrun's TP-sharded serving path, now behind a handle);
+tests may also run it on a thread for fast in-process transport
+coverage. The server hosts a **plain**
+:class:`~deepspeed_tpu.serving.replica.Replica` — the same Dynamic
+SplitFuse worker the in-process stack runs, so every engine-level
+feature (prefix cache, speculation, kv/weight quant, tiering,
+reservation admission, preemption) works unmodified behind the wire.
+
+Protocol (all frames via fabric/codec.py):
+
+- client calls: ``hello`` (codec-version check, role assignment,
+  optional fresh-engine ``reset`` — the supervisor-restart path),
+  ``assign`` (wire request + optional staged-KV meta; chunk frames
+  stream ahead as ``stage_chunk`` events), ``evacuate``;
+- client events: ``cancel``, ``drain``, ``stop``, ``stage_chunk``,
+  ``stage_abort``;
+- server events: ``token``, ``finish``, ``failover``, ``handoff`` (+
+  ``payload_chunk`` stream), ``evacuated`` (+ chunks), ``status``
+  (~4/s: replica state, occupancy, forwarded engine counters).
+
+Ordering: one pump thread per request drains the request's event queue
+in order, so a ``failover``/``handoff`` marker can never overtake that
+request's trailing tokens. A client disconnect cancels the in-flight
+requests (their KV frees; the *frontend* already failed them over via
+its transport-loss path) and the server waits for the next connection —
+a frontend restart re-adopts a running server without restarting it.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+from ...utils.locks import RankedLock
+from ...utils.logging import logger
+from ..metrics import serving_metrics
+from ..replica import Replica, ReplicaState
+from ..request import FinishReason, RequestState, DoneEvent
+from .codec import (CODEC_VERSION, FrameTooLarge, payload_chunks,
+                    payload_from_chunks, request_from_wire)
+from .remote import RemoteHandle
+from .transport import Connection, FabricError, parse_address
+
+#: status cadence — also the server->client liveness signal, so it must
+#: undercut the client's stale window (STALE_HEARTBEATS x heartbeat_s)
+STATUS_INTERVAL_S = 0.25
+
+
+class ReplicaServer:
+    # lock discipline (docs/CONCURRENCY.md): the request table and the
+    # staged-chunk accumulator are hit from the transport reader thread
+    # (assign/cancel/chunk events), per-request pump threads (detach on
+    # finish) and the replica worker (via callbacks).
+    _GUARDED_BY = {"_reqs": "_lock", "_stage_rx": "_lock"}
+
+    def __init__(self, engine_factory, config=None,
+                 listen: str = "127.0.0.1:0", replica_id: int = 0,
+                 heartbeat_s: float = 1.0, max_frame_bytes: int = 0):
+        from ..config import ServingConfig
+
+        self.engine_factory = engine_factory
+        self.config = config or ServingConfig()
+        fab = getattr(self.config, "fabric", None)
+        self.heartbeat_s = float(heartbeat_s)
+        self.max_frame_bytes = int(max_frame_bytes
+                                   or (fab.max_frame_bytes
+                                       if fab is not None else 0))
+        self.replica_id = int(replica_id)
+        self._lock = RankedLock("serving.fabric.server")
+        self._reqs: Dict[int, object] = {}
+        self._stage_rx: Dict[int, list] = {}
+        self._conn: Optional[Connection] = None
+        self._engine = None
+        self.replica: Optional[Replica] = None
+        self._role = "mixed"
+        # server-private registry: the replica records into it and the
+        # status loop forwards the engine-level counters as deltas
+        self.registry = serving_metrics()
+        self._stop = threading.Event()
+        host, port = parse_address(listen)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(4)
+        self.listen_host = host
+        self.port = self._sock.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"fabric-server-{self.replica_id}")
+        self._status_thread = threading.Thread(
+            target=self._status_loop, daemon=True,
+            name=f"fabric-status-{self.replica_id}")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self._accept_thread.start()
+        self._status_thread.start()
+
+    def serve_forever(self) -> None:
+        self.start()
+        while not self._stop.is_set():
+            time.sleep(0.2)
+
+    def stop(self) -> None:
+        self._stop.set()
+        conn = self._conn
+        if conn is not None:
+            conn.close("server stopped")
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self.replica is not None:
+            self.replica.stop(timeout=2.0)
+
+    # -------------------------------------------------------------- accept
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._sock.accept()
+            except OSError:
+                return                      # listener closed
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            old = self._conn
+            if old is not None:
+                # newest frontend wins (a supervisor-rebuilt handle dials
+                # before the displaced one is stopped)
+                old.close("superseded by a new frontend connection")
+            # each handler is bound to ITS connection: a superseded
+            # connection's reader may still drain already-received calls
+            # after self._conn moved on, and answering those on the NEW
+            # connection could resolve the new frontend's pending calls
+            # by id collision
+            holder = {}
+            conn = Connection(
+                sock, max_frame_bytes=self.max_frame_bytes,
+                heartbeat_s=self.heartbeat_s,
+                on_event=lambda msg: self._on_msg(msg, holder["conn"]),
+                on_close=self._on_conn_close,
+                name=f"fabric-server-{self.replica_id}")
+            holder["conn"] = conn
+            self._conn = conn
+            conn.start()
+            logger.info(f"fabric replica server {self.replica_id}: "
+                        f"frontend connected from {addr}")
+
+    def _on_conn_close(self, reason: str) -> None:
+        """Frontend gone: cancel in-flight work so its KV frees (the
+        frontend's transport-loss path already failed the requests over)
+        and wait for the next connection."""
+        with self._lock:
+            reqs = list(self._reqs.values())
+            self._stage_rx.clear()
+        for req in reqs:
+            req.cancel_requested.set()
+
+    # ------------------------------------------------------------- replica
+    def _build_replica(self, role: str, fresh_engine: bool) -> None:
+        from ..frontend import apply_engine_serving_config
+
+        old = self.replica
+        if old is not None:
+            old.stop(timeout=1.0)
+        if self._engine is None or fresh_engine \
+                or (old is not None and old.thread.is_alive()):
+            # a wedged worker owns the old engine — only a fresh one is
+            # safe (the supervisor's restart rule, applied server-side)
+            self._engine = self.engine_factory()
+            apply_engine_serving_config(self._engine, self.config)
+        else:
+            for uid in list(self._engine.state_manager.tracked_sequences):
+                try:
+                    self._engine.flush(uid)
+                except Exception:
+                    pass
+        cfg = self.config
+        spec = cfg.speculative if cfg.speculative.enabled else None
+        dis = cfg.disaggregation if cfg.disaggregation.enabled else None
+        self._role = role
+        self.replica = Replica(
+            self.replica_id, self._engine, self.registry,
+            wedge_timeout_s=cfg.wedge_timeout_s, speculative=spec,
+            faults=cfg.faults.build_injector(),
+            on_failover=self._on_replica_failover, role=role,
+            decode_reserve_tokens=(dis.decode_reserve_tokens
+                                   if dis is not None else 0),
+            on_handoff=(self._on_replica_handoff if role == "prefill"
+                        else None))
+        self.replica.start()
+
+    def _on_replica_failover(self, req) -> bool:
+        """Replica-death hand-back: mark the request so its pump sends
+        an ordered ``failover`` marker after the trailing tokens, then
+        settle it locally (the real stream lives client-side)."""
+        req._fabric_failover = True
+        req.finish(RequestState.FAILED, FinishReason.ERROR)
+        return True
+
+    def _on_replica_handoff(self, req, sreq, engine, replica_id) -> None:
+        """Prefill-role completion: export (chunked per the handoff
+        config) runs HERE — the KV is in this process — and the payload
+        crosses the wire; the frontend stages and re-queues it."""
+        cfg = self.config.disaggregation
+        payload = None
+        try:
+            payload = engine.export_sequence(
+                req.uid, chunk_blocks=(cfg.handoff.chunk_blocks
+                                       if cfg.enabled else 0))
+        except Exception as e:
+            logger.warning(f"fabric replica server {self.replica_id}: KV "
+                           f"export for request {req.uid} failed ({e!r})")
+        finally:
+            try:
+                engine.flush(req.uid)
+            except Exception:
+                pass
+        if payload is not None:
+            payload["last_logits"] = sreq.last_logits
+        req._fabric_handoff_payload = payload
+        req.finish(RequestState.FINISHED, "prefilled")
+
+    # ------------------------------------------------------------ messages
+    def _on_msg(self, msg: dict, conn: Connection) -> None:
+        if msg.get("t") == "call":
+            self._on_call(msg, conn)
+            return
+        ev = msg.get("ev")
+        if ev == "stage_chunk":
+            with self._lock:
+                self._stage_rx.setdefault(int(msg["uid"]), []).append(
+                    {"slabs": msg["slabs"]})
+        elif ev == "stage_abort":
+            with self._lock:
+                self._stage_rx.pop(int(msg["uid"]), None)
+        elif ev == "cancel":
+            with self._lock:
+                req = self._reqs.get(int(msg["uid"]))
+            if req is not None:
+                req.cancel_requested.set()
+        elif ev == "drain":
+            if self.replica is not None:
+                self.replica.drain()
+        elif ev == "stop":
+            if self.replica is not None:
+                self.replica.stop(timeout=1.0)
+
+    def _on_call(self, msg: dict, conn: Connection) -> None:
+        call_id = msg.get("id")
+        method = msg.get("m")
+        try:
+            handler = {"hello": self._rpc_hello,
+                       "assign": self._rpc_assign,
+                       "evacuate": self._rpc_evacuate}.get(method)
+            if handler is None:
+                conn.respond(call_id, error=f"unknown method {method!r}")
+                return
+            conn.respond(call_id, handler(msg.get("p") or {}, conn))
+        except FabricError:
+            raise
+        except Exception as e:
+            logger.error(f"fabric replica server {self.replica_id}: "
+                         f"{method} failed: {e!r}")
+            try:
+                conn.respond(call_id, error=repr(e))
+            except FabricError:
+                pass
+
+    def _rpc_hello(self, p: dict, conn: Connection) -> dict:
+        if int(p.get("codec_version", -1)) != CODEC_VERSION:
+            # typed refusal, matched by RemoteHandle.connect: a peer from
+            # a different codec generation must never be half-spoken to
+            raise ValueError(
+                f"version_mismatch: server codec v{CODEC_VERSION}, "
+                f"client v{p.get('codec_version')!r}")
+        # frame-bound negotiation (both directions): this server never
+        # sends more than the client's receive bound, and tells the
+        # client its own so oversized payloads die at encode — typed,
+        # degrading one payload — instead of at the peer's reader,
+        # killing the connection
+        client_bound = int(p.get("max_frame_bytes", 0) or 0)
+        if client_bound:
+            conn.send_max_bytes = (min(self.max_frame_bytes, client_bound)
+                                   if self.max_frame_bytes
+                                   else client_bound)
+        role = str(p.get("role", "mixed"))
+        reset = bool(p.get("reset", False))
+        if (self.replica is None or reset or self._role != role
+                or self.replica.state in (ReplicaState.DEAD,
+                                          ReplicaState.STOPPED)):
+            self._build_replica(role, fresh_engine=reset)
+        eng = self._engine
+        return {"replica_id": self.replica_id, "role": self._role,
+                "codec_version": CODEC_VERSION, "pid": os.getpid(),
+                "max_frame_bytes": int(self.max_frame_bytes),
+                "max_seq_len": int(eng.model.cfg.max_seq_len),
+                "max_seats": int(eng.config.max_ragged_sequence_count),
+                "kv_block_size": int(eng.config.kv_block_size)}
+
+    def _rpc_assign(self, p: dict, conn: Connection) -> bool:
+        if self.replica is None:
+            raise RuntimeError("assign before hello")
+        req = request_from_wire(p["req"])
+        with self._lock:
+            chunks = self._stage_rx.pop(req.uid, [])
+        req.staged_kv = payload_from_chunks(p.get("staged_meta"), chunks)
+        with self._lock:
+            self._reqs[req.uid] = req
+        ok = self.replica.assign(req)
+        if ok:
+            threading.Thread(target=self._pump, args=(req,), daemon=True,
+                             name=f"fabric-pump-{req.uid}").start()
+        else:
+            with self._lock:
+                self._reqs.pop(req.uid, None)
+        return ok
+
+    def _rpc_evacuate(self, p: dict, conn: Connection) -> bool:
+        if self.replica is None:
+            return False
+        self.replica.request_evacuation(self._evac_handback)
+        return True
+
+    def _evac_handback(self, req, payload, replica_id: int) -> None:
+        """Runs on the replica worker thread: stream the exported KV (if
+        any) back to the frontend, chunk by chunk. NOTE trailing tokens
+        may still sit in the request's pump queue; a mirror that missed
+        some sees a seen_tokens mismatch at import and falls back to
+        re-prefill — lossless either way (import failure is atomic)."""
+        req._fabric_detached = True
+        meta = self._send_payload(req.uid, payload)
+        self._send_event({"t": "ev", "ev": "evacuated", "uid": req.uid,
+                          "meta": meta})
+        with self._lock:
+            self._reqs.pop(req.uid, None)
+        req.finish(RequestState.REJECTED, "draining")
+
+    # ------------------------------------------------------------- pumping
+    def _send_event(self, msg: dict) -> None:
+        conn = self._conn
+        if conn is None:
+            return
+        try:
+            conn.send(msg)
+        except FabricError:
+            pass
+
+    def _send_payload(self, uid: int, payload) -> Optional[dict]:
+        """Stream a KV payload as chunk frames; returns the meta dict to
+        stamp on the final event, or None when there is no payload OR a
+        chunk broke the frame bound (the client degrades to
+        re-prefill)."""
+        meta, chunks = payload_chunks(payload)
+        if meta is None:
+            return None
+        conn = self._conn
+        if conn is None:
+            return None
+        try:
+            for c in chunks:
+                conn.send({"t": "ev", "ev": "payload_chunk", "uid": uid,
+                           "slabs": c["slabs"]})
+        except FrameTooLarge:
+            self._send_event({"t": "ev", "ev": "payload_abort", "uid": uid})
+            return None
+        except FabricError:
+            return None
+        return meta
+
+    def _pump(self, req) -> None:
+        """Per-request event pump: the request's queue is the ordering
+        authority — tokens first, then exactly one terminal marker
+        (finish / failover / handoff)."""
+        while True:
+            ev = req._events.get()
+            if isinstance(ev, DoneEvent):
+                break
+            self._send_event({"t": "ev", "ev": "token", "uid": req.uid,
+                              "token": ev.token})
+        with self._lock:
+            self._reqs.pop(req.uid, None)
+        if getattr(req, "_fabric_failover", False):
+            self._send_event({"t": "ev", "ev": "failover", "uid": req.uid})
+            return
+        payload = getattr(req, "_fabric_handoff_payload", None)
+        if req.finish_reason == "prefilled":
+            meta = self._send_payload(req.uid, payload)
+            self._send_event({"t": "ev", "ev": "handoff", "uid": req.uid,
+                              "meta": meta})
+            return
+        if getattr(req, "_fabric_detached", False):
+            return                  # evacuation sent its own marker
+        self._send_event({"t": "ev", "ev": "finish", "uid": req.uid,
+                          "reason": req.finish_reason,
+                          "state": req.state.value})
+
+    # -------------------------------------------------------------- status
+    def _status_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(STATUS_INTERVAL_S)
+            rep, conn = self.replica, self._conn
+            if rep is None or conn is None or not conn.alive:
+                continue
+            try:
+                rep.check_health()
+                snap = self.registry.snapshot()
+                counters = {n: float(snap.get(n, 0.0))
+                            for n in RemoteHandle._FORWARDED_COUNTERS}
+                eng = self._engine
+                self._send_event({
+                    "t": "ev", "ev": "status",
+                    "state": rep.state.value,
+                    "thread_alive": rep.thread.is_alive(),
+                    "occupancy": eng.occupancy(),
+                    "param_stats": eng.param_stats(),
+                    "tier_stats": eng.tier_stats(),
+                    "counters": counters})
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(f"fabric replica server {self.replica_id}: "
+                             f"status tick failed: {e!r}")

@@ -1,0 +1,376 @@
+"""Length-prefixed socket RPC transport for the serving fabric.
+
+One TCP connection per (frontend, replica server) pair carries three
+message shapes, every one a codec frame (fabric/codec.py) behind a
+``u32`` length prefix:
+
+- **calls** ``{"t": "call", "id", "m", "p"}`` answered by
+  ``{"t": "resp", "id", "p"}`` or ``{"t": "err", "id", "error"}`` —
+  multiplexed: many calls may be in flight, matched by id;
+- **notifies** ``{"t": "ev", ...}`` — one-way, both directions (token
+  streams, status updates, cancellation);
+- **heartbeats** ``{"t": "ping"}`` / ``{"t": "pong"}`` — liveness.
+  *Any* received frame refreshes the peer-liveness clock; an idle,
+  healthy connection stays alive on pings alone.
+
+Threading model (docs/CONCURRENCY.md): a writer thread owns the socket's
+send side and drains a plain ``queue.Queue`` outbox — no ranked lock is
+ever held across socket I/O — and a reader thread owns the receive side,
+resolving call responses under the ``serving.fabric.transport`` lock and
+dispatching events with **no** lock held (handlers take their own,
+higher-level locks). Connection death is a single idempotent
+transition: pending calls fail with :class:`ConnectionLost`, the
+``on_close`` hook fires exactly once, and ``alive`` goes false — the
+caller (RemoteHandle) maps that to a DEAD replica.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ...utils.locks import RankedLock
+from ...utils.logging import logger
+from .codec import (CodecError, FrameTooLarge, decode_frame,  # noqa: F401
+                    encode_frame)
+
+_LEN_FMT = ">I"
+_LEN_SIZE = struct.calcsize(_LEN_FMT)
+
+#: how many heartbeat intervals may pass without ANY received frame
+#: before the peer is presumed dead
+STALE_HEARTBEATS = 3.0
+
+#: floor on the staleness window regardless of heartbeat cadence: a
+#: healthy peer's event loop legitimately pauses for SECONDS while XLA
+#: compiles a new shape bucket (the wedge-timeout lesson, docs/
+#: SERVING.md), and reading that as death would kill replicas exactly
+#: when they warm up. A *closed* socket is detected instantly by the
+#: reader thread regardless — staleness only backstops silent half-open
+#: connections (network partitions, frozen hosts), where seconds of
+#: extra latency are the right trade.
+STALE_FLOOR_S = 10.0
+
+
+class FabricError(Exception):
+    """Base of the transport-level failure surface."""
+
+
+class RPCTimeout(FabricError):
+    """A call's deadline passed with no response (the connection may
+    still be alive — slow peer vs dead peer is the caller's policy)."""
+
+
+class ConnectionLost(FabricError):
+    """The connection died (socket error, EOF, protocol violation, or
+    explicit close) — a dead connection is a dead replica."""
+
+
+def parse_address(addr: str) -> Tuple[str, int]:
+    """``host:port`` -> tuple; the one address syntax fabric accepts."""
+    host, sep, port = addr.rpartition(":")
+    if not sep or not host:
+        raise ValueError(f"fabric address {addr!r} is not host:port")
+    return host, int(port)
+
+
+def advertised_address(listen_host: str, port: int) -> str:
+    """The address peers should dial for a server bound to
+    ``listen_host:port``. Wildcard/loopback binds advertise the host's
+    routable IP via :func:`deepspeed_tpu.comm.comm._routable_ip` (the
+    PR 1 MPI-discovery satellite — one discovery path, not two): a
+    multi-host fleet rendezvousing on 127.0.0.1 would connect every
+    frontend to its own loopback."""
+    if listen_host in ("", "0.0.0.0", "::", "localhost") \
+            or listen_host.startswith("127."):
+        from ...comm.comm import _routable_ip
+
+        return f"{_routable_ip()}:{port}"
+    return f"{listen_host}:{port}"
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """n bytes or None on clean EOF; raises OSError on socket failure."""
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            return None
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket,
+               max_frame_bytes: int = 0) -> Optional[bytes]:
+    """One length-prefixed frame body, None on clean EOF. An announced
+    length over ``max_frame_bytes`` raises :class:`FrameTooLarge`
+    BEFORE any allocation — an oversized (or garbage-length) frame must
+    be refused, not buffered."""
+    head = _recv_exact(sock, _LEN_SIZE)
+    if head is None:
+        return None
+    (length,) = struct.unpack(_LEN_FMT, head)
+    if max_frame_bytes and length > max_frame_bytes:
+        raise FrameTooLarge(length, max_frame_bytes)
+    body = _recv_exact(sock, length)
+    if body is None:
+        raise ConnectionLost("EOF inside a fabric frame")
+    return body
+
+
+def send_frame(sock: socket.socket, body: bytes) -> None:
+    sock.sendall(struct.pack(_LEN_FMT, len(body)) + body)
+
+
+class Connection:
+    """One framed, multiplexed fabric connection (either side)."""
+
+    # lock discipline (docs/CONCURRENCY.md): the pending-call table and
+    # id counter move under the transport lock; the dead flag is
+    # writes-only guarded (its readers — alive checks on hot paths —
+    # take lock-free last-write-wins snapshots by design). Socket I/O
+    # NEVER happens under the lock: sends ride the writer thread's
+    # outbox queue, receives live on the reader thread.
+    _GUARDED_BY = {
+        "_pending": "_lock",
+        "_next_id": "_lock",
+        "_dead": "_lock:writes",
+    }
+
+    def __init__(self, sock: socket.socket, *, max_frame_bytes: int = 0,
+                 heartbeat_s: float = 0.0,
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 on_close: Optional[Callable[[str], None]] = None,
+                 name: str = "fabric"):
+        self.name = name
+        self.max_frame_bytes = int(max_frame_bytes)
+        # SEND bound, negotiated down to the peer's receive bound in the
+        # hello exchange (0 = use max_frame_bytes). Catching an
+        # oversized payload at ENCODE keeps the typed degrade path
+        # (drop to re-prefill); a receiver-side FrameTooLarge kills the
+        # whole connection, which after negotiation only a
+        # non-conforming peer can trigger.
+        self.send_max_bytes = 0
+        self.heartbeat_s = float(heartbeat_s)
+        self._sock = sock
+        self._on_event = on_event
+        self._on_close = on_close
+        self._lock = RankedLock("serving.fabric.transport")
+        self._pending: Dict[int, dict] = {}
+        self._next_id = 0
+        self._dead = False
+        self._close_reason = ""
+        self._last_rx = time.monotonic()
+        self._outbox: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"{name}-reader")
+        self._writer = threading.Thread(target=self._write_loop, daemon=True,
+                                        name=f"{name}-writer")
+        self._beater = None
+        if self.heartbeat_s > 0:
+            self._beater = threading.Thread(target=self._beat_loop,
+                                            daemon=True,
+                                            name=f"{name}-heartbeat")
+
+    def start(self) -> None:
+        self._reader.start()
+        self._writer.start()
+        if self._beater is not None:
+            self._beater.start()
+
+    # ------------------------------------------------------------ liveness
+    @property
+    def alive(self) -> bool:
+        """False once the socket died OR the peer went silent past the
+        stale window (``max(STALE_FLOOR_S, STALE_HEARTBEATS ×
+        heartbeat_s)`` — the floor keeps routine XLA-compile pauses from
+        reading as death). Any received frame — response, event, ping,
+        pong — counts as liveness."""
+        if self._dead:
+            return False
+        if self.heartbeat_s > 0:
+            stale = max(STALE_FLOOR_S, STALE_HEARTBEATS * self.heartbeat_s)
+            if time.monotonic() - self._last_rx > stale:
+                return False
+        return True
+
+    @property
+    def close_reason(self) -> str:
+        return self._close_reason
+
+    # ------------------------------------------------------------- sending
+    def send(self, msg: dict) -> None:
+        """One-way notify. Raises :class:`FrameTooLarge` synchronously
+        when the encoded message breaks the frame bound (the caller
+        degrades — e.g. drops a KV payload to the re-prefill fallback);
+        raises :class:`ConnectionLost` on a dead connection."""
+        if self._dead:
+            raise ConnectionLost(self._close_reason or "connection closed")
+        self._outbox.put(encode_frame(
+            msg, self.send_max_bytes or self.max_frame_bytes))
+
+    def call(self, method: str, payload: Optional[dict] = None,
+             timeout_s: float = 30.0) -> Any:
+        """Request/response with deadline. Raises :class:`RPCTimeout`
+        after ``timeout_s`` with no answer, :class:`ConnectionLost` if
+        the connection dies first, and re-raises a remote error surface
+        as :class:`FabricError`."""
+        slot = {"done": threading.Event(), "resp": None, "error": None}
+        with self._lock:
+            if self._dead:
+                raise ConnectionLost(self._close_reason
+                                     or "connection closed")
+            self._next_id += 1
+            call_id = self._next_id
+            self._pending[call_id] = slot
+        try:
+            self.send({"t": "call", "id": call_id, "m": method,
+                       "p": payload or {}})
+        except FabricError:
+            with self._lock:
+                self._pending.pop(call_id, None)
+            raise
+        if not slot["done"].wait(timeout_s):
+            with self._lock:
+                self._pending.pop(call_id, None)
+            raise RPCTimeout(f"{self.name}: {method} timed out "
+                             f"after {timeout_s}s")
+        if slot["error"] is not None:
+            err = slot["error"]
+            if isinstance(err, FabricError):
+                raise err
+            raise FabricError(f"{method} failed remotely: {err}")
+        return slot["resp"]
+
+    def respond(self, call_id: int, payload: Any = None,
+                error: Optional[str] = None) -> None:
+        """Server-side answer to a received call."""
+        if error is not None:
+            self.send({"t": "err", "id": call_id, "error": str(error)})
+        else:
+            self.send({"t": "resp", "id": call_id, "p": payload})
+
+    # --------------------------------------------------------------- loops
+    def _write_loop(self) -> None:
+        while True:
+            body = self._outbox.get()
+            if body is None:
+                return
+            try:
+                send_frame(self._sock, body)
+            except OSError as e:
+                self._die(f"send failed: {e!r}")
+                return
+
+    def _read_loop(self) -> None:
+        while not self._dead:
+            try:
+                body = recv_frame(self._sock, self.max_frame_bytes)
+            except (OSError, CodecError, ConnectionLost) as e:
+                self._die(f"recv failed: {e!r}")
+                return
+            if body is None:
+                self._die("peer closed")
+                return
+            self._last_rx = time.monotonic()
+            try:
+                msg = decode_frame(body)
+                if not isinstance(msg, dict):
+                    raise CodecError(f"fabric message is a "
+                                     f"{type(msg).__name__}, not an "
+                                     "object")
+            except CodecError as e:
+                # a frame this end cannot parse means the two sides no
+                # longer speak the same protocol — kill the connection
+                # (typed, logged), never limp on with garbage
+                self._die(f"undecodable frame: {e!r}")
+                return
+            except Exception as e:  # pragma: no cover - last resort
+                # the codec's contract is typed errors only, but a
+                # surprise here must still take the dead-connection
+                # transition, never silently lose the reader thread
+                self._die(f"frame decode crashed: {e!r}")
+                return
+            self._handle(msg)
+
+    def _handle(self, msg: dict) -> None:
+        kind = msg.get("t")
+        if kind == "ping":
+            try:
+                self.send({"t": "pong"})
+            except FabricError:
+                pass
+            return
+        if kind == "pong":
+            return
+        if kind in ("resp", "err"):
+            with self._lock:
+                slot = self._pending.pop(msg.get("id"), None)
+            if slot is not None:
+                if kind == "err":
+                    slot["error"] = msg.get("error", "unknown remote error")
+                else:
+                    slot["resp"] = msg.get("p")
+                slot["done"].set()
+            return
+        # calls and events dispatch with NO transport lock held — the
+        # handler is free to take its own (higher-ranked) locks
+        if self._on_event is not None:
+            try:
+                self._on_event(msg)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(f"{self.name}: event handler failed: {e!r}")
+
+    def _beat_loop(self) -> None:
+        while not self._dead:
+            time.sleep(self.heartbeat_s)
+            if self._dead:
+                return
+            try:
+                self.send({"t": "ping"})
+            except FabricError:
+                return
+
+    # ------------------------------------------------------------ teardown
+    def _die(self, reason: str) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._close_reason = reason
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for slot in pending:
+            slot["error"] = ConnectionLost(reason)
+            slot["done"].set()
+        self._outbox.put(None)              # writer exits
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        cb = self._on_close
+        if cb is not None:
+            try:
+                cb(reason)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(f"{self.name}: on_close failed: {e!r}")
+
+    def close(self, reason: str = "closed") -> None:
+        self._die(reason)
+
+
+def dial(address: str, *, timeout_s: float = 5.0,
+         **conn_kwargs) -> Connection:
+    """Connect to a replica server and start the connection threads."""
+    host, port = parse_address(address)
+    sock = socket.create_connection((host, port), timeout=timeout_s)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn = Connection(sock, **conn_kwargs)
+    conn.start()
+    return conn

@@ -36,6 +36,67 @@ from .request import (FinishReason, Rejected, RequestHandle,
 from .router import ReplicaRouter
 
 
+class _PeerRef:
+    """Engine-factory sentinel for a fabric peer slot: the supervisor's
+    restart path calls ``engine_factory(rid)`` then
+    ``replica_factory(rid, engine)`` — for a remote slot the "engine"
+    is the peer address, and the replica factory builds a fresh
+    RemoteHandle (dial + server-side engine reset) instead."""
+
+    def __init__(self, address: str):
+        self.address = address
+
+
+def apply_engine_serving_config(engine, config: ServingConfig) -> None:
+    """Stamp the engine-level serving blocks (weight_quant → kv_quant →
+    prefix_cache → kv_tier → admission, in dependency order) onto a
+    built engine — the one configuration path shared by every replica
+    build site: the frontend's boot/restart/grow paths AND the fabric
+    replica server (fabric/server.py), so a remote engine is configured
+    exactly as a local one would be."""
+    if config.weight_quant.enabled:
+        # applied FIRST and BEFORE any traffic (quantizing is lossy and
+        # retraces the forward, both only legal with no tracked
+        # sequences — true on every build path: boot, supervisor
+        # restart, autoscaler grow, fabric server reset)
+        configure = getattr(engine, "configure_weight_quant", None)
+        if configure is not None:
+            wq = config.weight_quant
+            configure(True, dtype=wq.dtype, block=wq.block,
+                      skip=list(wq.skip))
+    if config.kv_quant.enabled:
+        # re-allocates the pools — only legal with no tracked sequences
+        configure = getattr(engine, "configure_kv_quant", None)
+        if configure is not None:
+            configure(True, config.kv_quant.dtype,
+                      config.kv_quant.scale_granularity)
+    if config.prefix_cache.enabled:
+        # safe on a built engine: matching simply starts now
+        configure = getattr(engine, "configure_prefix_cache", None)
+        if configure is not None:
+            configure(True, config.prefix_cache.max_cached_blocks or None)
+    if config.kv_tier.enabled:
+        # AFTER the prefix cache (the tier requires it — the engine
+        # raises on a tier without the cache, better caught at boot)
+        configure = getattr(engine, "configure_kv_tier", None)
+        if configure is not None:
+            kt = config.kv_tier
+            configure(True, host_bytes=kt.host_max_bytes,
+                      disk_path=kt.disk_path, disk_bytes=kt.disk_max_bytes)
+    if config.admission.active:
+        # stamped BEFORE the replica builds its scheduler (schedulers
+        # read engine config at construction)
+        configure = getattr(engine, "configure_admission", None)
+        if configure is not None:
+            adm = config.admission
+            configure(adm.reservation,
+                      oversubscription_factor=adm.oversubscription_factor,
+                      preemption_enabled=adm.preemption.enabled,
+                      victim_policy=adm.preemption.victim_policy,
+                      max_preemptions_per_seq=(
+                          adm.preemption.max_preemptions_per_seq))
+
+
 class ServingFrontend:
     # lock discipline (docs/CONCURRENCY.md): membership admin state is
     # written under the fleet lock. ``_closed`` and ``_role_overrides``
@@ -58,9 +119,28 @@ class ServingFrontend:
         given, is how the supervisor builds FRESH engines for restarted
         replicas (docs/SERVING.md "Fault tolerance"); without it a
         restart reuses the dead replica's engine when that is safe."""
-        if not engines:
-            raise ValueError("ServingFrontend needs at least one engine")
         self.config = config or ServingConfig()
+        # cross-process serving fabric (docs/SERVING.md "Multi-host
+        # serving"): peers are replica server processes adopted as
+        # RemoteHandle replicas, ids allocated after the local engines.
+        # None when disabled — no handles, no transport, the in-process
+        # stack byte for byte.
+        fab = self.config.fabric
+        self._fabric = fab if fab.enabled else None
+        peer_addrs = list(fab.peers) if self._fabric is not None else []
+        if not engines and not peer_addrs:
+            raise ValueError("ServingFrontend needs at least one engine "
+                             "(or fabric.peers)")
+        if peer_addrs and sample_fn is not None:
+            # a frontend-level callable cannot cross the wire: remote
+            # replicas would silently fall back to greedy sampling while
+            # local ones use the custom sampler — same request,
+            # different tokens depending on routing. Refuse loudly.
+            raise ValueError(
+                "fabric.peers is incompatible with a custom sample_fn — "
+                "a sampler callable cannot cross the process boundary "
+                "(configure sampling in the replica servers' specs "
+                "instead)")
         # the registry pre-declares every per-class series for the
         # CONFIGURED classes, so custom classes expose zero-valued
         # Prometheus series before first traffic too
@@ -117,7 +197,9 @@ class ServingFrontend:
         # membership mutations (the controller issues one at a time,
         # but the API must be safe for direct callers too).
         self._engine_factory = engine_factory
-        self._next_replica_id = len(engines)
+        self._peer_addrs = {len(engines) + i: addr
+                            for i, addr in enumerate(peer_addrs)}
+        self._next_replica_id = len(engines) + len(peer_addrs)
         self._role_overrides: dict = {}
         self._fleet_lock = RankedLock("serving.frontend.fleet")
         # evacuated KV rides the same bounded host-RAM staging budget
@@ -144,7 +226,7 @@ class ServingFrontend:
         self._disagg = dis if dis.enabled else None
         self._stager = None
         if self._disagg is not None:
-            self._validate_disaggregation(len(engines))
+            self._validate_disaggregation(len(engines) + len(peer_addrs))
             if dis.handoff.enabled:
                 from .handoff import HandoffStager
 
@@ -152,6 +234,8 @@ class ServingFrontend:
                                              self.metrics)
         replicas = [self._build_replica(i, eng)
                     for i, eng in enumerate(engines)]
+        replicas += [self._build_remote(rid, addr)
+                     for rid, addr in sorted(self._peer_addrs.items())]
         # ~1/s observability tick on the router loop: windowed-metrics
         # snapshots always; SLO alert evaluation when enabled
         tick_hooks = [self._observability_tick]
@@ -164,8 +248,14 @@ class ServingFrontend:
         if ft.enabled:
             from .supervisor import ReplicaSupervisor
 
+            # with fabric peers, the supervisor's engine source resolves
+            # peer slots to _PeerRef sentinels (restart = fresh handle +
+            # server-side engine reset) and local slots to the caller's
+            # factory
             self.supervisor = ReplicaSupervisor(
-                self.router, self._build_replica, engine_factory,
+                self.router, self._build_replica,
+                (self._engine_source if self._peer_addrs
+                 else engine_factory),
                 config=ft, metrics=self.metrics, tracer=self.tracer,
                 recorder=self.recorder, journal=self.journal)
             self.router.supervisor = self.supervisor
@@ -226,76 +316,69 @@ class ServingFrontend:
             return "mixed"
         return self._disagg.role_of(replica_id)
 
+    def _engine_source(self, replica_id: int):
+        """Supervisor-facing engine factory when fabric peers exist:
+        peer slots resolve to :class:`_PeerRef` sentinels (the restart
+        builds a fresh RemoteHandle against the same server), local
+        slots to the caller's factory — or ``None`` when there is no
+        factory, which tells the supervisor to take its historical
+        salvage-engine path (a mixed fleet without a factory must keep
+        the same local-restart behavior it had before fabric)."""
+        addr = self._peer_addrs.get(replica_id)
+        if addr is not None:
+            return _PeerRef(addr)
+        if self._engine_factory is None:
+            return None
+        return self._engine_factory(replica_id)
+
+    def _build_remote(self, replica_id: int, address: str,
+                      reset: bool = False):
+        """One RemoteHandle over a fabric peer with this frontend's full
+        wiring — the boot path AND the supervisor's restart path
+        (``reset=True`` additionally rebuilds the server-side engine, so
+        a restarted remote replica is as fresh as a restarted local
+        one). The server applies the engine-level config blocks itself
+        (``apply_engine_serving_config`` from ITS spec) — the role is
+        the one thing the frontend dictates."""
+        from .fabric.remote import RemoteHandle
+
+        ft = self.config.fault_tolerance
+        handle = RemoteHandle(
+            replica_id, address, self.config.fabric,
+            role=self._role_of(replica_id), metrics=self.metrics,
+            tracer=self.tracer, recorder=self._replica_recorder,
+            journal=self.journal,
+            on_failover=self._failover if ft.enabled else None,
+            on_handoff=self._handoff_remote)
+        handle.connect(reset=reset)
+        return handle
+
     def _build_replica(self, replica_id: int, engine) -> Replica:
         """One replica over ``engine`` with this frontend's full wiring —
         the constructor path AND the supervisor's restart path, so a
         restarted replica is indistinguishable from a first-boot one
-        (prefix cache applied, proposer built, telemetry attached)."""
-        if self.config.weight_quant.enabled:
-            # config-driven int8/fp8 weight serving: applied FIRST and
-            # BEFORE any traffic (quantizing is lossy and retraces the
-            # forward, both only legal with no tracked sequences — true
-            # on every build path: boot, supervisor restart, autoscaler
-            # grow). Engines the caller quantized directly are left
-            # alone when the block is off; configure_weight_quant
-            # no-ops on an engine already quantized with these settings.
-            configure = getattr(engine, "configure_weight_quant", None)
-            if configure is not None:
-                wq = self.config.weight_quant
-                configure(True, dtype=wq.dtype, block=wq.block,
-                          skip=list(wq.skip))
-        if self.config.kv_quant.enabled:
-            # config-driven int8 KV quantization: applied BEFORE any
-            # traffic reaches the engine (configure_kv_quant re-allocates
-            # the pools, which is only legal with no tracked sequences —
-            # true both at first boot and on the supervisor's fresh-engine
-            # restart path). Engines the caller quantized directly are
-            # left alone when the block is off.
-            configure = getattr(engine, "configure_kv_quant", None)
-            if configure is not None:
-                configure(True, self.config.kv_quant.dtype,
-                          self.config.kv_quant.scale_granularity)
-        if self.config.prefix_cache.enabled:
-            # config-driven prefix caching: flip it on every engine that
-            # supports it (enabling on a built engine is safe — matching
-            # simply starts now). Engines the caller already enabled
-            # directly are left alone when the config block is off.
-            configure = getattr(engine, "configure_prefix_cache", None)
-            if configure is not None:
-                configure(True,
-                          self.config.prefix_cache.max_cached_blocks
-                          or None)
-        if self.config.kv_tier.enabled:
-            # tiered KV memory (docs/SERVING.md "KV tiering"): applied
-            # AFTER the prefix cache (the tier requires it — the engine
-            # raises on a config that enables the tier without the
-            # cache, a misconfiguration better caught at boot than as a
-            # silent never-spills tier). Engines the caller tiered
-            # directly are left alone when the block is off.
-            configure = getattr(engine, "configure_kv_tier", None)
-            if configure is not None:
-                kt = self.config.kv_tier
-                configure(True, host_bytes=kt.host_max_bytes,
-                          disk_path=kt.disk_path,
-                          disk_bytes=kt.disk_max_bytes)
-        if self.config.admission.active:
-            # admission overhaul (docs/SERVING.md "Admission and
-            # preemption"): stamped onto the engine config BEFORE the
-            # replica builds its scheduler (schedulers read it at
-            # construction). Engines the caller configured directly are
-            # left alone when the block is off.
-            configure = getattr(engine, "configure_admission", None)
-            if configure is not None:
-                adm = self.config.admission
-                configure(adm.reservation,
-                          oversubscription_factor=adm.oversubscription_factor,
-                          preemption_enabled=adm.preemption.enabled,
-                          victim_policy=adm.preemption.victim_policy,
-                          max_preemptions_per_seq=(
-                              adm.preemption.max_preemptions_per_seq))
+        (prefix cache applied, proposer built, telemetry attached).
+        A :class:`_PeerRef` "engine" builds a RemoteHandle instead —
+        the supervisor's restart path for fabric peer slots."""
+        if isinstance(engine, _PeerRef):
+            return self._build_remote(replica_id, engine.address,
+                                      reset=True)
+        # engine-level config blocks (weight/kv quant, prefix cache,
+        # tier, admission) — the shared path also used by the fabric
+        # replica server, so local and remote engines configure alike
+        apply_engine_serving_config(engine, self.config)
         ft = self.config.fault_tolerance
         role = self._role_of(replica_id)
-        return Replica(replica_id, engine, self.metrics, self._sample_fn,
+        cls = Replica
+        if self._fabric is not None:
+            # fabric fleets name their in-process workers LocalHandle —
+            # an EMPTY Replica subclass (fabric/handle.py), so behavior
+            # is identical by construction; disabled fabric keeps plain
+            # Replica, the byte-for-byte historical path
+            from .fabric.handle import LocalHandle
+
+            cls = LocalHandle
+        return cls(replica_id, engine, self.metrics, self._sample_fn,
                        wedge_timeout_s=self.config.wedge_timeout_s,
                        speculative=self._spec, tracer=self.tracer,
                        recorder=self._replica_recorder,
@@ -425,7 +508,13 @@ class ServingFrontend:
             return
         payload = None
         try:
-            payload = engine.export_sequence(req.uid)
+            # block-granularity streamed export (docs/SERVING.md
+            # "Multi-host serving"): chunk_blocks > 0 dispatches every
+            # chunk's host copy before any materializes (overlapped
+            # copies, host-RAM payload) in units the import/wire side
+            # streams one at a time
+            payload = engine.export_sequence(
+                req.uid, chunk_blocks=self._disagg.handoff.chunk_blocks)
         except Exception as e:
             logger.warning(f"serving replica {replica_id}: KV export for "
                            f"request {req.uid} failed ({e!r}); falling "
@@ -435,16 +524,46 @@ class ServingFrontend:
                 engine.flush(req.uid)
             except Exception:
                 pass
-        # the "handoff" span covers staging + queue wait + import; it is
-        # ended by the decode replica at import (or by req.finish)
-        req.begin_span(self.tracer, "handoff",
-                       attrs={"from_replica": replica_id,
-                              "blocks": (payload or {}).get("n_blocks", 0)})
         if payload is not None:
             # last_logits rides the payload: the decode replica samples
             # its first token from the source's final prompt position —
             # the byte-losslessness hinge
             payload["last_logits"] = sreq.last_logits
+        self._stage_handoff(req, payload, replica_id)
+
+    def _handoff_remote(self, req: ServingRequest, payload,
+                        replica_id: int) -> None:
+        """Remote-prefill completion (docs/SERVING.md "Multi-host
+        serving"): the export and flush already ran in the replica
+        server process; settle the cancel/deadline/shutdown races here
+        and stage/requeue exactly like the local path (``payload`` None
+        = server-side export failed or broke the frame bound → the same
+        recompute fallback)."""
+        if (self._closed or req.cancel_requested.is_set()
+                or req.expired()):
+            if req.cancel_requested.is_set():
+                req.finish(RequestState.CANCELLED, FinishReason.CANCELLED)
+                self.metrics.counter("requests_cancelled").inc()
+            elif req.expired():
+                req.finish(RequestState.EXPIRED, FinishReason.DEADLINE)
+                self.metrics.counter("requests_expired").inc()
+            else:
+                req.finish(RequestState.REJECTED, "draining")
+                self.metrics.counter("requests_shed").inc()
+            return
+        self._stage_handoff(req, payload, replica_id)
+
+    def _stage_handoff(self, req: ServingRequest, payload,
+                       replica_id: int) -> None:
+        """Shared tail of the prefill→decode handoff (local export and
+        remote payload alike): stage under the host-RAM budget and
+        requeue for a decode-capable replica, or degrade to the
+        recompute fallback."""
+        # the "handoff" span covers staging + queue wait + import; it is
+        # ended by the decode replica at import (or by req.finish)
+        req.begin_span(self.tracer, "handoff",
+                       attrs={"from_replica": replica_id,
+                              "blocks": (payload or {}).get("n_blocks", 0)})
         if payload is not None and self._stager is not None \
                 and self._stager.try_stage(req, payload):
             self.metrics.counter("handoffs_started").inc()
@@ -637,18 +756,26 @@ class ServingFrontend:
             self._role_overrides[replica_id] = role
             try:
                 self._drain_out(target, timeout_s)
-                if target.thread.is_alive():
-                    # wedged mid-drain: the stuck thread owns the old
-                    # engine — only a fresh one is safe
-                    if self._engine_factory is None:
-                        raise RuntimeError(
-                            f"replica {replica_id} wedged during "
-                            "re-role drain and no engine_factory exists")
-                    engine = self._engine_factory(replica_id)
+                if getattr(target, "is_remote", False):
+                    # fabric peer: the engine lives server-side — a
+                    # fresh handle re-attaches with the new role (the
+                    # server rebuilds its replica on the role change)
+                    replacement = self._build_remote(
+                        replica_id, self._peer_addrs[replica_id])
                 else:
-                    engine = getattr(target.engine, "_ft_inner",
-                                     target.engine)
-                replacement = self._build_replica(replica_id, engine)
+                    if target.thread.is_alive():
+                        # wedged mid-drain: the stuck thread owns the
+                        # old engine — only a fresh one is safe
+                        if self._engine_factory is None:
+                            raise RuntimeError(
+                                f"replica {replica_id} wedged during "
+                                "re-role drain and no engine_factory "
+                                "exists")
+                        engine = self._engine_factory(replica_id)
+                    else:
+                        engine = getattr(target.engine, "_ft_inner",
+                                         target.engine)
+                    replacement = self._build_replica(replica_id, engine)
                 displaced = self.router.replace_replica(replica_id,
                                                         replacement)
                 # the slot is retired during the swap, so nothing else
@@ -743,7 +870,8 @@ class ServingFrontend:
             ReplicaInfo(r.replica_id, getattr(r, "role", "mixed"),
                         r.accepting, r.replica_id in parked,
                         r.outstanding_prefill_tokens,
-                        r.outstanding_decode_tokens)
+                        r.outstanding_decode_tokens,
+                        remote=bool(getattr(r, "is_remote", False)))
             for r in self.router.replicas)
         burn = 0.0
         if self.alerts is not None:
@@ -778,6 +906,17 @@ class ServingFrontend:
         if self.admission.remove(req):
             req.finish(RequestState.CANCELLED, FinishReason.CANCELLED)
             self.metrics.counter("requests_cancelled").inc()
+            return
+        # cross-process cancel (docs/SERVING.md "Multi-host serving"):
+        # a local replica polls the flag between scheduler steps, but a
+        # remote replica's worker reads ITS copy of the request — the
+        # flag must cross the wire. No-op for local replicas (no
+        # notify_cancel attribute).
+        rep = (self.router.replica_by_id(req.replica_id)
+               if req.replica_id is not None else None)
+        notify = getattr(rep, "notify_cancel", None)
+        if notify is not None:
+            notify(req)
 
     def wait_all(self, handles: Sequence[RequestHandle],
                  timeout: Optional[float] = None) -> bool:

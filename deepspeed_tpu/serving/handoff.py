@@ -20,6 +20,15 @@ payload is consumed (``ServingRequest.take_staged``) **or** when the
 request reaches any terminal state first (cancel / deadline / shed /
 shutdown — ``ServingRequest.finish`` drops the payload), so an abandoned
 request can never pin the buffer.
+
+Payloads may arrive in the block-granularity streamed form
+(``handoff.chunk_blocks`` > 0, docs/SERVING.md "Multi-host serving"):
+the ``"chunks"`` list holds per-chunk host slab groups whose
+device→host copies were all dispatched before any materialized
+(overlapped), in units the wire codec and the import scatter stream one
+at a time — a long-context handoff overlaps its transfer with ongoing
+decode. The budget is per payload either way; both local and remote
+handles stage through the same slots.
 """
 
 from __future__ import annotations

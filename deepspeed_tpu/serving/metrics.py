@@ -412,7 +412,12 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # autoscaling"): requests handed off a draining replica
               # during removal/re-role (staged-KV or re-prefill resume,
               # both lossless under greedy decoding)
-              "requests_evacuated"):
+              "requests_evacuated",
+              # serving fabric (docs/SERVING.md "Multi-host serving"):
+              # retries = reconnect/backoff attempts against replica
+              # servers; disconnects = transport losses that turned a
+              # remote handle DEAD (each one fires the failover path)
+              "rpc_retries", "handle_disconnects"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -457,7 +462,10 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # the proactive (budget-burn-driven) brownout flag
               "replicas_target", "replicas_role_prefill",
               "replicas_role_decode", "replicas_role_mixed",
-              "brownout_proactive_active"):
+              "brownout_proactive_active",
+              # serving fabric: RPC calls currently awaiting a replica
+              # server's response (docs/SERVING.md "Multi-host serving")
+              "rpc_inflight"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md
@@ -469,7 +477,12 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
               # preemption spill (export → tier) / resume (import →
               # running) wall time, one sample per preempted sequence
               # (docs/SERVING.md "Admission and preemption")
-              "preempt_spill_s", "preempt_resume_s"):
+              "preempt_spill_s", "preempt_resume_s",
+              # serving fabric: per-RPC wall time (hello/assign/
+              # evacuate), the transport-overhead signal the bench
+              # fabric phase stamps (docs/SERVING.md "Multi-host
+              # serving")
+              "rpc_call_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
     # RankedLock debug-mode hold times (docs/CONCURRENCY.md): zero
     # samples unless enable_lock_debug() attached this registry

@@ -1,4 +1,11 @@
-"""Replica router: admission queue → least-loaded healthy replica.
+"""Replica router: admission queue → least-loaded healthy replica handle.
+
+The router speaks to engine *handles* (fabric/handle.py
+``HANDLE_SURFACE``), never to engines or threads: an entry of
+``self.replicas`` is an in-process :class:`Replica`/``LocalHandle`` or a
+cross-process :class:`~deepspeed_tpu.serving.fabric.remote.RemoteHandle`
+— selection, health sweeps, drain and membership mutations are identical
+either way (docs/SERVING.md "Multi-host serving").
 
 A dispatcher thread pops the highest-urgency request from the
 :class:`AdmissionQueue` and assigns it to the *accepting* replica with the

@@ -247,8 +247,14 @@ class ReplicaSupervisor:
         re-wraps) and flushes leftover sequences so KV blocks return."""
         if old_replica.thread.is_alive():
             return None
+        sched = getattr(old_replica, "scheduler", None)
+        if sched is None:
+            # remote handle (docs/SERVING.md "Multi-host serving"):
+            # there is no in-process engine to salvage — peer slots
+            # normally restart through the frontend's _PeerRef engine
+            # source; reaching here means no factory at all, so park
+            return None
         engine = getattr(old_replica.engine, "_ft_inner", old_replica.engine)
-        sched = old_replica.scheduler
         for uid in list(sched.running) + [r.uid for r in sched.pending]:
             try:
                 engine.flush(uid)
@@ -281,9 +287,15 @@ class ReplicaSupervisor:
                         reason=f"restart_replica-{rid}")
                 except Exception:  # pragma: no cover - defensive
                     pass
+            engine = None
             if self.engine_factory is not None:
+                # a factory may decline a specific slot with None (the
+                # frontend's fabric engine source does this for local
+                # slots when the caller passed no factory) — that slot
+                # falls through to the historical salvage path
                 engine = self.engine_factory(rid)
-            else:
+            fresh = engine is not None
+            if engine is None:
                 engine = self._salvage_engine(old)
             if engine is None:
                 with self._lock:
@@ -294,7 +306,7 @@ class ReplicaSupervisor:
                 "replica_restart", trace_id=f"replica-{rid}",
                 attrs={"attempt": attempt,
                        "backoff_s": round(getattr(slot, "backoff_s", 0.0), 4),
-                       "fresh_engine": self.engine_factory is not None}) \
+                       "fresh_engine": fresh}) \
                 if self.tracer.enabled else None
             replacement = self.replica_factory(rid, engine)
             if self._stop.is_set() or slot.retired:
@@ -336,7 +348,7 @@ class ReplicaSupervisor:
                     "replica_restart", replica=rid, attempt=attempt,
                     recovery_s=round(t_up - t_dead, 4),
                     backoff_s=round(getattr(slot, "backoff_s", 0.0), 4),
-                    fresh_engine=self.engine_factory is not None)
+                    fresh_engine=fresh)
             logger.warning(f"serving replica {rid} restarted "
                            f"(attempt {attempt}, "
                            f"{t_up - t_dead:.2f}s after death)")

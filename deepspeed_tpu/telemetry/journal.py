@@ -84,6 +84,12 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     "scale_down": frozenset({"replica", "fleet_size", "reason"}),
     "replica_reroled": frozenset({"replica", "from_role", "to_role"}),
     "brownout_proactive": frozenset({"active", "fraction"}),
+    # serving fabric (docs/SERVING.md "Multi-host serving"): a remote
+    # replica handle lost its transport (the handle went DEAD and its
+    # in-flight requests failed over) / a rebuilt handle re-attached to
+    # its replica server (supervisor restart or reconnect)
+    "replica_disconnected": frozenset({"replica", "reason"}),
+    "replica_reconnected": frozenset({"replica"}),
     # ----------------------------------------------------------- training
     # supervised restart (docs/TRAINING.md "Fault tolerance")
     "train_restart": frozenset({"reason", "attempt", "steps_lost",

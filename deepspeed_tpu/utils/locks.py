@@ -50,6 +50,9 @@ LOCK_RANKS = {
     # ------------------------------------------------- request flow
     "serving.queue": 60,           # admission heap (condition)
     "serving.replica": 70,         # per-replica delivery/accounting
+    "serving.fabric.remote": 72,   # remote-handle mirror/accounting
+    "serving.fabric.server": 74,   # replica-server request table
+    "serving.fabric.transport": 76,    # RPC pending-call table
     "serving.handoff": 80,         # KV staging budget
     "serving.faults": 90,          # serving fault-injection schedule
     "serving.request.seq": 100,    # uid allocation

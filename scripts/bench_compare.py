@@ -48,6 +48,9 @@ _LOWER_IS_BETTER = (
     # overload phase: sheds under preemption pressure mean the
     # oversubscribed pool ran out of graceful-degradation headroom
     "shed_preempt_pressure",
+    # fabric phase: transport losses that turned a remote handle DEAD
+    # (each one is a failover storm) — zero on a healthy localhost run
+    "disconnects",
     # autoscale phase: replica-seconds are the fleet's cost ledger
     # (chip-seconds stand-in) — the elastic fleet's whole point is
     # spending fewer of them at equal SLO attainment
@@ -78,6 +81,9 @@ _HIGHER_IS_BETTER = (
     # weight_quant phase: replicas a fixed host byte budget can hold,
     # and the fp32/int8 resident-byte compression factor
     "replicas_at_budget", "compression",
+    # fabric phase: cross-process handoffs completed — fewer means the
+    # prefill->decode path degraded to re-prefill fallbacks
+    "handoffs_completed_fabric", "handoffs_completed_local",
 )
 
 

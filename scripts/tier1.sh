@@ -9,9 +9,10 @@
 #
 # Env knobs:
 #   TIER1_LOG      log path (default /tmp/_t1.log)
-#   TIER1_TIMEOUT  whole-run timeout in seconds (default 1800; raised
-#                  from 1200 when the kv_tier suite joined tier-1 — the
-#                  1200s bound started binding at the suite tail)
+#   TIER1_TIMEOUT  whole-run timeout in seconds (default 2700; raised
+#                  from 1200 when the kv_tier suite joined tier-1 and
+#                  from 1800 when the fabric suite joined — each time
+#                  the old bound started binding at the suite tail)
 #   TIER1_ARGS     extra pytest args (e.g. "-k spec")
 #   TIER1_PHASE    run ONE named serving bench phase as a smoke instead
 #                  of the test suite (e.g. TIER1_PHASE=kv_quant,
@@ -35,6 +36,13 @@
 #                  enabled:false greedy byte-parity asserted (the
 #                  kv_quant phase additionally carries the fp8_e4m3 KV
 #                  dtype axis: ppl_gate_ok_fp8 on the same bars),
+#                  or TIER1_PHASE=fabric for the cross-process serving
+#                  fabric — frontend + 2 subprocess replica servers on
+#                  localhost vs the same disaggregated fleet in-process:
+#                  greedy byte-parity AND fabric-disabled byte-parity
+#                  asserted (cross-process handoffs > 0 so parity isn't
+#                  vacuous), zero wedges, RPC overhead stamped
+#                  (rpc_p50/p95_ms + TTFT delta),
 #                  or TIER1_PHASE=autoscale for the elastic-autoscaling
 #                  phase — diurnal + bursty replay where the elastic
 #                  fleet must match/beat the static fleet's SLO
@@ -57,9 +65,9 @@ cd "$(dirname "$0")/.."
 LOG="${TIER1_LOG:-/tmp/_t1.log}"
 rm -f "$LOG"
 if [ -n "${TIER1_PHASE:-}" ]; then
-    timeout -k 10 "${TIER1_TIMEOUT:-1800}" env JAX_PLATFORMS=cpu \
+    timeout -k 10 "${TIER1_TIMEOUT:-2700}" env JAX_PLATFORMS=cpu \
         BENCH_SERVING_ONLY=1 BENCH_PHASES="$TIER1_PHASE" \
-        BENCH_TIMEOUT_S="${TIER1_TIMEOUT:-1800}" \
+        BENCH_TIMEOUT_S="${TIER1_TIMEOUT:-2700}" \
         python bench.py 2>&1 | tee "$LOG"
     rc=${PIPESTATUS[0]}
     echo "DOTS_PASSED=0"   # smoke mode: no pytest dots, exit code is truth
@@ -75,7 +83,7 @@ fi
 # audits, baselined exceptions in deepspeed_tpu/analysis/baseline.toml.
 python scripts/lint_concurrency.py 2>&1 | tee -a "$LOG"
 lint_rc=${PIPESTATUS[0]}
-timeout -k 10 "${TIER1_TIMEOUT:-1800}" env JAX_PLATFORMS=cpu \
+timeout -k 10 "${TIER1_TIMEOUT:-2700}" env JAX_PLATFORMS=cpu \
     python -m pytest "$TARGET" -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly ${TIER1_ARGS:-} 2>&1 | tee -a "$LOG"

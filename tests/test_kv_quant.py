@@ -505,7 +505,7 @@ def test_bench_schema_validator():
         "kv_occupancy": dict(occ)}
     for name in bench._STAMPED_PHASES:
         if name in ("kv_quant", "weight_quant", "train_chaos", "disagg",
-                    "slo", "kv_tier", "overload", "autoscale"):
+                    "slo", "kv_tier", "overload", "autoscale", "fabric"):
             continue            # typed phases built explicitly
         good[name] = {"kv_occupancy": dict(occ)}
     good["kv_tier"] = {"tier_on_p50_ttft_ms": 10.7,
@@ -564,7 +564,32 @@ def test_bench_schema_validator():
                          "requests_evacuated": 0,
                          "greedy_parity": True, "disabled_parity": True,
                          "kv_occupancy": dict(occ)}
+    good["fabric"] = {"replicas": 2, "n_requests": 8, "prompt_len": 24,
+                      "max_new": 8, "chunk_blocks": 1,
+                      "local_p50_ttft_ms": 1287.3,
+                      "local_p95_ttft_ms": 1287.4,
+                      "local_p50_tpot_ms": 2.3, "local_p95_tpot_ms": 3.5,
+                      "fabric_p50_ttft_ms": 1967.6,
+                      "fabric_p95_ttft_ms": 1989.7,
+                      "fabric_p50_tpot_ms": 3.4,
+                      "fabric_p95_tpot_ms": 169.7,
+                      "rpc_calls": 22, "rpc_p50_ms": 0.8,
+                      "rpc_p95_ms": 175.0,
+                      "rpc_overhead_p50_ttft_ms": 680.3,
+                      "handoffs_completed_local": 10,
+                      "handoffs_completed_fabric": 10,
+                      "handoff_fallbacks_fabric": 0,
+                      "handle_disconnects": 0,
+                      "parity": True, "disabled_parity": True,
+                      "zero_wedges": True, "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
+    # fabric typed checks: bool-for-int rejected, missing fields named
+    bad_fb = dict(good)
+    bad_fb["fabric"] = {"rpc_calls": True, "parity": 1}
+    problems_fb = bench.validate_serving_schema(bad_fb)
+    assert any("fabric.rpc_calls" in p for p in problems_fb)
+    assert any("fabric.parity" in p for p in problems_fb)
+    assert any("fabric.zero_wedges: missing" in p for p in problems_fb)
     # autoscale typed checks: bool-for-int rejected, missing named
     bad_as = dict(good)
     bad_as["autoscale"] = {"scale_ups": True, "attainment_ok": 1}
