@@ -292,7 +292,32 @@ _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
                    "weight_quant",
                    "disagg", "slo", "kv_tier", "overload", "autoscale",
-                   "fabric")
+                   "fabric", "multitenant")
+# Typed shape of the multitenant phase (docs/SERVING.md "Multi-model &
+# multi-tenant serving"): tenant-B interactive p95 TTFT solo vs under a
+# tenant-A flood with deficit-weighted-fair admission ON (isolation:
+# within 1.5x of solo while A still progresses) and OFF (starvation
+# shown), plus the parity bits the acceptance gates read (greedy parity
+# across every scheduling mode + tenancy-disabled byte-parity, both
+# asserted in-phase).
+_MULTITENANT_KEYS = (("n_flood", int),
+                     ("n_interactive", int),
+                     ("flood_max_new", int),
+                     ("interactive_max_new", int),
+                     ("solo_p95_ttft_ms", (int, float)),
+                     ("fair_on_p95_ttft_ms", (int, float)),
+                     ("fair_off_p95_ttft_ms", (int, float)),
+                     ("isolation_ratio_on", (int, float)),
+                     ("starvation_ratio_off", (int, float)),
+                     ("isolation_ok", bool),
+                     ("flood_tokens_on", int),
+                     ("flood_progress_ok", bool),
+                     ("fair_beats_off", bool),
+                     ("tenant_b_submitted", int),
+                     ("tenant_b_shed", int),
+                     ("zero_wedges", bool),
+                     ("greedy_parity", bool),
+                     ("disabled_parity", bool))
 # Typed shape of the fabric phase (docs/SERVING.md "Multi-host
 # serving"): in-process vs subprocess-replica latency, per-RPC
 # transport overhead, the cross-process handoff count, and the parity
@@ -519,6 +544,11 @@ def validate_serving_schema(serving: dict):
         problems.append("fabric: missing or not an object")
     elif "phase_skipped" not in fb:
         _check_typed_phase("fabric", fb, _FABRIC_KEYS, problems)
+    mt = serving.get("multitenant")
+    if not isinstance(mt, dict):
+        problems.append("multitenant: missing or not an object")
+    elif "phase_skipped" not in mt:
+        _check_typed_phase("multitenant", mt, _MULTITENANT_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -2430,6 +2460,138 @@ def bench_serving(on_tpu: bool):
             "zero_wedges": bool(local["completed"] and fab["completed"]),
         }
 
+    def run_multitenant_phase():
+        """Multi-tenant fair-share admission (docs/SERVING.md
+        "Multi-model & multi-tenant serving"): tenant ALPHA floods the
+        queue with batchy same-class traffic, tenant BRAVO submits
+        sparse interactive requests behind it, one small fleet. Four
+        runs of the SAME greedy traffic: (1) BRAVO solo — the baseline
+        p95 TTFT; (2) fair-share ON (``tenants:`` configured) — BRAVO's
+        p95 must stay near solo (isolation_ok: within 1.5x) while
+        ALPHA's flood still progresses; (3) fair-share OFF (no
+        ``tenants:`` block) — the same flood starves BRAVO behind
+        ALPHA's FIFO backlog (starvation_ratio_off); (4) OFF with the
+        legacy submit() signature (no tenant kwarg at all) — asserted
+        byte-for-byte run (3), and no per-tenant series may appear in
+        the tenancy-off snapshot. Greedy parity across all four runs is
+        asserted: admission ORDER must never change token CONTENT."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+
+        if on_tpu:
+            n_flood, n_int = 14, 6
+            flood_plen, int_plen = 32, 128
+            flood_new, int_new = 12, 8
+            max_seqs = 2
+        else:
+            n_flood, n_int = 12, 5
+            flood_plen, int_plen = 16, 64
+            flood_new, int_new = 10, 6
+            max_seqs = 2
+        flood_prompts = [rng.integers(0, cfg.vocab_size,
+                                      size=flood_plen).tolist()
+                         for _ in range(n_flood)]
+        int_prompts = [rng.integers(0, cfg.vocab_size,
+                                    size=int_plen).tolist()
+                      for _ in range(n_int)]
+        tenants = {"alpha": {"weight": 1.0}, "bravo": {"weight": 4.0}}
+
+        def build_fe(with_tenants):
+            pcfg = type(vcfg)(**vars(vcfg))
+            pcfg.max_ragged_sequence_count = max_seqs
+            extra = {"tenants": tenants} if with_tenants else {}
+            eng = InferenceEngineV2(engine.model, params=engine.params,
+                                    config=pcfg)
+            return ServingFrontend([eng], ServingConfig(
+                max_queue_depth=128, **extra))
+
+        def drive(fe, flood, tenant_kwargs=True):
+            # warm dispatch first: TTFT baselines must not eat compiles
+            warm = fe.submit(int_prompts[0], max_new_tokens=2)
+            fe.wait_all([warm], timeout=600)
+            warm.drain()
+            kw_a = {"tenant": "alpha"} if tenant_kwargs else {}
+            kw_b = {"tenant": "bravo"} if tenant_kwargs else {}
+            ha = ([fe.submit(p, max_new_tokens=flood_new, **kw_a)
+                   for p in flood_prompts] if flood else [])
+            if flood:
+                time.sleep(0.3)     # the flood occupies the fleet first
+            hb = [fe.submit(p, max_new_tokens=int_new, **kw_b)
+                  for p in int_prompts]
+            done = fe.wait_all(ha + hb, timeout=600)
+            finished = all(h.state == RequestState.FINISHED
+                           for h in ha + hb)
+            evs_b = [h.drain() for h in hb]
+            evs_a = [h.drain() for h in ha]
+            return {
+                "completed": bool(done and finished),
+                "gens_b": [[ev.token for ev in e] for e in evs_b],
+                "gens_a": [[ev.token for ev in e] for e in evs_a],
+                "ttfts_b": [e[0].t - h._req.arrival_t
+                            for h, e in zip(hb, evs_b) if e],
+                "flood_tokens": sum(len(e) for e in evs_a),
+                "snap": fe.metrics_snapshot(),
+            }
+
+        def run_one(with_tenants, flood, tenant_kwargs=True):
+            fe = build_fe(with_tenants)
+            try:
+                return drive(fe, flood, tenant_kwargs)
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        solo = run_one(True, flood=False)
+        fair_on = run_one(True, flood=True)
+        fair_off = run_one(False, flood=True)
+        legacy = run_one(False, flood=True, tenant_kwargs=False)
+
+        assert solo["completed"] and fair_on["completed"] \
+            and fair_off["completed"] and legacy["completed"], \
+            "multitenant phase left unfinished requests"
+        greedy_parity = (solo["gens_b"] == fair_on["gens_b"]
+                         == fair_off["gens_b"]
+                         and fair_on["gens_a"] == fair_off["gens_a"])
+        assert greedy_parity, \
+            "fair-share admission changed greedy token content"
+        disabled_parity = (legacy["gens_a"] == fair_off["gens_a"]
+                           and legacy["gens_b"] == fair_off["gens_b"])
+        assert disabled_parity, \
+            "tenant= submit kwargs diverged from the legacy signature"
+        off_keys = [k for k in fair_off["snap"] if "tenant" in k]
+        assert not off_keys, \
+            f"tenancy-off snapshot grew per-tenant series: {off_keys}"
+        pct = lambda xs, q: (round(float(np.percentile(xs, q)) * 1e3, 3)  # noqa: E731
+                             if xs else -1.0)
+        solo_p95 = pct(solo["ttfts_b"], 95)
+        on_p95 = pct(fair_on["ttfts_b"], 95)
+        off_p95 = pct(fair_off["ttfts_b"], 95)
+        snap_on = fair_on["snap"]
+        return {
+            "n_flood": int(n_flood), "n_interactive": int(n_int),
+            "flood_max_new": int(flood_new),
+            "interactive_max_new": int(int_new),
+            "max_ragged_sequence_count": int(max_seqs),
+            "solo_p95_ttft_ms": solo_p95,
+            "fair_on_p95_ttft_ms": on_p95,
+            "fair_off_p95_ttft_ms": off_p95,
+            "isolation_ratio_on": round(on_p95 / max(solo_p95, 1e-9), 3),
+            "starvation_ratio_off": round(off_p95 / max(solo_p95, 1e-9),
+                                          3),
+            "isolation_ok": bool(on_p95 <= 1.5 * solo_p95),
+            "flood_tokens_on": int(fair_on["flood_tokens"]),
+            "flood_progress_ok": bool(
+                fair_on["flood_tokens"] == n_flood * flood_new),
+            "fair_beats_off": bool(on_p95 < off_p95),
+            "tenant_b_submitted": int(
+                snap_on.get("requests_submitted_tenant_bravo", 0)),
+            "tenant_b_shed": int(
+                snap_on.get("requests_shed_tenant_bravo", 0)),
+            "zero_wedges": True,
+            "greedy_parity": bool(greedy_parity),
+            "disabled_parity": bool(disabled_parity),
+        }
+
     # phase-resumable dispatch: per-phase budgets + artifact cache +
     # skip/degrade stamps (PhaseRunner docstring); every result carries
     # the shared engine's KV occupancy snapshot
@@ -2506,6 +2668,12 @@ def bench_serving(on_tpu: bool):
     # the same fleet in-process — greedy byte-parity, cross-process
     # handoff count, and the RPC transport overhead stamped
     result["fabric"] = runner.run("fabric", run_fabric_phase)
+    # multi-tenant fair-share phase (docs/SERVING.md "Multi-model &
+    # multi-tenant serving"): tenant-A flood vs tenant-B interactive —
+    # B's p95 TTFT near solo with fair-share on, starved with it off,
+    # greedy parity + tenancy-disabled byte-parity asserted
+    result["multitenant"] = runner.run("multitenant",
+                                       run_multitenant_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

@@ -406,6 +406,61 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     return ckpt_dir, manifest.get("client_state", {})
 
 
+def load_params_for_model(model, checkpoint_dir: str):
+    """Params-only load for SERVING (docs/SERVING.md "Multi-model &
+    multi-tenant serving"): build the inference weights of ``model``
+    from a training checkpoint, without an engine or optimizer state.
+
+    ``checkpoint_dir`` is either one tag directory (holds
+    ``manifest.json`` directly) or a save directory whose ``latest``
+    pointer is resolved first — the same two forms
+    :func:`load_checkpoint` accepts. The universal layout does the rest:
+    every leaf reassembles from its full ``.npy`` or shard files
+    regardless of the mesh that wrote it.
+
+    Raises :class:`FileNotFoundError` naming the manifest path when the
+    directory holds no checkpoint, and :class:`ValueError` naming the
+    offending leaves when the manifest's parameter set does not match
+    the model (the serve_replica.py misconfiguration path — a spec
+    pointing one model family at another family's weights must fail
+    loudly at boot, not serve garbage)."""
+    ckpt_dir = checkpoint_dir
+    manifest_path = os.path.join(ckpt_dir, "manifest.json")
+    if not os.path.exists(manifest_path):
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as fh:
+                tag = fh.read().strip()
+            ckpt_dir = os.path.join(checkpoint_dir, tag)
+            manifest_path = os.path.join(ckpt_dir, "manifest.json")
+    if not os.path.exists(manifest_path):
+        raise FileNotFoundError(
+            f"no checkpoint manifest at {manifest_path} — expected a tag "
+            f"directory containing manifest.json, or a save directory "
+            f"with a 'latest' pointer, under {checkpoint_dir}")
+    with open(manifest_path) as fh:
+        manifest = json.load(fh)
+    # shapes/dtypes without allocating: the template drives _load_tree
+    template = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(template)[0]
+    want = {_path_str(path) for path, _ in flat}
+    have = set(manifest.get("params_index", {}))
+    if have and want != have:
+        missing = sorted(want - have)
+        extra = sorted(have - want)
+        raise ValueError(
+            f"checkpoint {ckpt_dir} (tag {manifest.get('tag')!r}) does "
+            f"not match the model: "
+            + (f"model leaves absent from checkpoint {missing}; "
+               if missing else "")
+            + (f"checkpoint leaves unknown to model {extra}"
+               if extra else ""))
+    params = _load_tree(template, None, os.path.join(ckpt_dir, "params"))
+    logger.info(f"Loaded serving params from {ckpt_dir} "
+                f"({len(want)} leaves)")
+    return params
+
+
 def save_16bit_model(engine, save_dir: str, save_filename: str = "model.npz"):
     """Consolidated low-precision export (reference engine.py:3488
     ``save_16bit_model`` / ``_zero3_consolidated_16bit_state_dict``)."""

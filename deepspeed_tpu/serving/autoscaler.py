@@ -81,6 +81,9 @@ class ReplicaInfo:
     # the connection, the chips stay allocated — so local replicas are
     # preferred shrink victims at equal load
     remote: bool = False
+    # named model pool (docs/SERVING.md "Multi-model & multi-tenant
+    # serving"); "default" on homogeneous fleets
+    model_id: str = "default"
 
     @property
     def outstanding(self) -> float:
@@ -103,6 +106,11 @@ class FleetSignals:
     prefill_token_cost: float = 1.0
     decode_token_cost: float = 1.0
     disaggregated: bool = False
+    # per-model pool bounds as (model, min, max) rows — already
+    # resolved against the global min/max by the frontend (a ModelSpec
+    # leaves either end None to inherit). Empty on homogeneous fleets;
+    # growth then targets the caller engine_factory (model=None).
+    model_bounds: Tuple[Tuple[str, int, int], ...] = ()
 
 
 class FleetController:
@@ -253,18 +261,35 @@ class FleetController:
         # inside at one step per cooldown regardless of load
         if n_total < cfg.min_replicas \
                 and self._cooled(now, cfg.scale_up_cooldown_s):
-            return ("scale_up", self._grow_role(signals), "below_min")
+            return ("scale_up", self._grow_role(signals), "below_min",
+                    self._grow_model(signals))
         if n_total > cfg.max_replicas \
                 and self._cooled(now, cfg.scale_down_cooldown_s):
             victim = self._shrink_victim(signals)
             if victim is not None:
                 return ("scale_down", victim, "above_max")
 
+        # per-model pool repair (docs/SERVING.md "Multi-model &
+        # multi-tenant serving"): each named pool obeys its own
+        # resolved [min, max], one step per cooldown, same priority
+        # order as the global bounds — below-min first (capacity debt
+        # beats capacity excess)
+        counts = self._pool_counts(signals)
+        for model, mn, mx in signals.model_bounds:
+            live = counts.get(model, 0)
+            if live < mn and self._cooled(now, cfg.scale_up_cooldown_s):
+                return ("scale_up", self._grow_role(signals),
+                        "pool_below_min", model)
+            if live > mx and self._cooled(now, cfg.scale_down_cooldown_s):
+                victim = self._shrink_victim(signals, pool=model)
+                if victim is not None:
+                    return ("scale_down", victim, "pool_above_max")
+
         if self._up_streak >= cfg.up_stable_ticks \
                 and self._cooled(now, cfg.scale_up_cooldown_s):
             if n_total < cfg.max_replicas:
                 return ("scale_up", self._grow_role(signals),
-                        "queue_pressure")
+                        "queue_pressure", self._grow_model(signals))
             # at max with a parked corpse aboard: evict the corpse so
             # the NEXT round can grow live capacity — otherwise a
             # sustained burst (down_cond never holds under load) would
@@ -296,12 +321,48 @@ class FleetController:
         pre, dec = self._weighted_loads(signals)
         return "prefill" if pre > dec else "decode"
 
-    def _shrink_victim(self, signals: FleetSignals) -> Optional[int]:
+    @staticmethod
+    def _pool_counts(signals: FleetSignals) -> dict:
+        """Live (non-parked) replica count per model pool."""
+        counts: dict = {}
+        for r in signals.replicas:
+            if not r.parked:
+                counts[r.model_id] = counts.get(r.model_id, 0) + 1
+        return counts
+
+    def _grow_model(self, signals: FleetSignals) -> Optional[str]:
+        """Model pool a queue-pressure grow should target: the pool
+        with the highest outstanding tokens per accepting replica among
+        pools below their max. ``None`` on homogeneous fleets — the
+        frontend then grows from the caller ``engine_factory``."""
+        if not signals.model_bounds:
+            return None
+        counts = self._pool_counts(signals)
+        best, best_load = None, -1.0
+        for model, _mn, mx in signals.model_bounds:
+            live = counts.get(model, 0)
+            if live >= mx:
+                continue
+            acc = [r for r in signals.replicas
+                   if r.accepting and r.model_id == model]
+            load = (sum(r.outstanding for r in acc) / len(acc)
+                    if acc else float("inf"))   # empty pool: grow first
+            if load > best_load:
+                best, best_load = model, load
+        return best
+
+    def _shrink_victim(self, signals: FleetSignals,
+                       pool: Optional[str] = None) -> Optional[int]:
         """Replica id to remove: PARKED slots first (a circuit-broken
         corpse frees a seat at zero capacity cost), then the
         least-loaded accepting replica whose removal keeps at least one
-        accepting decode-capable replica (role-split fleets)."""
-        parked = [r for r in signals.replicas if r.parked]
+        accepting decode-capable replica (role-split fleets) and never
+        drains a model pool below its resolved min (or to zero) —
+        ``pool`` restricts the search to one model's replicas."""
+        pool_min = {m: mn for m, mn, _mx in signals.model_bounds}
+        counts = self._pool_counts(signals)
+        parked = [r for r in signals.replicas if r.parked
+                  and (pool is None or r.model_id == pool)]
         if parked:
             return min(parked, key=lambda r: r.replica_id).replica_id
         accepting = [r for r in signals.replicas if r.accepting]
@@ -309,6 +370,12 @@ class FleetController:
             return None         # never remove the last accepting replica
         candidates = []
         for r in accepting:
+            if pool is not None and r.model_id != pool:
+                continue
+            floor = pool_min.get(r.model_id)
+            if floor is not None and pool is None \
+                    and counts.get(r.model_id, 0) <= max(1, floor):
+                continue        # pool at its min (or last member) stays
             if signals.disaggregated and r.role in _DECODE_CAPABLE:
                 others_decode = sum(1 for o in accepting
                                     if o is not r
@@ -453,9 +520,16 @@ class FleetController:
         kind = action[0]
         now = self.clock()
         if kind == "scale_up":
-            _, role, reason = action
+            _, role, reason, model = (action if len(action) == 4
+                                      else action + (None,))
+
+            def _add(r):
+                # model=None keeps the legacy add_replica(role) call so
+                # fake fleets in the policy tests stay signature-exact
+                return (self.fleet.add_replica(r, model_id=model)
+                        if model is not None else self.fleet.add_replica(r))
             try:
-                rid = self.fleet.add_replica(role)
+                rid = _add(role)
             except Exception as e:
                 if role != "mixed":
                     # specialized growth rejected (e.g. handoff off):
@@ -463,14 +537,17 @@ class FleetController:
                     logger.warning(f"autoscaler: add_replica({role!r}) "
                                    f"failed ({e!r}); retrying as mixed")
                     role = "mixed"
-                    rid = self.fleet.add_replica(role)
+                    rid = _add(role)
                 else:
                     raise
             self._last_scale_t = now
             self._up_streak = self._down_streak = 0
             n = self._fleet_size()
-            self._record("scale_up", now, replica=rid, fleet_size=n,
-                         reason=reason, role=role)
+            detail = dict(replica=rid, fleet_size=n,
+                          reason=reason, role=role)
+            if model is not None:
+                detail["model"] = model
+            self._record("scale_up", now, **detail)
             self._set_target(n)
             logger.warning(f"autoscaler: scale UP -> {n} replicas "
                            f"(replica {rid}, role {role}, {reason})")

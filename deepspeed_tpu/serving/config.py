@@ -497,6 +497,99 @@ class FaultsConfig(DSConfigModel):
         return FaultInjector(self.schedule, seed=self.seed)
 
 
+class ModelSpec(DSConfigModel):
+    """One entry of the ``models: {...}`` registry (docs/CONFIG.md,
+    docs/SERVING.md "Multi-model & multi-tenant serving"): a named model
+    family the frontend serves as its own replica pool. ``model`` /
+    ``engine`` / ``seed`` / ``checkpoint`` mirror the
+    ``scripts/serve_replica.py`` spec exactly — the same dict describes
+    the model whether the pool is built in-process or adopted from a
+    replica server, which is what makes cross-process parity testable.
+    Programmatic callers (tests) may instead hand the frontend an
+    ``engine_factories[name]`` callable, which wins over ``model``."""
+
+    # TransformerConfig / RaggedInferenceEngineConfig kwargs (the
+    # serve_replica.py spec shape); {} model means an engine_factories
+    # entry MUST be supplied for this name
+    model: Dict[str, Any] = Field(default_factory=dict)
+    engine: Dict[str, Any] = Field(default_factory=dict)
+    # params = model.init(PRNGKey(seed)) unless checkpoint is given
+    seed: int = 0
+    # runtime checkpoint dir (runtime/checkpointing.py layout: a tag dir
+    # or a save_dir with a ``latest`` pointer); overrides seeded init
+    checkpoint: Optional[str] = None
+    # local in-process pool size for this model
+    replicas: int = 1
+    # fabric peer addresses ("host:port") serving THIS model — adopted
+    # as RemoteHandle replicas of this pool (fabric.enabled required;
+    # the hello exchange verifies the peer really hosts this model_id)
+    peers: List[str] = Field(default_factory=list)
+    # per-pool autoscaler bounds; None inherits the global
+    # autoscaler.min_replicas / max_replicas
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.replicas < 0:
+            raise ValueError("models.<name>.replicas must be >= 0")
+        if self.replicas == 0 and not self.peers:
+            raise ValueError(
+                "models.<name> needs replicas >= 1 or a peers list — a "
+                "pool with no members could never serve its model")
+        lo = self.min_replicas
+        hi = self.max_replicas
+        if lo is not None and lo < 1:
+            raise ValueError("models.<name>.min_replicas must be >= 1")
+        if lo is not None and hi is not None and hi < lo:
+            raise ValueError(
+                f"models.<name>.max_replicas ({hi}) must be >= "
+                f"min_replicas ({lo})")
+        for addr in self.peers:
+            host, sep, port = str(addr).rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(
+                    f"models.<name>.peers entry {addr!r} is not host:port")
+        return self
+
+
+class TenantPolicy(DSConfigModel):
+    """One entry of the ``tenants: {...}`` map (docs/CONFIG.md,
+    docs/SERVING.md "Multi-model & multi-tenant serving"): per-tenant
+    fair-share weight and quotas, enforced by
+    :class:`~deepspeed_tpu.serving.tenancy.TenantLedger`. A non-empty
+    map turns tenancy ON: deficit-weighted-fair ordering across tenants
+    in the admission queue, sliding-window token-rate throttling, and a
+    per-engine KV block budget riding the reservation ledger. The
+    ``default`` tenant is always merged in (the stock-classes idiom), so
+    ``submit()`` callers that never name a tenant keep working."""
+
+    # fair-share weight: a tenant with weight 2.0 drains twice the
+    # tokens of a weight-1.0 tenant under contention (must be > 0)
+    weight: float = 1.0
+    # sustained admission rate cap in tokens/s over the sliding window
+    # (prompt + max_new_tokens charged at pop); 0 = unlimited. Over-rate
+    # tenants are deprioritized (served only when no in-quota tenant has
+    # work) and become first-choice brownout/preemption victims.
+    token_rate: float = 0.0
+    # KV block budget per engine for this tenant's resident requests;
+    # 0 = unlimited. Enforced at dispatch via the admission reservation
+    # ledger's block math (engine kv_block_size).
+    kv_block_budget: int = 0
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.weight <= 0:
+            raise ValueError(
+                "tenants.<name>.weight must be > 0 — a zero-weight "
+                "tenant would never be scheduled under contention")
+        if self.token_rate < 0:
+            raise ValueError("tenants.<name>.token_rate must be >= 0")
+        if self.kv_block_budget < 0:
+            raise ValueError("tenants.<name>.kv_block_budget must be >= 0")
+        return self
+
+
 class ServingConfig(DSConfigModel):
     """Queue bounds, SLO defaults, replica fleet shape, shed policy."""
 
@@ -528,6 +621,45 @@ class ServingConfig(DSConfigModel):
         v.setdefault("interactive", ClassPolicy())
         v.setdefault("batch", ClassPolicy(priority=2, shed_rank=1))
         return v
+    # multi-model registry (docs/SERVING.md "Multi-model & multi-tenant
+    # serving"): named model families, each its own replica pool behind
+    # ONE frontend/queue/router; the router routes by request model_id.
+    # Empty (the default) = the historical single-model fleet byte for
+    # byte (every replica and request is model "default").
+    models: Dict[str, ModelSpec] = Field(default_factory=dict)
+    # submit() model when the caller names none; None resolves to
+    # "default" with no registry, else the first registered model name
+    # in sorted order (deterministic)
+    default_model: Optional[str] = None
+    # multi-tenant fair share + quotas (serving/tenancy.py): a non-empty
+    # map enables deficit-weighted-fair admission ordering across
+    # tenants, token-rate throttling, and per-engine KV block budgets.
+    # Empty (the default) = tenancy off — the pure class-ordered heap
+    # byte for byte. The "default" tenant is merged in whenever the map
+    # is non-empty (the stock-classes idiom).
+    tenants: Dict[str, TenantPolicy] = Field(default_factory=dict)
+
+    @field_validator("tenants", mode="after")
+    @classmethod
+    def _merge_stock_tenants(cls, v):
+        if v:
+            v.setdefault("default", TenantPolicy())
+        return v
+
+    @model_validator(mode="after")
+    def _validate_default_model(self):
+        if self.default_model is not None and self.models \
+                and self.default_model not in self.models:
+            raise ValueError(
+                f"serving.default_model {self.default_model!r} is not in "
+                f"the models registry {sorted(self.models)}")
+        return self
+
+    def resolve_default_model(self) -> str:
+        """The model_id ``submit()`` uses when the caller names none."""
+        if self.default_model is not None:
+            return self.default_model
+        return sorted(self.models)[0] if self.models else "default"
     # replicas
     num_replicas: int = 1               # fleet size (from_engine_factory)
     # a busy replica with no completed iteration for this long is DEAD.

@@ -59,6 +59,14 @@ class VersionMismatch(CodecError):
                          f"this process speaks v={want}")
 
 
+class ModelMismatch(CodecError):
+    """Hello exchange found the peer hosting a different model than the
+    pool adopting it expects (docs/SERVING.md "Multi-model &
+    multi-tenant serving") — a config error, permanent for this pairing:
+    retrying cannot fix it, and adopting anyway would misroute every
+    request of the pool."""
+
+
 class FrameTooLarge(CodecError):
     """Frame over the configured ``max_frame_bytes`` bound."""
 
@@ -218,6 +226,12 @@ def request_to_wire(req) -> Dict[str, Any]:
                          if req.eos_token_id is not None else None),
         "request_class": req.request_class,
         "shed_rank": int(req.shed_rank),
+        # tenancy labels (docs/SERVING.md "Multi-model & multi-tenant
+        # serving"): extra dict fields are backward-compatible — an
+        # older peer ignores them, an older sender's frame decodes with
+        # the "default" fallbacks below — so CODEC_VERSION stays put
+        "tenant": req.tenant,
+        "model_id": req.model_id,
         "generated_tokens": [int(t) for t in req.generated_tokens],
         "attempts": int(req.attempts),
         "no_prefill": bool(req.no_prefill),
@@ -235,7 +249,9 @@ def request_from_wire(d: Dict[str, Any]):
         int(d["priority"]), d.get("deadline_remaining_s"),
         d.get("eos_token_id"),
         request_class=d.get("request_class", "interactive"),
-        shed_rank=int(d.get("shed_rank", 0)))
+        shed_rank=int(d.get("shed_rank", 0)),
+        tenant=d.get("tenant", "default"),
+        model_id=d.get("model_id", "default"))
     req.uid = int(d["uid"])
     for t in d.get("generated_tokens", ()):
         # replay through push_token so n_generated / first_token_t stay

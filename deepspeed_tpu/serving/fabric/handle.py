@@ -31,7 +31,7 @@ from ..replica import Replica
 #: Replica and RemoteHandle must provide every name.
 HANDLE_SURFACE = (
     # identity / shape
-    "replica_id", "role", "state", "engine", "thread",
+    "replica_id", "role", "model_id", "state", "engine", "thread",
     # router selection
     "accepting", "has_capacity", "active_count",
     "outstanding_tokens", "outstanding_prefill_tokens",

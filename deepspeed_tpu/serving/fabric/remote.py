@@ -38,8 +38,8 @@ from ...utils.logging import logger
 from ...utils.restart import RestartPolicy
 from ..replica import ReplicaState
 from ..request import FinishReason, RequestState, ServingRequest
-from .codec import CODEC_VERSION, FrameTooLarge, payload_chunks, \
-    payload_from_chunks, request_to_wire
+from .codec import CODEC_VERSION, FrameTooLarge, ModelMismatch, \
+    payload_chunks, payload_from_chunks, request_to_wire
 from .transport import ConnectionLost, FabricError, dial
 
 class _ModelCfgFacade:
@@ -147,13 +147,18 @@ class RemoteHandle:
                  role: str = "mixed", metrics=None, tracer=None,
                  recorder=None, journal=None,
                  on_failover: Optional[Callable] = None,
-                 on_handoff: Optional[Callable] = None):
+                 on_handoff: Optional[Callable] = None,
+                 model_id: str = "default"):
         from ...telemetry import NOOP_TRACER
 
         self.replica_id = replica_id
         self.address = address
         self.fabric = fabric_config
         self.role = role
+        # multi-model serving (docs/SERVING.md "Multi-model &
+        # multi-tenant serving"): the model pool this peer is adopted
+        # into; the hello exchange verifies the server really hosts it
+        self.model_id = str(model_id)
         self.metrics = metrics
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.recorder = recorder
@@ -211,8 +216,22 @@ class RemoteHandle:
                     "codec_version": CODEC_VERSION,
                     "replica_id": self.replica_id,
                     "role": self.role,
+                    "model_id": self.model_id,
                     "max_frame_bytes": int(self.fabric.max_frame_bytes),
                     "reset": bool(reset)})
+                # model identity check (docs/SERVING.md "Multi-model &
+                # multi-tenant serving"): adopting a peer that hosts a
+                # different model would silently misroute every request
+                # of this pool — refuse typed, like a codec mismatch.
+                # Older servers don't report one; trust the spec then.
+                srv_model = info.get("model_id")
+                if srv_model is not None and srv_model != self.model_id:
+                    self._conn.close("model mismatch")
+                    self._conn = None
+                    raise ModelMismatch(
+                        f"fabric replica {self.replica_id}: peer at "
+                        f"{self.address} hosts model {srv_model!r}, "
+                        f"expected {self.model_id!r}")
                 # frame-bound negotiation: never SEND more than the peer
                 # can receive — an oversized payload must die at encode
                 # (typed, degrades to re-prefill), not kill the peer's
@@ -493,6 +512,9 @@ class RemoteHandle:
                 self.metrics.histogram("ttft_s").observe(dt)
                 self.metrics.histogram(
                     f"ttft_s_class_{req.request_class}").observe(dt)
+                if req.tenant != "default":
+                    self.metrics.histogram(
+                        f"ttft_s_tenant_{req.tenant}").observe(dt)
                 if getattr(req, "_fabric_staged", False) \
                         and req.handoff_t is not None:
                     # staging -> first decoded token: the import ran
@@ -505,6 +527,9 @@ class RemoteHandle:
                 self.metrics.histogram("tpot_s").observe(dt)
                 self.metrics.histogram(
                     f"tpot_s_class_{req.request_class}").observe(dt)
+                if req.tenant != "default":
+                    self.metrics.histogram(
+                        f"tpot_s_tenant_{req.tenant}").observe(dt)
 
     def _detach(self, uid: int) -> Optional[ServingRequest]:
         """Pop a mirrored request and settle its load accounting; None

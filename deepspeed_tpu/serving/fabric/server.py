@@ -62,11 +62,13 @@ class ReplicaServer:
 
     def __init__(self, engine_factory, config=None,
                  listen: str = "127.0.0.1:0", replica_id: int = 0,
-                 heartbeat_s: float = 1.0, max_frame_bytes: int = 0):
+                 heartbeat_s: float = 1.0, max_frame_bytes: int = 0,
+                 model_id: str = "default"):
         from ..config import ServingConfig
 
         self.engine_factory = engine_factory
         self.config = config or ServingConfig()
+        self.model_id = str(model_id)
         fab = getattr(self.config, "fabric", None)
         self.heartbeat_s = float(heartbeat_s)
         self.max_frame_bytes = int(max_frame_bytes
@@ -298,6 +300,7 @@ class ReplicaServer:
         eng = self._engine
         return {"replica_id": self.replica_id, "role": self._role,
                 "codec_version": CODEC_VERSION, "pid": os.getpid(),
+                "model_id": self.model_id,
                 "max_frame_bytes": int(self.max_frame_bytes),
                 "max_seq_len": int(eng.model.cfg.max_seq_len),
                 "max_seats": int(eng.config.max_ragged_sequence_count),

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..telemetry import FlightRecorder  # noqa: F401  (re-export surface)
 from ..telemetry.journal import OpsJournal
@@ -97,22 +97,51 @@ def apply_engine_serving_config(engine, config: ServingConfig) -> None:
                           adm.preemption.max_preemptions_per_seq))
 
 
+def engine_from_model_spec(spec):
+    """Build one InferenceEngineV2 from a
+    :class:`~deepspeed_tpu.serving.config.ModelSpec` — the same
+    ``{model, engine, seed, checkpoint}`` shape
+    ``scripts/serve_replica.py`` serves from, so one dict describes a
+    model pool whether its replicas run in-process or behind the fabric
+    (seeded init / checkpoint loading yields identical weights on both
+    sides, which is what makes cross-process per-model parity
+    testable)."""
+    import jax
+
+    from ..inference.v2.engine_v2 import (InferenceEngineV2,
+                                          RaggedInferenceEngineConfig)
+    from ..models.transformer import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(**dict(spec.model)))
+    if spec.checkpoint:
+        from ..runtime.checkpointing import load_params_for_model
+
+        params = load_params_for_model(model, spec.checkpoint)
+    else:
+        params = model.init(jax.random.PRNGKey(int(spec.seed)))
+    return InferenceEngineV2(
+        model, params=params,
+        config=RaggedInferenceEngineConfig(**dict(spec.engine)))
+
+
 class ServingFrontend:
     # lock discipline (docs/CONCURRENCY.md): membership admin state is
-    # written under the fleet lock. ``_closed`` and ``_role_overrides``
-    # are writes-only guarded — their readers (submit's fast-path check,
-    # the supervisor's restart-time role lookup) take lock-free
-    # last-write-wins snapshots by design.
+    # written under the fleet lock. ``_closed``, ``_role_overrides``
+    # and ``_replica_models`` are writes-only guarded — their readers
+    # (submit's fast-path check, the supervisor's restart-time role /
+    # model lookup) take lock-free last-write-wins snapshots by design.
     _GUARDED_BY = {
         "_closed": "_fleet_lock:writes",
         "_next_replica_id": "_fleet_lock",
         "_role_overrides": "_fleet_lock:writes",
+        "_replica_models": "_fleet_lock:writes",
     }
 
     def __init__(self, engines: Sequence, config: Optional[ServingConfig] = None,
                  sample_fn: Optional[Callable] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 engine_factory: Optional[Callable[[int], object]] = None):
+                 engine_factory: Optional[Callable[[int], object]] = None,
+                 model_engine_factories: Optional[Dict[str, Callable]] = None):
         """``engines``: one InferenceEngineV2 per replica (the caller owns
         model/param placement; replicas never share an engine — each owns
         its KV pool and scheduler). ``engine_factory(replica_id)``, when
@@ -128,10 +157,19 @@ class ServingFrontend:
         fab = self.config.fabric
         self._fabric = fab if fab.enabled else None
         peer_addrs = list(fab.peers) if self._fabric is not None else []
-        if not engines and not peer_addrs:
+        # multi-model registry (docs/SERVING.md "Multi-model &
+        # multi-tenant serving"): named ModelSpecs add heterogeneous
+        # replica pools — local engines built from each spec (or a
+        # caller-supplied ``model_engine_factories[name]``, which wins)
+        # plus fabric peers hosting that model. Empty = the historical
+        # single-pool stack, every replica model_id "default".
+        self._models = dict(self.config.models)
+        self._default_model = self.config.resolve_default_model()
+        model_peer_count = sum(len(s.peers) for s in self._models.values())
+        if not engines and not peer_addrs and not self._models:
             raise ValueError("ServingFrontend needs at least one engine "
-                             "(or fabric.peers)")
-        if peer_addrs and sample_fn is not None:
+                             "(or fabric.peers, or a models: registry)")
+        if (peer_addrs or model_peer_count) and sample_fn is not None:
             # a frontend-level callable cannot cross the wire: remote
             # replicas would silently fall back to greedy sampling while
             # local ones use the custom sampler — same request,
@@ -142,10 +180,12 @@ class ServingFrontend:
                 "(configure sampling in the replica servers' specs "
                 "instead)")
         # the registry pre-declares every per-class series for the
-        # CONFIGURED classes, so custom classes expose zero-valued
-        # Prometheus series before first traffic too
+        # CONFIGURED classes — and every per-tenant series for the
+        # configured tenants — so custom classes and tenants expose
+        # zero-valued Prometheus series before first traffic too
         self.metrics = metrics or serving_metrics(
-            sorted(self.config.classes))
+            sorted(self.config.classes),
+            tenants=sorted(self.config.tenants))
         # telemetry (docs/OBSERVABILITY.md): one tracer for the whole
         # frontend — request stage spans begin here at submit, the
         # router/replicas/scheduler continue the chain — plus a flight
@@ -183,12 +223,23 @@ class ServingFrontend:
         if self.config.ttft_buckets_s:
             self.metrics.histogram("ttft_s", self.config.ttft_buckets_s,
                                    reset=True)
+        # multi-tenant fair share / quotas (docs/SERVING.md "Multi-model
+        # & multi-tenant serving"): one ledger per frontend, consulted
+        # by the queue's DWF pop and the router's KV-budget filter. None
+        # when no ``tenants:`` block — every path byte-identical.
+        self._tenancy = None
+        if self.config.tenants:
+            from .tenancy import TenantLedger
+
+            self._tenancy = TenantLedger(self.config.tenants,
+                                         metrics=self.metrics,
+                                         journal=self.journal)
         ft = self.config.fault_tolerance
         self.admission = AdmissionQueue(
             self.config.max_queue_depth, self.metrics,
             brownout_threshold=(ft.brownout_threshold if ft.enabled
                                 else 0.0),
-            journal=self.journal)
+            journal=self.journal, tenancy=self._tenancy)
         # elastic autoscaling (docs/SERVING.md "Elastic autoscaling"):
         # dynamic membership state. Replica ids are allocated
         # monotonically and never reused; role overrides (set by
@@ -197,9 +248,43 @@ class ServingFrontend:
         # membership mutations (the controller issues one at a time,
         # but the API must be safe for direct callers too).
         self._engine_factory = engine_factory
+        # replica-id layout: caller engines, global fabric peers, then
+        # each named model pool (locals before peers) in sorted-name
+        # order — ids stay monotonic and are never reused either way
         self._peer_addrs = {len(engines) + i: addr
                             for i, addr in enumerate(peer_addrs)}
-        self._next_replica_id = len(engines) + len(peer_addrs)
+        # rid -> model_id for every slot outside the unnamed-default
+        # pool (absent = "default"); with a models: registry the
+        # caller's plain engines serve the default model's pool
+        self._replica_models: Dict[int, str] = {}
+        if self._models:
+            for rid in range(len(engines) + len(peer_addrs)):
+                self._replica_models[rid] = self._default_model
+        next_rid = len(engines) + len(peer_addrs)
+        self._model_factories: Dict[str, Callable] = {}
+        model_locals = []                       # (rid, model name)
+        for name in sorted(self._models):
+            spec = self._models[name]
+            fac = (model_engine_factories or {}).get(name)
+            if fac is None:
+                if not spec.model:
+                    raise ValueError(
+                        f"models.{name} has no model kwargs and no "
+                        f"model_engine_factories[{name!r}] entry — "
+                        f"nothing to build its pool from")
+
+                def fac(spec=spec):
+                    return engine_from_model_spec(spec)
+            self._model_factories[name] = fac
+            for _ in range(spec.replicas):
+                model_locals.append((next_rid, name))
+                self._replica_models[next_rid] = name
+                next_rid += 1
+            for addr in spec.peers:
+                self._peer_addrs[next_rid] = addr
+                self._replica_models[next_rid] = name
+                next_rid += 1
+        self._next_replica_id = next_rid
         self._role_overrides: dict = {}
         self._fleet_lock = RankedLock("serving.frontend.fleet")
         # evacuated KV rides the same bounded host-RAM staging budget
@@ -226,7 +311,7 @@ class ServingFrontend:
         self._disagg = dis if dis.enabled else None
         self._stager = None
         if self._disagg is not None:
-            self._validate_disaggregation(len(engines) + len(peer_addrs))
+            self._validate_disaggregation(self._next_replica_id)
             if dis.handoff.enabled:
                 from .handoff import HandoffStager
 
@@ -234,6 +319,8 @@ class ServingFrontend:
                                              self.metrics)
         replicas = [self._build_replica(i, eng)
                     for i, eng in enumerate(engines)]
+        replicas += [self._build_replica(rid, self._model_factories[name]())
+                     for rid, name in model_locals]
         replicas += [self._build_remote(rid, addr)
                      for rid, addr in sorted(self._peer_addrs.items())]
         # ~1/s observability tick on the router loop: windowed-metrics
@@ -243,7 +330,8 @@ class ServingFrontend:
                                     tracer=self.tracer,
                                     recorder=self.recorder,
                                     disaggregation=self._disagg,
-                                    tick_hooks=tick_hooks)
+                                    tick_hooks=tick_hooks,
+                                    tenancy=self._tenancy)
         self.supervisor = None
         if ft.enabled:
             from .supervisor import ReplicaSupervisor
@@ -254,7 +342,8 @@ class ServingFrontend:
             # factory
             self.supervisor = ReplicaSupervisor(
                 self.router, self._build_replica,
-                (self._engine_source if self._peer_addrs
+                (self._engine_source
+                 if (self._peer_addrs or self._model_factories)
                  else engine_factory),
                 config=ft, metrics=self.metrics, tracer=self.tracer,
                 recorder=self.recorder, journal=self.journal)
@@ -268,12 +357,13 @@ class ServingFrontend:
         self.autoscaler = None
         asc = self.config.autoscaler
         if asc.enabled:
-            if engine_factory is None:
+            if engine_factory is None and not self._model_factories:
                 raise ValueError(
                     "autoscaler.enabled requires an engine_factory — a "
                     "fleet with no way to build engines cannot grow "
-                    "(use ServingFrontend.from_engine_factory, or pass "
-                    "engine_factory=)")
+                    "(use ServingFrontend.from_engine_factory, pass "
+                    "engine_factory=, or configure a models: registry "
+                    "whose specs are buildable)")
             from .autoscaler import FleetController
 
             self.autoscaler = FleetController(
@@ -317,16 +407,23 @@ class ServingFrontend:
         return self._disagg.role_of(replica_id)
 
     def _engine_source(self, replica_id: int):
-        """Supervisor-facing engine factory when fabric peers exist:
-        peer slots resolve to :class:`_PeerRef` sentinels (the restart
-        builds a fresh RemoteHandle against the same server), local
-        slots to the caller's factory — or ``None`` when there is no
-        factory, which tells the supervisor to take its historical
-        salvage-engine path (a mixed fleet without a factory must keep
-        the same local-restart behavior it had before fabric)."""
+        """Supervisor-facing engine factory when fabric peers or model
+        pools exist: peer slots resolve to :class:`_PeerRef` sentinels
+        (the restart builds a fresh RemoteHandle against the same
+        server), named-model slots to that model's spec factory (a
+        restarted pool member must host ITS model, not the default
+        one), local default slots to the caller's factory — or ``None``
+        when there is no factory, which tells the supervisor to take
+        its historical salvage-engine path (a mixed fleet without a
+        factory must keep the same local-restart behavior it had before
+        fabric)."""
         addr = self._peer_addrs.get(replica_id)
         if addr is not None:
             return _PeerRef(addr)
+        fac = self._model_factories.get(
+            self._replica_models.get(replica_id, "default"))
+        if fac is not None:
+            return fac()
         if self._engine_factory is None:
             return None
         return self._engine_factory(replica_id)
@@ -348,6 +445,7 @@ class ServingFrontend:
             role=self._role_of(replica_id), metrics=self.metrics,
             tracer=self.tracer, recorder=self._replica_recorder,
             journal=self.journal,
+            model_id=self._replica_models.get(replica_id, "default"),
             on_failover=self._failover if ft.enabled else None,
             on_handoff=self._handoff_remote)
         handle.connect(reset=reset)
@@ -385,6 +483,8 @@ class ServingFrontend:
                        faults=self.injector,
                        on_failover=self._failover if ft.enabled else None,
                        role=role,
+                       model_id=self._replica_models.get(replica_id,
+                                                         "default"),
                        decode_reserve_tokens=(
                            self._disagg.decode_reserve_tokens
                            if self._disagg is not None else 0),
@@ -413,7 +513,9 @@ class ServingFrontend:
                priority: Optional[int] = None,
                deadline_ms: Optional[float] = None,
                eos_token_id: Optional[int] = None,
-               request_class: Optional[str] = None) -> RequestHandle:
+               request_class: Optional[str] = None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Admit a request. Raises :class:`Rejected` when shed (full queue,
         draining frontend, or a prompt no replica could ever schedule).
         ``priority``/``deadline_ms``/``max_new_tokens`` default from the
@@ -422,7 +524,13 @@ class ServingFrontend:
         its policy fills priority/deadline when the caller passes
         neither, labels the per-class TTFT/TPOT/queue metrics, and
         orders brownout shedding (docs/SERVING.md "Disaggregated
-        serving")."""
+        serving"). ``model`` selects an entry of ``config.models``
+        (default ``config.resolve_default_model()``) — the request only
+        routes to replicas of that pool; ``tenant`` selects an entry of
+        ``config.tenants`` (default ``"default"``) for fair-share /
+        quota accounting (docs/SERVING.md "Multi-model & multi-tenant
+        serving"). Both default so every pre-tenancy call site behaves
+        byte-identically."""
         cfg = self.config
         cls = request_class if request_class is not None else cfg.default_class
         policy = cfg.classes.get(cls)
@@ -431,14 +539,40 @@ class ServingFrontend:
             # so the submitted/admitted/shed balance stays honest
             raise ValueError(f"unknown request class {cls!r} "
                              f"(configured: {sorted(cfg.classes)})")
+        # unknown model / tenant are caller bugs too, refused before any
+        # counter moves for the same reason
+        model_id = model if model is not None else self._default_model
+        known_models = set(self._models) if self._models else {"default"}
+        if model_id not in known_models:
+            raise ValueError(f"unknown model {model_id!r} "
+                             f"(configured: {sorted(known_models)})")
+        tenant_id = tenant if tenant is not None else "default"
+        if self._tenancy is not None and not self._tenancy.known(tenant_id):
+            raise ValueError(f"unknown tenant {tenant_id!r} "
+                             f"(configured: {self._tenancy.tenant_names})")
+        if self._tenancy is None:
+            # no tenants: config, no tenant namespace — a named tenant
+            # is accepted (so call sites are portable across deployments
+            # with tenancy on and off) but normalized to "default", or
+            # replicas would mint per-tenant latency series the registry
+            # never declared and the tenancy-off metrics snapshot would
+            # stop being byte-identical to the historical one
+            tenant_id = "default"
         self.metrics.counter("requests_submitted").inc()
         # per-class submit counter: the denominator of the SLO engine's
         # windowed availability burn rate (docs/OBSERVABILITY.md "SLOs
-        # and burn-rate alerts")
+        # and burn-rate alerts"); the per-tenant twin is the denominator
+        # of the per-tenant availability rule
         self.metrics.counter(f"requests_submitted_class_{cls}").inc()
+        if self._tenancy is not None:
+            self.metrics.counter(
+                f"requests_submitted_tenant_{tenant_id}").inc()
         if self._closed:
             self.metrics.counter("requests_shed").inc()
             self.metrics.counter(f"requests_shed_class_{cls}").inc()
+            if self._tenancy is not None:
+                self.metrics.counter(
+                    f"requests_shed_tenant_{tenant_id}").inc()
             raise Rejected("draining", "frontend is shut down")
         if priority is None:
             priority = (policy.priority if policy.priority is not None
@@ -453,7 +587,8 @@ class ServingFrontend:
             else cfg.default_max_new_tokens,
             priority, deadline_ms / 1e3 if deadline_ms is not None else None,
             eos_token_id,
-            request_class=cls, shed_rank=policy.shed_rank)
+            request_class=cls, shed_rank=policy.shed_rank,
+            tenant=tenant_id, model_id=model_id)
         if self.tracer.enabled:
             # root of this request's trace + the first stage (queue wait).
             # Rejection paths below close both via req.finish.
@@ -466,11 +601,19 @@ class ServingFrontend:
                        "priority": req.priority,
                        "class": req.request_class})}
             req.begin_span(self.tracer, "queue")
-        max_len = min(r.engine.model.cfg.max_seq_len
-                      for r in self.router.replicas)
+        # length bound over the request's OWN pool: heterogeneous pools
+        # may have different max_seq_len, and a request must not be shed
+        # for exceeding a bound only some other model's replicas have
+        pool_lens = [r.engine.model.cfg.max_seq_len
+                     for r in self.router.replicas
+                     if getattr(r, "model_id", "default") == req.model_id]
+        max_len = min(pool_lens) if pool_lens else 0
         if len(req.prompt_tokens) + req.max_new_tokens > max_len:
             self.metrics.counter("requests_shed").inc()
             self.metrics.counter(f"requests_shed_class_{cls}").inc()
+            if self._tenancy is not None:
+                self.metrics.counter(
+                    f"requests_shed_tenant_{tenant_id}").inc()
             req.finish(RequestState.REJECTED, "too_long")
             raise Rejected("too_long",
                            f"{len(req.prompt_tokens)}+{req.max_new_tokens} "
@@ -634,14 +777,23 @@ class ServingFrontend:
         return True
 
     # ------------------------------------------------- dynamic membership
-    def add_replica(self, role: str = "mixed") -> int:
+    def add_replica(self, role: str = "mixed",
+                    model_id: Optional[str] = None) -> int:
         """Grow the fleet by one replica built from the stored
-        ``engine_factory`` (docs/SERVING.md "Elastic autoscaling").
-        Returns the new replica id (monotonic, never reused).
-        Specialized roles require a role-split fleet: "prefill"
-        additionally requires the handoff path (a prefill-only replica
-        with nowhere to send its KV could never finish a request)."""
-        if self._engine_factory is None:
+        ``engine_factory`` — or, with ``model_id``, from that model
+        pool's spec factory, so a grown pool member hosts the right
+        model (docs/SERVING.md "Elastic autoscaling" / "Multi-model &
+        multi-tenant serving"). Returns the new replica id (monotonic,
+        never reused). Specialized roles require a role-split fleet:
+        "prefill" additionally requires the handoff path (a prefill-only
+        replica with nowhere to send its KV could never finish a
+        request)."""
+        fac = (self._model_factories.get(model_id)
+               if model_id is not None else None)
+        if model_id is not None and fac is None:
+            raise ValueError(f"unknown model {model_id!r} (configured: "
+                             f"{sorted(self._model_factories)})")
+        if self._engine_factory is None and fac is None:
             raise RuntimeError("add_replica requires an engine_factory")
         if role not in ("prefill", "decode", "mixed"):
             raise ValueError(f"unknown replica role {role!r} "
@@ -659,12 +811,16 @@ class ServingFrontend:
             rid = self._next_replica_id
             self._next_replica_id += 1
             self._role_overrides[rid] = role
+            if model_id is not None:
+                self._replica_models[rid] = model_id
             try:
-                engine = self._engine_factory(rid)
+                engine = (fac() if fac is not None
+                          else self._engine_factory(rid))
                 replica = self._build_replica(rid, engine)
                 self.router.add_replica(replica)
             except Exception:
                 self._role_overrides.pop(rid, None)
+                self._replica_models.pop(rid, None)
                 raise
             if self.supervisor is not None:
                 self.supervisor.register_slot(rid)
@@ -692,6 +848,12 @@ class ServingFrontend:
             others = [r for r in self.router.replicas if r is not target]
             if not others:
                 raise ValueError("cannot remove the last replica")
+            if self._models:
+                mid = getattr(target, "model_id", "default")
+                if not any(getattr(r, "model_id", "default") == mid
+                           for r in others):
+                    raise ValueError("cannot remove the last replica of "
+                                     f"model {mid!r}")
             if target.accepting:
                 if not any(r.accepting for r in others):
                     raise ValueError("cannot remove the last accepting "
@@ -715,6 +877,7 @@ class ServingFrontend:
             if removed is not target:
                 target.stop(timeout=1.0)
             self._role_overrides.pop(replica_id, None)
+            self._replica_models.pop(replica_id, None)
         return True
 
     def set_replica_role(self, replica_id: int, role: str,
@@ -871,13 +1034,25 @@ class ServingFrontend:
                         r.accepting, r.replica_id in parked,
                         r.outstanding_prefill_tokens,
                         r.outstanding_decode_tokens,
-                        remote=bool(getattr(r, "is_remote", False)))
+                        remote=bool(getattr(r, "is_remote", False)),
+                        model_id=getattr(r, "model_id", "default"))
             for r in self.router.replicas)
         burn = 0.0
         if self.alerts is not None:
             for s in self.alerts.status().values():
                 burn = max(burn, s["burn_slow"])
         dis = self._disagg
+        # per-model pool bounds, a ModelSpec's None ends resolved
+        # against the global autoscaler min/max (docs/SERVING.md
+        # "Multi-model & multi-tenant serving")
+        asc = self.config.autoscaler
+        bounds = tuple(
+            (name,
+             spec.min_replicas if spec.min_replicas is not None
+             else asc.min_replicas,
+             spec.max_replicas if spec.max_replicas is not None
+             else asc.max_replicas)
+            for name, spec in sorted(self._models.items()))
         return FleetSignals(
             queue_depth=len(self.admission), replicas=infos,
             burn_slow_max=burn,
@@ -885,7 +1060,8 @@ class ServingFrontend:
                                 if dis is not None else 1.0),
             decode_token_cost=(dis.decode_token_cost
                                if dis is not None else 1.0),
-            disaggregated=dis is not None)
+            disaggregated=dis is not None,
+            model_bounds=bounds)
 
     def set_proactive_brownout(self, fraction: Optional[float]) -> None:
         """Autoscaler brownout actuator: degrade (or restore, with
@@ -1111,15 +1287,19 @@ class ServingFrontend:
         self.windowed.tick()
         snap = self.metrics.snapshot()
         classes = sorted(self.config.classes)
+        tenants = sorted(self.config.tenants)
         hist_names = (["ttft_s", "tpot_s", "queue_wait_s",
                        "kv_tier_restore_s", "preempt_spill_s",
                        "preempt_resume_s"]
                       + [f"ttft_s_class_{c}" for c in classes]
-                      + [f"tpot_s_class_{c}" for c in classes])
+                      + [f"tpot_s_class_{c}" for c in classes]
+                      + [f"ttft_s_tenant_{t}" for t in tenants]
+                      + [f"tpot_s_tenant_{t}" for t in tenants])
         report = {
             "wall_time": time.time(),
             "replicas": [{"id": r.replica_id, "state": r.state.value,
                           "role": getattr(r, "role", "mixed"),
+                          "model": getattr(r, "model_id", "default"),
                           "outstanding_tokens": r.outstanding_tokens}
                          for r in self.router.replicas],
             "replicas_healthy": snap.get("replicas_healthy", 0.0),
@@ -1150,6 +1330,10 @@ class ServingFrontend:
                 "sequences_preempted", "sequences_resumed")},
             "window_s": window_s,
             "window": self.windowed.summary(hist_names, window_s),
+            # per-tenant fair-share/quota books (docs/SERVING.md
+            # "Multi-model & multi-tenant serving"); None = tenancy off
+            "tenants": (self._tenancy.snapshot()
+                        if self._tenancy is not None else None),
             "slo": (self.alerts.status() if self.alerts is not None
                     else None),
             "alerts_firing": (self.alerts.firing()
@@ -1194,6 +1378,14 @@ class ServingFrontend:
             f"shed={c['requests_shed']:.0f} "
             f"failed={c['requests_failed']:.0f} "
             f"failed_over={c['requests_failed_over']:.0f}")
+        if r.get("tenants"):
+            for name, t in sorted(r["tenants"].items()):
+                lines.append(
+                    f"tenant {name}: w={t['weight']:g} "
+                    f"service={t['service']:.1f} "
+                    f"window_tokens={t['window_tokens']:.0f}"
+                    + (f"  THROTTLED({t['throttled']})"
+                       if t["throttled"] else ""))
         for name, w in sorted(r["window"].items()):
             if w.get("count"):
                 lines.append(

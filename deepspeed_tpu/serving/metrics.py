@@ -355,8 +355,8 @@ class MetricsRegistry:
 STOCK_CLASSES = ("interactive", "batch")
 
 
-def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
-                    ) -> MetricsRegistry:
+def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
+                    tenants: Sequence[str] = ()) -> MetricsRegistry:
     """Registry pre-declaring the serving layer's metric names, so
     dashboards and ``bench.py`` see zeros (not absences) before traffic.
     ``classes`` extends the per-class series (``ttft_s_class_<cls>``,
@@ -364,7 +364,10 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
     interactive/batch pair — ``ServingFrontend`` passes the configured
     ``classes:`` map, so ``render_prometheus()`` exposes every class's
     zero-valued series at boot (an absent series is indistinguishable
-    from a broken exporter; a zero one isn't)."""
+    from a broken exporter; a zero one isn't). ``tenants`` does the same
+    for the per-tenant series (docs/SERVING.md "Multi-model &
+    multi-tenant serving"); the default empty tuple declares none —
+    tenancy-off registries carry zero per-tenant overhead."""
     reg = MetricsRegistry("serving")
     all_classes = list(dict.fromkeys(list(STOCK_CLASSES) + list(classes)))
     for c in ("requests_submitted", "requests_admitted", "requests_shed",
@@ -496,5 +499,14 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES
         reg.gauge(f"queue_depth_class_{cls}")
         reg.histogram(f"ttft_s_class_{cls}", DEFAULT_LATENCY_BUCKETS)
         reg.histogram(f"tpot_s_class_{cls}", DEFAULT_LATENCY_BUCKETS)
+    # per-tenant series (docs/SERVING.md "Multi-model & multi-tenant
+    # serving"): submit/shed counters, latency splits, and the current
+    # quota-throttle flag — the per-tenant SLO engine's raw material
+    for t in dict.fromkeys(tenants):
+        reg.counter(f"requests_submitted_tenant_{t}")
+        reg.counter(f"requests_shed_tenant_{t}")
+        reg.gauge(f"tenant_over_quota_{t}")
+        reg.histogram(f"ttft_s_tenant_{t}", DEFAULT_LATENCY_BUCKETS)
+        reg.histogram(f"tpot_s_tenant_{t}", DEFAULT_LATENCY_BUCKETS)
     reg.histogram("queue_depth_hist", DEFAULT_DEPTH_BUCKETS)
     return reg

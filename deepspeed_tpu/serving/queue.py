@@ -48,9 +48,16 @@ class AdmissionQueue:
     }
 
     def __init__(self, max_depth: int, metrics: Optional[MetricsRegistry] = None,
-                 brownout_threshold: float = 0.0, journal=None):
+                 brownout_threshold: float = 0.0, journal=None,
+                 tenancy=None):
         self.max_depth = int(max_depth)
         self.metrics = metrics
+        # tenancy ledger (serving/tenancy.py, docs/SERVING.md
+        # "Multi-model & multi-tenant serving"): set-once reference,
+        # internally locked at rank 65 — acquirable while holding this
+        # queue's condition (rank 60). None = tenancy off: every pop is
+        # the historical class-ordered heap byte for byte.
+        self._tenancy = tenancy
         # ops journal (telemetry/journal.py): brownout enter/exit
         # transitions are fleet-lifecycle events worth a durable record,
         # not just a gauge flip
@@ -111,6 +118,12 @@ class AdmissionQueue:
         self.metrics.counter("requests_shed").inc()
         self.metrics.counter(
             f"requests_shed_class_{req.request_class}").inc()
+        if self._tenancy is not None:
+            # per-tenant shed series exist only for configured tenants
+            # (pre-declared by serving_metrics) — tenancy off keeps the
+            # snapshot byte-identical to the historical registry
+            self.metrics.counter(
+                f"requests_shed_tenant_{req.tenant}").inc()
         if reason == FinishReason.BROWNOUT:
             self.metrics.counter("requests_shed_brownout").inc()
         elif reason == "overloaded" and self._preempt_pressure:
@@ -272,23 +285,34 @@ class AdmissionQueue:
             self._count_shed(req, FinishReason.BROWNOUT)
             req.finish(RequestState.REJECTED, FinishReason.BROWNOUT)
 
+    def _victim_key(self, r: ServingRequest) -> tuple:
+        """Brownout/preemption victim order. With tenancy enabled the
+        leading component is whether the request's tenant is over quota
+        (over-quota tenants shed FIRST — docs/SERVING.md "Multi-model &
+        multi-tenant serving"); the rest is the historical ``shed_key``
+        (class shed rank, then lowest urgency). Tenancy off prepends a
+        constant 0, so the ordering is byte-identical."""
+        over = (self._tenancy.victim_rank(r)
+                if self._tenancy is not None else 0)
+        return (over,) + tuple(r.shed_key)
+
     def _worst_sheddable_index(self) -> Optional[int]:
-        """Index of the entry brownout sheds first: max ``shed_key`` —
-        highest class shed rank first (batch before interactive,
-        regardless of priority — docs/SERVING.md "Disaggregated
-        serving"), then lowest urgency within the class (max order_key:
-        lowest priority, then longest/absent deadline). Failover-requeued
-        requests (attempts > 1) are never victims — they already
-        streamed on a replica that died, and conserving admitted work is
-        the failover contract — and neither are staged KV-handoff
-        requests (their prefill work is done and paid for). Caller holds
-        the lock."""
+        """Index of the entry brownout sheds first: max victim key —
+        over-quota tenants first (tenancy only), then highest class shed
+        rank (batch before interactive, regardless of priority —
+        docs/SERVING.md "Disaggregated serving"), then lowest urgency
+        within the class (max order_key: lowest priority, then
+        longest/absent deadline). Failover-requeued requests
+        (attempts > 1) are never victims — they already streamed on a
+        replica that died, and conserving admitted work is the failover
+        contract — and neither are staged KV-handoff requests (their
+        prefill work is done and paid for). Caller holds the lock."""
         best = None
         best_key = None
         for j, (_, r) in enumerate(self._heap):
             if r.attempts > 1 or r.staged_kv is not None:
                 continue
-            key = r.shed_key
+            key = self._victim_key(r)
             if best is None or key > best_key:
                 best, best_key = j, key
         return best
@@ -310,7 +334,7 @@ class AdmissionQueue:
             # over-depth purely with retried work: admit rather than
             # touch it (requeue is depth-exempt for the same reason)
             return True
-        if req.shed_key >= self._heap[worst_i][1].shed_key:
+        if self._victim_key(req) >= self._victim_key(self._heap[worst_i][1]):
             return False
         victim = self._pop_index_locked(worst_i)
         self._count_shed(victim, FinishReason.BROWNOUT)
@@ -367,6 +391,8 @@ class AdmissionQueue:
         "Disaggregated serving"), which keeps a request no replica can
         currently run from head-of-line-blocking work that idle replicas
         of the other role could take. Caller holds the lock."""
+        if self._tenancy is not None:
+            return self._pop_fair_locked(accept)
         if accept is None:
             if not self._heap:
                 return None
@@ -381,6 +407,32 @@ class AdmissionQueue:
             return self._pop_index_locked(best)
         self._dec_class(req)
         return req
+
+    def _pop_fair_locked(self, accept) -> Optional[ServingRequest]:
+        """Deficit-weighted-fair pop (docs/SERVING.md "Multi-model &
+        multi-tenant serving"): among tenants with acceptable queued
+        work, drain the one with the best ledger key — in-quota tenants
+        before over-quota ones (work-conserving: an over-quota tenant
+        still drains when nobody else has work), then least
+        weight-normalized virtual service, then the tenant's own best
+        (priority, deadline, FIFO) entry as the tie-break. Within the
+        chosen tenant, the class machinery orders exactly as before.
+        O(n) over the bounded heap, like the accept path. Caller holds
+        the lock; the ledger's rank-65 lock nests inside."""
+        best_per_tenant: dict = {}       # tenant -> (order_key, index)
+        for j, (key, r) in enumerate(self._heap):
+            if accept is not None and not accept(r):
+                continue
+            cur = best_per_tenant.get(r.tenant)
+            if cur is None or key < cur[0]:
+                best_per_tenant[r.tenant] = (key, j)
+        if not best_per_tenant:
+            return None
+        tenant = min(
+            best_per_tenant,
+            key=lambda t: (self._tenancy.drain_key(t)
+                           + tuple(best_per_tenant[t][0])))
+        return self._pop_index_locked(best_per_tenant[tenant][1])
 
     def pop(self, timeout: Optional[float] = None,
             accept=None) -> Optional[ServingRequest]:

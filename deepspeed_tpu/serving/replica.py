@@ -68,10 +68,16 @@ class Replica:
                  speculative=None, tracer=None, recorder=None,
                  faults=None, on_failover: Optional[Callable] = None,
                  role: str = "mixed", decode_reserve_tokens: int = 0,
-                 on_handoff: Optional[Callable] = None, journal=None):
+                 on_handoff: Optional[Callable] = None, journal=None,
+                 model_id: str = "default"):
         from ..telemetry import NOOP_TRACER
 
         self.replica_id = replica_id
+        # multi-model serving (docs/SERVING.md "Multi-model &
+        # multi-tenant serving"): which model pool this replica belongs
+        # to — the router only routes a request onto replicas of its
+        # model. "default" is the historical single-model fleet.
+        self.model_id = str(model_id)
         # ops journal (telemetry/journal.py): import-side handoff
         # fallbacks are fleet-lifecycle events (the export side journals
         # in the frontend)
@@ -493,11 +499,17 @@ class Replica:
                 self.metrics.histogram("ttft_s").observe(dt)
                 self.metrics.histogram(
                     f"ttft_s_class_{req.request_class}").observe(dt)
+                if req.tenant != "default":
+                    self.metrics.histogram(
+                        f"ttft_s_tenant_{req.tenant}").observe(dt)
             else:
                 dt = req.last_token_t - prev_t
                 self.metrics.histogram("tpot_s").observe(dt)
                 self.metrics.histogram(
                     f"tpot_s_class_{req.request_class}").observe(dt)
+                if req.tenant != "default":
+                    self.metrics.histogram(
+                        f"tpot_s_tenant_{req.tenant}").observe(dt)
 
     def _on_finish(self, sreq, reason: str) -> None:
         with self._lock:

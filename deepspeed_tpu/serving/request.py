@@ -86,7 +86,8 @@ class ServingRequest:
     def __init__(self, prompt_tokens: List[int], max_new_tokens: int,
                  priority: int, deadline_s: Optional[float],
                  eos_token_id: Optional[int], *,
-                 request_class: str = "interactive", shed_rank: int = 0):
+                 request_class: str = "interactive", shed_rank: int = 0,
+                 tenant: str = "default", model_id: str = "default"):
         with ServingRequest._seq_lock:
             ServingRequest._seq += 1
             self.uid = ServingRequest._seq
@@ -98,6 +99,13 @@ class ServingRequest:
         # (higher shed_rank sheds first — batch before interactive)
         self.request_class = str(request_class)
         self.shed_rank = int(shed_rank)
+        # multi-tenant / multi-model serving (docs/SERVING.md
+        # "Multi-model & multi-tenant serving"): the tenant labels
+        # fair-share accounting and per-tenant metrics; model_id pins
+        # routing to that model's replica pool. Both default to
+        # "default" — single-model, tenancy-off traffic never names them.
+        self.tenant = str(tenant)
+        self.model_id = str(model_id)
         self.eos_token_id = eos_token_id
         self.arrival_t = time.monotonic()
         # absolute monotonic deadline; None = no SLO
@@ -258,6 +266,12 @@ class ServingRequest:
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
+
+    @property
+    def done(self) -> bool:
+        """True once the request reached a terminal state (the tenancy
+        ledger's reconcile predicate for releasing KV charges)."""
+        return self._done.is_set()
 
 
 class RequestHandle:

@@ -58,7 +58,7 @@ class ReplicaRouter:
                  metrics: Optional[MetricsRegistry] = None,
                  poll_interval_s: float = 0.05,
                  tracer=None, recorder=None, disaggregation=None,
-                 tick_hooks=None):
+                 tick_hooks=None, tenancy=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         from ..telemetry import NOOP_TRACER
@@ -69,6 +69,11 @@ class ReplicaRouter:
         # prefill-cost vs decode-cost model. None = the historical
         # unweighted least-outstanding-tokens router, byte for byte.
         self.disaggregation = disaggregation
+        # TenantLedger when tenancy is on (docs/SERVING.md "Multi-model
+        # & multi-tenant serving"): dispatches charge fair-share service
+        # and KV budgets; selection filters replicas where the tenant's
+        # KV budget is exhausted. None = no per-dispatch accounting.
+        self.tenancy = tenancy
         self.replicas = list(replicas)
         # dynamic membership (docs/SERVING.md "Elastic autoscaling"):
         # every structural mutation of ``self.replicas`` — add, remove,
@@ -156,16 +161,31 @@ class ReplicaRouter:
                 + r.outstanding_decode_tokens * dis.decode_token_cost,
                 r.replica_id)
 
+    @staticmethod
+    def _model_of(r) -> str:
+        return getattr(r, "model_id", "default")
+
     def pick(self, req=None) -> Optional[Replica]:
         """Least-loaded over accepting replicas with a free concurrency
-        slot. Role-split pools (docs/SERVING.md "Disaggregated serving")
-        also filter by the request's phase: decode-phase work only lands
-        decode-capable; prefill-phase work prefers prefill-capable and
-        spills to decode-role replicas only when no prefill-capable
-        replica is accepting at all (they run the request end to end —
+        slot. Heterogeneous fleets (docs/SERVING.md "Multi-model &
+        multi-tenant serving") first pin the candidate set to the
+        request's model pool — a request can never land on a replica of
+        a different model — and tenancy filters out replicas where the
+        tenant's KV block budget is exhausted. Role-split pools
+        (docs/SERVING.md "Disaggregated serving") also filter by the
+        request's phase: decode-phase work only lands decode-capable;
+        prefill-phase work prefers prefill-capable and spills to
+        decode-role replicas only when no prefill-capable replica is
+        accepting at all (they run the request end to end —
         availability beats specialization)."""
         candidates = [r for r in self.healthy_replicas()
                       if r.accepting and r.has_capacity]
+        if req is not None:
+            candidates = [r for r in candidates
+                          if self._model_of(r) == req.model_id]
+            if self.tenancy is not None:
+                candidates = [r for r in candidates
+                              if self.tenancy.admits_kv(req, r)]
         if self.disaggregation is not None and req is not None:
             if self._needs_decode_role(req):
                 candidates = [r for r in candidates
@@ -174,6 +194,7 @@ class ReplicaRouter:
                 preferred = [r for r in candidates
                              if r.role in PREFILL_CAPABLE]
                 if preferred or any(r.accepting and r.role in PREFILL_CAPABLE
+                                    and self._model_of(r) == req.model_id
                                     for r in self.replicas):
                     # prefill-capable capacity exists (maybe busy): wait
                     # for it rather than full-running on a decode replica
@@ -186,40 +207,56 @@ class ReplicaRouter:
         return any(r.accepting for r in self.replicas)
 
     def _any_accepting_for(self, req) -> bool:
-        """Phase-aware liveness: decode-phase work is only dispatchable
-        to decode-capable replicas — a fleet where just prefill-role
-        slots survive cannot finish it."""
+        """Phase- and model-aware liveness: a request is only
+        dispatchable to accepting replicas of ITS model pool, and
+        decode-phase work only to decode-capable ones — a fleet where
+        just prefill-role slots (or only other models' pools) survive
+        cannot finish it."""
+        pool = [r for r in self.replicas
+                if self._model_of(r) == req.model_id]
         if self.disaggregation is None or not self._needs_decode_role(req):
-            return self._any_accepting()
-        return any(r.accepting and r.role in DECODE_CAPABLE
-                   for r in self.replicas)
+            return any(r.accepting for r in pool)
+        return any(r.accepting and r.role in DECODE_CAPABLE for r in pool)
 
     def _dispatchable_filter(self):
-        """Pop-time predicate for the admission queue (role-split pools
-        only; None otherwise = the historical pop). Snapshot which
-        phases currently have a free slot, so the single dispatcher
-        thread never pops a request it cannot place — a staged decode
-        request at the head of the queue must not head-of-line-block
-        fresh prompts that idle prefill replicas could take (and vice
-        versa). Capacity can shift between snapshot and dispatch;
-        _dispatch's poll loop absorbs that rare race."""
-        if self.disaggregation is None:
+        """Pop-time predicate for the admission queue (None for the
+        historical homogeneous single-role tenancy-off fleet = the
+        historical pop). Snapshot which model pools / phases currently
+        have a free slot, so the single dispatcher thread never pops a
+        request it cannot place — a staged decode request (or a request
+        for a saturated model pool, or a KV-budget-exhausted tenant) at
+        the head of the queue must not head-of-line-block work that
+        other idle replicas could take. Capacity can shift between
+        snapshot and dispatch; _dispatch's poll loop absorbs that rare
+        race."""
+        reps = self.replicas
+        multi_model = len({self._model_of(r) for r in reps}) > 1
+        if self.disaggregation is None and not multi_model \
+                and self.tenancy is None:
             return None
-        free = [r for r in self.replicas
+        free = [r for r in reps
                 if r.accepting and r.has_capacity
                 and r.state == ReplicaState.HEALTHY]
-        can_decode = any(r.role in DECODE_CAPABLE for r in free)
-        prefill_free = any(r.role in PREFILL_CAPABLE for r in free)
-        prefill_accepting = any(r.accepting and r.role in PREFILL_CAPABLE
-                                for r in self.replicas)
-        # fresh work: a free prefill-capable slot, or the spillover case
-        # (no prefill-capable replica accepting at all → decode-role
-        # replicas run the request end to end)
-        can_prefill = prefill_free or (not prefill_accepting and can_decode)
 
         def accept(req):
-            return (can_decode if self._needs_decode_role(req)
-                    else can_prefill)
+            pool = [r for r in free if self._model_of(r) == req.model_id]
+            if self.tenancy is not None:
+                pool = [r for r in pool
+                        if self.tenancy.admits_kv(req, r)]
+            if self.disaggregation is None:
+                return bool(pool)
+            if self._needs_decode_role(req):
+                return any(r.role in DECODE_CAPABLE for r in pool)
+            if any(r.role in PREFILL_CAPABLE for r in pool):
+                return True
+            # spillover: no prefill-capable replica of this model
+            # accepting at all → a free decode-capable one runs the
+            # request end to end
+            prefill_accepting = any(
+                r.accepting and r.role in PREFILL_CAPABLE
+                and self._model_of(r) == req.model_id for r in reps)
+            return (not prefill_accepting
+                    and any(r.role in DECODE_CAPABLE for r in pool))
         return accept
 
     def role_census(self, replicas=None) -> dict:
@@ -325,6 +362,12 @@ class ReplicaRouter:
                 return
             replica = self.pick(req)
             if replica is not None and replica.assign(req):
+                if self.tenancy is not None:
+                    # account the dispatch: fair-share service + the
+                    # token-rate window, and the KV block charge against
+                    # this tenant's budget on the chosen replica
+                    self.tenancy.charge(req)
+                    self.tenancy.charge_kv(req, replica)
                 return
             # healthy fleet but every slot busy (or lost a drain race):
             # capacity frees as sequences finish — wait, don't fail
@@ -353,6 +396,10 @@ class ReplicaRouter:
             req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
 
     def _tick(self) -> None:
+        if self.tenancy is not None:
+            # release KV charges of finished requests + age the
+            # token-rate windows (quota clears even with zero traffic)
+            self.tenancy.reconcile()
         if self.recorder is not None:
             self.recorder.maybe_snapshot()
         for hook in self.tick_hooks:

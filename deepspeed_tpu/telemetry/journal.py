@@ -90,6 +90,12 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     # its replica server (supervisor restart or reconnect)
     "replica_disconnected": frozenset({"replica", "reason"}),
     "replica_reconnected": frozenset({"replica"}),
+    # multi-tenant serving (docs/SERVING.md "Multi-model & multi-tenant
+    # serving"): a tenant crossed into throttled state — its sliding-
+    # window dispatch rate exceeded token_rate, or a KV budget refusal
+    # ("reason": token_rate/kv_budget). Fires on the edge, not per
+    # refused request; the tenant_over_quota_<tenant> gauge tracks state.
+    "tenant_throttled": frozenset({"tenant", "reason"}),
     # ----------------------------------------------------------- training
     # supervised restart (docs/TRAINING.md "Fault tolerance")
     "train_restart": frozenset({"reason", "attempt", "steps_lost",

@@ -73,6 +73,13 @@ class SLOConfig(DSConfigModel):
     enabled: bool = False
     # class name -> targets; classes with no entry are unmonitored
     classes: Dict[str, SLOClassTarget] = Field(default_factory=dict)
+    # tenant name -> targets (docs/SERVING.md "Multi-model &
+    # multi-tenant serving"): same shape, evaluated over the per-tenant
+    # series (``ttft_s_tenant_<t>``, shed/submitted tenant counters) —
+    # a tenant's burn is measured against ITS traffic only, so one
+    # tenant's flood spending another's error budget is impossible by
+    # construction. Tenants with no entry are unmonitored.
+    tenants: Dict[str, SLOClassTarget] = Field(default_factory=dict)
     # burn-rate windows: fire on fast AND slow breach, resolve when the
     # fast window clears. Production-shaped defaults; the CPU bench and
     # the chaos suite shrink them to seconds.
@@ -100,14 +107,18 @@ class SLOConfig(DSConfigModel):
 
 @dataclasses.dataclass
 class AlertRule:
-    """One derived burn-rate rule: (class, kind) -> thresholds."""
+    """One derived burn-rate rule: (class-or-tenant, kind) -> thresholds."""
 
     name: str                   # e.g. "slo_ttft_interactive"
-    request_class: str
+    request_class: str          # class name (or tenant name, scope="tenant")
     kind: str                   # "ttft" | "tpot" | "availability"
     metric: str                 # histogram or counter name observed
     threshold_s: Optional[float]  # latency rules: the target in seconds
     budget: float               # error budget (0.05 for p95 latency)
+    # availability rules: the submitted-counter the shed count is a
+    # fraction OF — per-class and per-tenant rules differ only here
+    denominator: Optional[str] = None
+    scope: str = "class"        # "class" | "tenant"
 
 
 @dataclasses.dataclass
@@ -152,7 +163,31 @@ class AlertEngine:
                 self.rules.append(AlertRule(
                     f"slo_availability_{cls}", cls, "availability",
                     f"requests_shed_class_{cls}", None,
-                    max(1e-9, 1.0 - target.availability)))
+                    max(1e-9, 1.0 - target.availability),
+                    denominator=f"requests_submitted_class_{cls}"))
+        # per-tenant rules (docs/SERVING.md "Multi-model & multi-tenant
+        # serving"): same machinery over the per-tenant series, with the
+        # tenant's own submitted counter as the availability denominator
+        for tenant, target in sorted(config.tenants.items()):
+            if target.ttft_p95_ms is not None:
+                self.rules.append(AlertRule(
+                    f"slo_ttft_tenant_{tenant}", tenant, "ttft",
+                    f"ttft_s_tenant_{tenant}",
+                    target.ttft_p95_ms / 1e3, LATENCY_BUDGET,
+                    scope="tenant"))
+            if target.tpot_p95_ms is not None:
+                self.rules.append(AlertRule(
+                    f"slo_tpot_tenant_{tenant}", tenant, "tpot",
+                    f"tpot_s_tenant_{tenant}",
+                    target.tpot_p95_ms / 1e3, LATENCY_BUDGET,
+                    scope="tenant"))
+            if target.availability is not None:
+                self.rules.append(AlertRule(
+                    f"slo_availability_tenant_{tenant}", tenant,
+                    "availability", f"requests_shed_tenant_{tenant}", None,
+                    max(1e-9, 1.0 - target.availability),
+                    denominator=f"requests_submitted_tenant_{tenant}",
+                    scope="tenant"))
         self._states: Dict[str, AlertState] = {
             r.name: AlertState(r) for r in self.rules}
         # pre-declare per-rule gauges so the zero-valued series exist
@@ -178,6 +213,7 @@ class AlertEngine:
         for name, s in states.items():
             out[name] = {
                 "class": s.rule.request_class,
+                "scope": s.rule.scope,
                 "kind": s.rule.kind,
                 "firing": s.firing,
                 "fire_count": s.fire_count,
@@ -214,8 +250,10 @@ class AlertEngine:
             frac = Histogram.fraction_over_from(bounds, counts,
                                                 rule.threshold_s)
             return frac / rule.budget
-        # availability: shed / submitted, both from one snapshot pair
-        submitted_name = f"requests_submitted_class_{rule.request_class}"
+        # availability: shed / submitted, both from one snapshot pair;
+        # the denominator is scope-specific (per-class or per-tenant)
+        submitted_name = (rule.denominator
+                          or f"requests_submitted_class_{rule.request_class}")
         deltas = self.windowed.window_deltas((submitted_name, rule.metric),
                                              window_s)
         if deltas is None or deltas[submitted_name] < min_count:
@@ -241,7 +279,8 @@ class AlertEngine:
             return Histogram.fraction_over_from(bounds, counts,
                                                 rule.threshold_s)
         submitted = self.metrics.counter(
-            f"requests_submitted_class_{rule.request_class}").value
+            rule.denominator
+            or f"requests_submitted_class_{rule.request_class}").value
         if submitted <= 0:
             return 0.0
         return min(1.0, self.metrics.counter(rule.metric).value / submitted)

@@ -49,6 +49,7 @@ LOCK_RANKS = {
     "serving.autoscaler": 50,      # controller counters/ledger
     # ------------------------------------------------- request flow
     "serving.queue": 60,           # admission heap (condition)
+    "serving.tenancy": 65,         # tenant ledger (quota/fair-share)
     "serving.replica": 70,         # per-replica delivery/accounting
     "serving.fabric.remote": 72,   # remote-handle mirror/accounting
     "serving.fabric.server": 74,   # replica-server request table

@@ -36,7 +36,11 @@ from typing import Dict, Optional, Tuple
 # _INFORMATIONAL wins over both: environment measurements (what the
 # MACHINE did, not the code) must never gate — the repo's own rounds
 # span 0.19%..4.78% noise floors across boxes.
-_INFORMATIONAL = ("noise_floor", "wall_", "budget_s")
+_INFORMATIONAL = ("noise_floor", "wall_", "budget_s",
+                  # multitenant phase: how badly the FAIRNESS-OFF
+                  # baseline starves tenant B — it documents the
+                  # problem, it is not a property of the shipped path
+                  "starvation_ratio")
 _LOWER_IS_BETTER = (
     "ttft", "tpot", "latency", "_ms", "_time_s", "time_s", "wait",
     "steps_lost", "overhead", "shed_rate", "ppl",
@@ -63,6 +67,9 @@ _LOWER_IS_BETTER = (
     # and must stay informational, and param_bytes_fp32 is a constant
     # baseline.
     "param_bytes_int8", "param_bytes_total",
+    # multitenant phase: how far tenant B's p95 TTFT sits above its
+    # solo run (fair-share on), and requests a tenant lost to shedding
+    "isolation_ratio", "tenant_b_shed",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
@@ -84,6 +91,10 @@ _HIGHER_IS_BETTER = (
     # fabric phase: cross-process handoffs completed — fewer means the
     # prefill->decode path degraded to re-prefill fallbacks
     "handoffs_completed_fabric", "handoffs_completed_local",
+    # multitenant phase: flood tokens generated while fair-share held
+    # tenant B near solo latency — zero would mean fairness starved
+    # the flood instead (work conservation lost)
+    "flood_tokens",
 )
 
 

@@ -13,18 +13,28 @@ for a frontend to adopt as a :class:`RemoteHandle` replica.
 ``spec.json``::
 
     {
-      "model":   {... TransformerConfig kwargs ...},
-      "engine":  {... RaggedInferenceEngineConfig kwargs ...},
-      "seed":    0,                 # params = model.init(PRNGKey(seed))
-      "serving": {... ServingConfig dict (engine blocks, speculative,
+      "model":      {... TransformerConfig kwargs ...},
+      "engine":     {... RaggedInferenceEngineConfig kwargs ...},
+      "seed":       0,              # params = model.init(PRNGKey(seed))
+      "checkpoint": null,           # OR a training checkpoint dir —
+                                    # params loaded via runtime/
+                                    # checkpointing.load_params_for_model
+                                    # (overrides seed; a missing or
+                                    # model-mismatched manifest aborts
+                                    # boot with a descriptive error)
+      "model_id":   "default",      # pool name advertised in the fabric
+                                    # hello — a frontend adopting this
+                                    # replica under a DIFFERENT model
+                                    # name refuses it (ModelMismatch)
+      "serving":    {... ServingConfig dict (engine blocks, speculative,
                       disaggregation/handoff chunking, faults...) ...}
     }
 
 Seeded init makes byte-parity testable: a frontend-side engine built
 from the same spec holds identical weights, so local-vs-remote greedy
 streams must match to the token. Production deployments swap ``seed``
-for a checkpoint path (``models/convert.py``) — the protocol does not
-care where the params came from.
+for the ``checkpoint`` field — the protocol does not care where the
+params came from.
 
 On startup the process prints one machine-readable line::
 
@@ -68,7 +78,11 @@ def main(argv=None) -> int:
     from deepspeed_tpu.serving.fabric.transport import advertised_address
 
     model = CausalLM(TransformerConfig(**spec["model"]))
-    params = model.init(jax.random.PRNGKey(int(spec.get("seed", 0))))
+    if spec.get("checkpoint"):
+        from deepspeed_tpu.runtime.checkpointing import load_params_for_model
+        params = load_params_for_model(model, spec["checkpoint"])
+    else:
+        params = model.init(jax.random.PRNGKey(int(spec.get("seed", 0))))
 
     def engine_factory():
         return InferenceEngineV2(
@@ -79,7 +93,8 @@ def main(argv=None) -> int:
     server = ReplicaServer(engine_factory, config, listen=args.listen,
                            replica_id=args.replica_id,
                            heartbeat_s=args.heartbeat_s,
-                           max_frame_bytes=config.fabric.max_frame_bytes)
+                           max_frame_bytes=config.fabric.max_frame_bytes,
+                           model_id=str(spec.get("model_id", "default")))
     host = (server.listen_host if args.loopback_ok
             else advertised_address(server.listen_host,
                                     server.port).rsplit(":", 1)[0])

@@ -48,7 +48,14 @@
 #                  fleet must match/beat the static fleet's SLO
 #                  attainment on fewer replica-seconds, scaling up AND
 #                  back down, with greedy parity and autoscaler-disabled
-#                  byte-parity asserted) — wires
+#                  byte-parity asserted,
+#                  or TIER1_PHASE=multitenant for the multi-tenant
+#                  fair-share phase — a tenant-A flood must not starve
+#                  tenant B's interactive traffic: B's p95 TTFT with
+#                  deficit-weighted-fair admission on stays within 1.5x
+#                  of its solo run while A still progresses, the same
+#                  flood starves B with tenancy off, and greedy parity
+#                  + tenancy-disabled byte-parity are asserted) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

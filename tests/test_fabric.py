@@ -870,3 +870,129 @@ class TestSubprocessReplica:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+# ==================================================== mixed-model fleet
+class TestMixedModelFleet:
+    """One frontend, two model families (docs/SERVING.md "Multi-model &
+    multi-tenant serving"): a ``models:`` registry builds heterogeneous
+    replica pools — here fam_a served ONLY by a subprocess replica
+    server and fam_b by a local engine plus a second subprocess — and
+    the router keys every dispatch on the request's model_id. Misroute
+    is shown impossible structurally (every completed request ran on a
+    replica of its own pool; the hello exchange refuses a peer hosting
+    a different model) and per-model greedy parity pins each pool's
+    weights to a solo single-model fleet built from the same spec."""
+
+    FAM_B_MODEL = dict(MODEL_KW, hidden_size=48, intermediate_size=96)
+    FAM_B_SEED = 7
+
+    def _spawn(self, tmp_path, name, model_kw, seed, model_id):
+        spec = {"model": model_kw, "engine": ENGINE_KW, "seed": seed,
+                "model_id": model_id, "serving": {}}
+        path = tmp_path / f"{name}.json"
+        path.write_text(json.dumps(spec))
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "serve_replica.py"),
+             "--spec", str(path), "--listen", "127.0.0.1:0",
+             "--loopback-ok"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    @staticmethod
+    def _addr(proc):
+        line = proc.stdout.readline()
+        assert line.startswith("FABRIC_LISTENING "), line
+        return line.split()[1]
+
+    @staticmethod
+    def _reap(proc):
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+    def test_two_families_one_frontend_parity_and_routing(self, tmp_path):
+        from deepspeed_tpu.serving.config import ModelSpec
+        from deepspeed_tpu.serving.frontend import engine_from_model_spec
+
+        procs = [
+            self._spawn(tmp_path, "fam_a", MODEL_KW, SEED, "fam_a"),
+            self._spawn(tmp_path, "fam_b", self.FAM_B_MODEL,
+                        self.FAM_B_SEED, "fam_b"),
+        ]
+        try:
+            addr_a, addr_b = self._addr(procs[0]), self._addr(procs[1])
+            ps = {m: prompts(3, seed) for m, seed in
+                  (("fam_a", 31), ("fam_b", 32))}
+            # solo single-model references from the SAME specs
+            ref = {"fam_a": local_reference(ps["fam_a"], 5)}
+            spec_b = ModelSpec(model=self.FAM_B_MODEL, engine=ENGINE_KW,
+                               seed=self.FAM_B_SEED)
+            fe_ref = ServingFrontend([engine_from_model_spec(spec_b)],
+                                     ServingConfig(max_queue_depth=64))
+            try:
+                ref["fam_b"] = run_fleet(fe_ref, ps["fam_b"], 5)
+            finally:
+                fe_ref.shutdown(drain=False, timeout=5)
+
+            fe = ServingFrontend([], ServingConfig(
+                max_queue_depth=64,
+                fabric={"enabled": True, "peers": [],
+                        "heartbeat_s": 1.0, "rpc_timeout_s": 60.0},
+                models={
+                    "fam_a": {"model": MODEL_KW, "engine": ENGINE_KW,
+                              "seed": SEED, "replicas": 0,
+                              "peers": [addr_a]},
+                    "fam_b": {"model": self.FAM_B_MODEL,
+                              "engine": ENGINE_KW,
+                              "seed": self.FAM_B_SEED, "replicas": 1,
+                              "peers": [addr_b]},
+                }))
+            try:
+                by_id = {r.replica_id: getattr(r, "model_id", "default")
+                         for r in fe.router.replicas}
+                assert sorted(by_id.values()) == \
+                    ["fam_a", "fam_b", "fam_b"], by_id
+                hs = {m: [fe.submit(p, max_new_tokens=5, model=m)
+                          for p in ps[m]] for m in ("fam_a", "fam_b")}
+                assert fe.wait_all(hs["fam_a"] + hs["fam_b"],
+                                   timeout=300), \
+                    [h.state for m in hs for h in hs[m]]
+                for m, handles in hs.items():
+                    # structural misroute impossibility: every request
+                    # ran on a replica of ITS model's pool
+                    for h in handles:
+                        assert by_id[h._req.replica_id] == m, \
+                            f"{m} request served by " \
+                            f"{by_id[h._req.replica_id]} replica"
+                    got = [[ev.token for ev in h.drain()]
+                           for h in handles]
+                    assert got == ref[m], \
+                        f"{m} greedy parity vs its solo fleet broke"
+                report = fe.health_report()
+                assert sorted(r["model"] for r in report["replicas"]) \
+                    == ["fam_a", "fam_b", "fam_b"]
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+        finally:
+            for p in procs:
+                self._reap(p)
+
+    def test_peer_hosting_wrong_model_refused(self):
+        """Adopting a peer into a pool whose model it does not host is
+        a config error, refused typed at the hello — NOT retried (the
+        mismatch is permanent) and never silently misrouted."""
+        with _Servers(1) as srv:        # advertises model_id "default"
+            with pytest.raises(fcodec.ModelMismatch, match="hosts model"):
+                ServingFrontend([], ServingConfig(
+                    max_queue_depth=64,
+                    fabric={"enabled": True, "peers": [],
+                            "heartbeat_s": 0.3, "rpc_timeout_s": 30.0},
+                    models={"fam_a": {"model": MODEL_KW,
+                                      "engine": ENGINE_KW,
+                                      "replicas": 0,
+                                      "peers": srv.peers}}))

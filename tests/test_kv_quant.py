@@ -582,7 +582,30 @@ def test_bench_schema_validator():
                       "handle_disconnects": 0,
                       "parity": True, "disabled_parity": True,
                       "zero_wedges": True, "kv_occupancy": dict(occ)}
+    good["multitenant"] = {"n_flood": 12, "n_interactive": 5,
+                           "flood_max_new": 10, "interactive_max_new": 6,
+                           "solo_p95_ttft_ms": 1635.7,
+                           "fair_on_p95_ttft_ms": 1921.0,
+                           "fair_off_p95_ttft_ms": 2158.6,
+                           "isolation_ratio_on": 1.174,
+                           "starvation_ratio_off": 1.32,
+                           "isolation_ok": True,
+                           "flood_tokens_on": 120,
+                           "flood_progress_ok": True,
+                           "fair_beats_off": True,
+                           "tenant_b_submitted": 5, "tenant_b_shed": 0,
+                           "zero_wedges": True,
+                           "greedy_parity": True, "disabled_parity": True,
+                           "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
+    # multitenant typed checks: bool-for-int rejected, missing named
+    bad_mt = dict(good)
+    bad_mt["multitenant"] = {"n_flood": True, "isolation_ok": 1}
+    problems_mt = bench.validate_serving_schema(bad_mt)
+    assert any("multitenant.n_flood" in p for p in problems_mt)
+    assert any("multitenant.isolation_ok" in p for p in problems_mt)
+    assert any("multitenant.fair_beats_off: missing" in p
+               for p in problems_mt)
     # fabric typed checks: bool-for-int rejected, missing fields named
     bad_fb = dict(good)
     bad_fb["fabric"] = {"rpc_calls": True, "parity": 1}

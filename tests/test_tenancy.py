@@ -1,0 +1,577 @@
+"""Multi-model, multi-tenant serving (ISSUE 16, docs/SERVING.md
+"Multi-model & multi-tenant serving").
+
+Covers the tenancy subsystem at every layer: ``TenantPolicy`` /
+``ModelSpec`` config validation, the :class:`TenantLedger` unit
+behaviors under an injectable clock (weight-normalized virtual service
+with re-flooring, sliding-window token-rate quota with edge-fired
+journal events and the over-quota gauge, idempotent per-engine KV block
+charges released on reconcile), the admission queue's deficit-weighted-
+fair pop and over-quota-first victim ordering, the per-tenant SLO rule
+derivation, and the frontend end to end: unknown model/tenant refused
+BEFORE counters (the PR-8 rejection-ordering contract), legacy
+``submit()`` call sites untouched, a tenant-A flood unable to starve
+tenant B under fair ordering (and provably starving it with tenancy
+off), per-tenant metric series + health-report books, and the
+multi-model registry routing every request to a replica of its own
+pool. Tenancy/models off must stay byte-identical to the historical
+stack — asserted on the metrics snapshot and pop order.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.serving import ServingConfig, ServingFrontend
+from deepspeed_tpu.serving.config import ModelSpec, TenantPolicy
+from deepspeed_tpu.serving.metrics import serving_metrics
+from deepspeed_tpu.serving.queue import AdmissionQueue
+from deepspeed_tpu.serving.request import (FinishReason, RequestState,
+                                           ServingRequest)
+from deepspeed_tpu.serving.tenancy import TenantLedger, kv_blocks_for
+from deepspeed_tpu.telemetry.slo import AlertEngine, SLOClassTarget, SLOConfig
+
+VOCAB = 128
+MODEL_KW = dict(vocab_size=VOCAB, hidden_size=32, intermediate_size=64,
+                num_layers=1, num_heads=2, max_seq_len=128, norm="rmsnorm",
+                activation="silu", position="rope")
+ENGINE_KW = dict(max_ragged_batch_size=64, max_ragged_sequence_count=4,
+                 max_chunk_tokens=32, kv_blocks=64, kv_block_size=8,
+                 max_tracked_sequences=32)
+
+_model = None
+_params = None
+
+
+def tiny_engine(**cfg_over):
+    global _model, _params
+    import jax
+
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+    if _model is None:
+        _model = CausalLM(TransformerConfig(**MODEL_KW))
+        _params = _model.init(jax.random.PRNGKey(0))
+    base = dict(ENGINE_KW)
+    base.update(cfg_over)
+    return InferenceEngineV2(_model, params=_params,
+                             config=RaggedInferenceEngineConfig(**base))
+
+
+def _req(plen=8, max_new=4, priority=1, deadline_s=None, tenant="default",
+         request_class="interactive", shed_rank=0, model_id="default"):
+    return ServingRequest([1] * plen, max_new, priority, deadline_s, None,
+                          request_class=request_class, shed_rank=shed_rank,
+                          tenant=tenant, model_id=model_id)
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeJournal:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+class _FakeReplica:
+    """Just enough surface for the ledger's KV budget math."""
+
+    class _Cfg:
+        kv_block_size = 8
+
+    class _Eng:
+        config = None
+
+    def __init__(self, replica_id=0):
+        self.replica_id = replica_id
+        self.engine = _FakeReplica._Eng()
+        self.engine.config = _FakeReplica._Cfg()
+
+
+def _ledger(policies, clock=None, journal=None, metrics=None, window_s=10.0):
+    pols = {name: TenantPolicy(**kw) for name, kw in policies.items()}
+    pols.setdefault("default", TenantPolicy())
+    return TenantLedger(pols, metrics=metrics, journal=journal,
+                        window_s=window_s,
+                        clock=clock or _Clock())
+
+
+# ============================================================== config
+class TestTenancyConfig:
+    def test_tenant_policy_validation(self):
+        with pytest.raises(ValueError):
+            TenantPolicy(weight=0.0)
+        with pytest.raises(ValueError):
+            TenantPolicy(weight=-1.0)
+        with pytest.raises(ValueError):
+            TenantPolicy(token_rate=-1.0)
+        with pytest.raises(ValueError):
+            TenantPolicy(kv_block_budget=-1)
+
+    def test_default_tenant_merged_only_when_enabled(self):
+        on = ServingConfig(tenants={"alpha": {"weight": 2.0}})
+        assert set(on.tenants) == {"alpha", "default"}
+        off = ServingConfig()
+        assert off.tenants == {}, \
+            "empty tenants map must stay empty (tenancy off)"
+
+    def test_model_spec_validation(self):
+        with pytest.raises(ValueError):
+            ModelSpec(replicas=0)          # no members at all
+        ModelSpec(replicas=0, peers=["10.0.0.1:7000"])   # peers suffice
+        with pytest.raises(ValueError):
+            ModelSpec(peers=["not-an-address"])
+        with pytest.raises(ValueError):
+            ModelSpec(min_replicas=0)
+        with pytest.raises(ValueError):
+            ModelSpec(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            ServingConfig(models={"a": {"replicas": 1}}, default_model="b")
+
+    def test_default_model_resolution(self):
+        assert ServingConfig().resolve_default_model() == "default"
+        two = ServingConfig(models={"zeta": {"replicas": 1},
+                                    "alpha": {"replicas": 1}})
+        assert two.resolve_default_model() == "alpha", \
+            "first registered name in sorted order"
+        pinned = ServingConfig(models={"zeta": {"replicas": 1},
+                                       "alpha": {"replicas": 1}},
+                               default_model="zeta")
+        assert pinned.resolve_default_model() == "zeta"
+
+
+# ========================================================== fair share
+class TestLedgerFairShare:
+    def test_charge_is_weight_normalized(self):
+        clk = _Clock()
+        led = _ledger({"a": {"weight": 1.0}, "b": {"weight": 4.0}}, clk)
+        ra, rb = _req(plen=12, max_new=4, tenant="a"), \
+            _req(plen=12, max_new=4, tenant="b")
+        led.charge(ra)
+        led.charge(rb)
+        # same 16 tokens; b's virtual service is a quarter of a's (the
+        # idle "default" tenant holds the floor at zero — solo history
+        # must NOT be re-floored away, see test below)
+        ka, kb = led.drain_key("a"), led.drain_key("b")
+        assert ka[1] == pytest.approx(16.0)
+        assert kb[1] == pytest.approx(4.0)
+        assert kb < ka, "weight-4 tenant must drain first"
+
+    def test_solo_flood_banks_service_before_victim_dispatches(self):
+        """Regression: the re-floor must range over ALL known tenants
+        (idle = 0), not just charged ones — otherwise a lone flooding
+        tenant is re-zeroed to parity on every charge and the fair pop
+        degrades to FIFO until the starved tenant's first dispatch."""
+        clk = _Clock()
+        led = _ledger({"a": {}, "b": {}}, clk)
+        for _ in range(6):
+            led.charge(_req(plen=8, max_new=4, tenant="a"))
+        assert led.drain_key("a")[1] == pytest.approx(6 * 12.0)
+        assert led.drain_key("b") < led.drain_key("a"), \
+            "the never-dispatched tenant must be preferred"
+
+    def test_refloor_keeps_counters_bounded(self):
+        clk = _Clock()
+        led = _ledger({"a": {}, "b": {}}, clk)
+        for _ in range(50):
+            # every known tenant (incl. the merged default) charges, so
+            # the floor rises each round and counters return to zero
+            led.charge(_req(tenant="a"))
+            led.charge(_req(tenant="b"))
+            led.charge(_req(tenant="default"))
+        assert led.drain_key("a")[1] == pytest.approx(0.0)
+        assert led.drain_key("b")[1] == pytest.approx(0.0)
+        assert led.drain_key("default")[1] == pytest.approx(0.0)
+
+    def test_known_and_names(self):
+        led = _ledger({"a": {}})
+        assert led.known("a") and led.known("default")
+        assert not led.known("ghost")
+        assert led.tenant_names == ["a", "default"]
+
+
+# =============================================================== quota
+class TestLedgerQuota:
+    def test_token_rate_edge_fires_once_and_clears(self):
+        clk = _Clock()
+        jr = _FakeJournal()
+        m = serving_metrics(("interactive", "batch"), tenants=("a", "default"))
+        # 2 tokens/s over a 10 s window = 20-token budget
+        led = _ledger({"a": {"token_rate": 2.0}}, clk, journal=jr, metrics=m)
+        led.charge(_req(plen=8, max_new=4, tenant="a"))   # 12 tokens: under
+        assert not led.over_quota("a")
+        led.charge(_req(plen=8, max_new=4, tenant="a"))   # 24 tokens: over
+        assert led.over_quota("a")
+        assert m.snapshot()["tenant_over_quota_a"] == 1.0
+        led.charge(_req(plen=8, max_new=4, tenant="a"))   # still over
+        assert [k for k, _ in jr.events] == ["tenant_throttled"], \
+            "throttle journal event must fire on the EDGE, not per charge"
+        assert jr.events[0][1] == {"tenant": "a", "reason": "token_rate"}
+        # window ages out with zero traffic -> quota clears on reconcile
+        clk.t += 11.0
+        led.reconcile()
+        assert not led.over_quota("a")
+        assert m.snapshot()["tenant_over_quota_a"] == 0.0
+        # next flood re-fires the edge
+        for _ in range(3):
+            led.charge(_req(plen=8, max_new=4, tenant="a"))
+        assert len(jr.events) == 2
+
+    def test_unlimited_tenant_never_over_quota(self):
+        clk = _Clock()
+        led = _ledger({"a": {}}, clk)
+        for _ in range(100):
+            led.charge(_req(plen=32, max_new=32, tenant="a"))
+        assert not led.over_quota("a")
+
+    def test_victim_rank_prefers_over_quota_tenant(self):
+        clk = _Clock()
+        led = _ledger({"a": {"token_rate": 1.0}, "b": {}}, clk)
+        for _ in range(5):
+            led.charge(_req(plen=8, max_new=4, tenant="a"))
+        assert led.victim_rank(_req(tenant="a")) == 1
+        assert led.victim_rank(_req(tenant="b")) == 0
+
+
+# =========================================================== KV budget
+class TestLedgerKVBudget:
+    def test_kv_blocks_projection(self):
+        r = _req(plen=17, max_new=6)
+        # ceil((17 + 6) / 8) = 3 blocks, whole-sequence projection
+        assert kv_blocks_for(r, 8) == 3
+        assert kv_blocks_for(r, 16) == 2
+
+    def test_budget_admits_charges_and_releases(self):
+        clk = _Clock()
+        led = _ledger({"a": {"kv_block_budget": 4}}, clk)
+        rep = _FakeReplica(0)
+        r1 = _req(plen=17, max_new=6, tenant="a")     # 3 blocks
+        r2 = _req(plen=17, max_new=6, tenant="a")     # 3 blocks
+        assert led.admits_kv(r1, rep)
+        led.charge_kv(r1, rep)
+        assert not led.admits_kv(r2, rep), "3 + 3 > budget of 4"
+        # refusal surfaces as a kv_budget throttle state
+        assert led.snapshot()["a"]["throttled"] == "kv_budget"
+        led.release_kv(r1.uid)
+        assert led.admits_kv(r2, rep)
+        assert led.snapshot()["a"]["throttled"] is None
+
+    def test_charge_is_idempotent_per_uid(self):
+        clk = _Clock()
+        led = _ledger({"a": {"kv_block_budget": 4}}, clk)
+        rep0, rep1 = _FakeReplica(0), _FakeReplica(1)
+        r = _req(plen=17, max_new=6, tenant="a")      # 3 blocks
+        led.charge_kv(r, rep0)
+        led.charge_kv(r, rep1)    # failover re-dispatch: refunds rep0
+        books = led.snapshot()["a"]["kv_blocks_used"]
+        assert books == {1: 3}, books
+
+    def test_reconcile_releases_done_requests(self):
+        clk = _Clock()
+        led = _ledger({"a": {"kv_block_budget": 4}}, clk)
+        rep = _FakeReplica(0)
+        r = _req(plen=17, max_new=6, tenant="a")
+        led.charge_kv(r, rep)
+        led.reconcile()
+        assert led.snapshot()["a"]["kv_blocks_used"] == {0: 3}, \
+            "live request must stay charged across reconcile"
+        r.finish(RequestState.FINISHED, FinishReason.LENGTH)
+        led.reconcile()
+        assert led.snapshot()["a"]["kv_blocks_used"] == {}
+
+    def test_unlimited_budget_is_a_noop(self):
+        led = _ledger({"a": {}}, _Clock())
+        rep = _FakeReplica(0)
+        r = _req(tenant="a")
+        assert led.admits_kv(r, rep)
+        led.charge_kv(r, rep)
+        assert led.snapshot()["a"]["kv_blocks_used"] == {}
+
+
+# ======================================================= queue ordering
+class TestQueueFairOrdering:
+    def _drain(self, q, led=None):
+        """Pop-and-charge loop, the router's dispatch contract."""
+        out = []
+        while len(q):
+            r = q.pop(timeout=0.1)
+            if r is None:
+                break
+            if led is not None:
+                led.charge(r)
+            out.append(r)
+        return out
+
+    def test_fair_pop_interleaves_flooded_tenant(self):
+        clk = _Clock()
+        led = _ledger({"a": {"weight": 1.0}, "b": {"weight": 4.0}}, clk)
+        q = AdmissionQueue(64, tenancy=led)
+        flood = [_req(tenant="a") for _ in range(6)]
+        inter = [_req(tenant="b") for _ in range(2)]
+        for r in flood + inter:
+            q.offer(r)
+        order = self._drain(q, led)
+        pos = [i for i, r in enumerate(order) if r.tenant == "b"]
+        # DWF: a1 pops at parity, then b overtakes until its quarter-
+        # rate service catches up — both b entries drain in the first
+        # three pops despite six earlier-submitted a entries
+        assert pos == [1, 2], [r.tenant for r in order]
+        assert len(order) == 8
+
+    def test_tenancy_off_pop_is_historical_fifo(self):
+        q = AdmissionQueue(64)
+        reqs = [_req(tenant="a") for _ in range(4)] + \
+            [_req(tenant="b") for _ in range(2)]
+        for r in reqs:
+            q.offer(r)
+        order = self._drain(q)
+        assert [r.uid for r in order] == [r.uid for r in reqs], \
+            "without a ledger the pop order must stay uid-FIFO"
+
+    def test_over_quota_tenant_deprioritized_but_work_conserving(self):
+        clk = _Clock()
+        led = _ledger({"a": {"token_rate": 1.0}, "b": {}}, clk)
+        for _ in range(5):                       # drive a over its quota
+            led.charge(_req(plen=8, max_new=4, tenant="a"))
+        assert led.over_quota("a")
+        q = AdmissionQueue(64, tenancy=led)
+        ra = [_req(tenant="a") for _ in range(2)]
+        rb = [_req(tenant="b") for _ in range(2)]
+        for r in ra + rb:
+            q.offer(r)
+        order = self._drain(q, led)
+        assert [r.tenant for r in order] == ["b", "b", "a", "a"], \
+            "in-quota tenant first; over-quota still drains when alone"
+
+    def test_victim_key_sheds_over_quota_tenant_first(self):
+        clk = _Clock()
+        led = _ledger({"a": {"token_rate": 1.0}, "b": {}}, clk)
+        for _ in range(5):
+            led.charge(_req(plen=8, max_new=4, tenant="a"))
+        q = AdmissionQueue(64, tenancy=led)
+        # batch-class b (shed_rank 1) vs interactive over-quota a: the
+        # over-quota component leads, beating the class shed rank
+        va = q._victim_key(_req(tenant="a"))
+        vb = q._victim_key(_req(tenant="b", request_class="batch",
+                                shed_rank=1))
+        assert va > vb, (va, vb)
+        q_off = AdmissionQueue(64)
+        r = _req(tenant="a")
+        assert q_off._victim_key(r) == (0,) + tuple(r.shed_key), \
+            "tenancy off must prepend a constant 0 (historical order)"
+
+    def test_per_tenant_shed_counter(self):
+        clk = _Clock()
+        led = _ledger({"a": {}}, clk)
+        m = serving_metrics(("interactive", "batch"),
+                            tenants=("a", "default"))
+        q = AdmissionQueue(1, metrics=m, tenancy=led)
+        q.offer(_req(tenant="a"))
+        with pytest.raises(Exception):
+            q.offer(_req(tenant="a"))            # depth 1: shed
+        snap = m.snapshot()
+        assert snap["requests_shed_tenant_a"] == 1.0
+        assert snap["requests_shed"] == 1.0
+
+
+# ============================================================ SLO rules
+class TestTenantSLORules:
+    def test_per_tenant_rules_derived(self):
+        cfg = SLOConfig(
+            enabled=True,
+            tenants={"alpha": SLOClassTarget(ttft_p95_ms=250.0,
+                                             tpot_p95_ms=50.0,
+                                             availability=0.99)})
+        eng = AlertEngine(cfg, windowed=None)
+        by_name = {r.name: r for r in eng.rules}
+        ttft = by_name["slo_ttft_tenant_alpha"]
+        assert ttft.scope == "tenant"
+        assert ttft.metric == "ttft_s_tenant_alpha"
+        assert ttft.threshold_s == pytest.approx(0.25)
+        avail = by_name["slo_availability_tenant_alpha"]
+        assert avail.metric == "requests_shed_tenant_alpha"
+        assert avail.denominator == "requests_submitted_tenant_alpha", \
+            "a tenant's burn must be measured against ITS traffic only"
+        st = eng.status()["slo_availability_tenant_alpha"]
+        assert st["scope"] == "tenant" and st["firing"] is False
+
+    def test_no_tenant_targets_no_tenant_rules(self):
+        eng = AlertEngine(SLOConfig(enabled=True), windowed=None)
+        assert not [r for r in eng.rules if r.scope == "tenant"]
+
+
+# ============================================================= frontend
+def _fe(tenants=None, models=None, engines=None, **scfg):
+    cfg = {"max_queue_depth": 64}
+    if tenants is not None:
+        cfg["tenants"] = tenants
+    if models is not None:
+        cfg["models"] = models
+    cfg.update(scfg)
+    if engines is None:
+        engines = [] if models is not None else [tiny_engine()]
+    return ServingFrontend(engines, ServingConfig(**cfg))
+
+
+class TestFrontendTenancy:
+    TEN = {"alpha": {"weight": 1.0}, "bravo": {"weight": 4.0}}
+
+    def test_unknown_tenant_refused_before_counters(self):
+        fe = _fe(tenants=self.TEN)
+        try:
+            before = fe.metrics.snapshot()["requests_submitted"]
+            with pytest.raises(ValueError, match="unknown tenant"):
+                fe.submit([1] * 8, max_new_tokens=2, tenant="ghost")
+            snap = fe.metrics.snapshot()
+            assert snap["requests_submitted"] == before, \
+                "caller bugs must not count as submitted traffic (PR 8)"
+            assert snap["requests_shed"] == 0.0
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_unknown_model_refused_before_counters(self):
+        fe = _fe()
+        try:
+            with pytest.raises(ValueError, match="unknown model"):
+                fe.submit([1] * 8, max_new_tokens=2, model="ghost")
+            assert fe.metrics.snapshot()["requests_submitted"] == 0.0
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_legacy_submit_signature_untouched(self):
+        """Call sites that predate tenancy keep working verbatim, and
+        with tenancy OFF the metrics namespace is byte-identical — no
+        tenant series leak into the historical snapshot."""
+        fe = _fe()
+        try:
+            h = fe.submit([1, 2, 3, 4], max_new_tokens=3)
+            assert fe.wait_all([h], timeout=120)
+            assert len([e.token for e in h.drain()]) == 3
+            assert not [k for k in fe.metrics.snapshot() if "tenant" in k]
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_per_tenant_series_and_health_report(self):
+        fe = _fe(tenants=self.TEN)
+        try:
+            hs = [fe.submit([1] * 8, max_new_tokens=2, tenant=t)
+                  for t in ("alpha", "bravo", "bravo")]
+            assert fe.wait_all(hs, timeout=120)
+            snap = fe.metrics.snapshot()
+            assert snap["requests_submitted_tenant_alpha"] == 1.0
+            assert snap["requests_submitted_tenant_bravo"] == 2.0
+            assert snap["tenant_over_quota_alpha"] == 0.0
+            report = fe.health_report()
+            books = report["tenants"]
+            assert set(books) == {"alpha", "bravo", "default"}
+            assert books["bravo"]["weight"] == 4.0
+            assert books["bravo"]["window_tokens"] == pytest.approx(20.0)
+            text = fe.health_report_text()
+            assert "tenant bravo:" in text
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_flood_isolation_on_vs_starvation_off(self):
+        """THE fairness claim, deterministically: a serial engine
+        (max_ragged_sequence_count=1) makes dispatch order equal queue
+        order, and ``admitted_t`` records it without timing noise. With
+        DWF on, tenant bravo's interactive pair overtakes tenant
+        alpha's six-deep flood; with tenancy off the same traffic
+        drains FIFO and bravo goes last — the starvation the feature
+        exists to prevent."""
+        for tenants, expect_overtake in ((self.TEN, True), (None, False)):
+            fe = _fe(tenants=tenants, engines=[
+                tiny_engine(max_ragged_sequence_count=1)])
+            try:
+                kw = {"tenant": "alpha"} if tenants else {}
+                flood = [fe.submit([1] * 8, max_new_tokens=2, **kw)
+                         for _ in range(6)]
+                kw = {"tenant": "bravo"} if tenants else {}
+                inter = [fe.submit([2] * 8, max_new_tokens=2, **kw)
+                         for _ in range(2)]
+                assert fe.wait_all(flood + inter, timeout=300)
+                ranked = sorted(flood + inter,
+                                key=lambda h: h._req.admitted_t)
+                pos = [i for i, h in enumerate(ranked) if h in inter]
+                if expect_overtake:
+                    assert max(pos) <= 3, \
+                        f"fair-on: bravo admitted at {pos}, starved"
+                    # work conservation: the flood still finished
+                    assert all(len(h.drain()) == 2 for h in flood)
+                else:
+                    assert pos == [6, 7], \
+                        f"tenancy-off FIFO should starve bravo, got {pos}"
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+
+class TestFrontendMultiModel:
+    MODELS = {
+        "fam_a": {"model": MODEL_KW, "engine": ENGINE_KW, "seed": 0,
+                  "replicas": 1},
+        "fam_b": {"model": dict(MODEL_KW, hidden_size=48,
+                                intermediate_size=96),
+                  "engine": ENGINE_KW, "seed": 7, "replicas": 1},
+    }
+
+    def test_requests_route_to_their_own_pool(self):
+        fe = _fe(models=self.MODELS)
+        try:
+            by_id = {r.replica_id: getattr(r, "model_id", "default")
+                     for r in fe.router.replicas}
+            assert sorted(by_id.values()) == ["fam_a", "fam_b"]
+            hs = {m: [fe.submit([3] * 8, max_new_tokens=2, model=m)
+                      for _ in range(3)]
+                  for m in ("fam_a", "fam_b")}
+            assert fe.wait_all(hs["fam_a"] + hs["fam_b"], timeout=300)
+            for want, handles in hs.items():
+                for h in handles:
+                    assert by_id[h._req.replica_id] == want, \
+                        f"request for {want} ran on " \
+                        f"{by_id[h._req.replica_id]}"
+            report = fe.health_report()
+            assert sorted(r["model"] for r in report["replicas"]) == \
+                ["fam_a", "fam_b"]
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_default_model_used_when_caller_names_none(self):
+        fe = _fe(models=self.MODELS, default_model="fam_b")
+        try:
+            h = fe.submit([3] * 8, max_new_tokens=2)
+            assert fe.wait_all([h], timeout=300)
+            assert h._req.model_id == "fam_b"
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_engine_factories_win_over_spec(self):
+        built = []
+
+        def fac():
+            built.append(True)
+            return tiny_engine()
+
+        fe = ServingFrontend(
+            [], ServingConfig(max_queue_depth=64,
+                              models={"fam_a": {"replicas": 1}}),
+            model_engine_factories={"fam_a": fac})
+        try:
+            assert built == [True]
+            h = fe.submit([3] * 8, max_new_tokens=2)
+            assert fe.wait_all([h], timeout=300)
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+    def test_spec_with_no_model_and_no_factory_refused(self):
+        with pytest.raises(ValueError):
+            ServingFrontend(
+                [], ServingConfig(models={"fam_a": {"replicas": 1}}))
