@@ -292,7 +292,7 @@ _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
                    "weight_quant",
                    "disagg", "slo", "kv_tier", "overload", "autoscale",
-                   "fabric", "multitenant")
+                   "fabric", "multitenant", "affinity")
 # Typed shape of the multitenant phase (docs/SERVING.md "Multi-model &
 # multi-tenant serving"): tenant-B interactive p95 TTFT solo vs under a
 # tenant-A flood with deficit-weighted-fair admission ON (isolation:
@@ -437,6 +437,39 @@ _AUTOSCALE_KEYS = (("n_requests", int),
                    ("requests_evacuated", int),
                    ("greedy_parity", bool),
                    ("disabled_parity", bool))
+# Typed shape of the affinity phase (docs/SERVING.md "Fleet KV
+# locality"): shared-prefix fleet TTFT + aggregate prefix tokens saved
+# with affinity ON vs OFF (both must improve, greedy parity both ways),
+# the share-cap and grow-path warm-up gates, and the deterministic
+# predictive-vs-watermark scaling replay (first grow strictly earlier,
+# no-worse backlog peak, no added flapping) — all asserted in-phase.
+_AFFINITY_KEYS = (("n_requests", int),
+                  ("n_replicas", int),
+                  ("n_families", int),
+                  ("shared_prefix_tokens", int),
+                  ("max_new", int),
+                  ("affinity_on_p50_ttft_ms", (int, float)),
+                  ("affinity_on_p95_ttft_ms", (int, float)),
+                  ("affinity_off_p50_ttft_ms", (int, float)),
+                  ("affinity_off_p95_ttft_ms", (int, float)),
+                  ("ttft_improved", bool),
+                  ("prefix_tokens_saved_on", int),
+                  ("prefix_tokens_saved_off", int),
+                  ("tokens_saved_improved", bool),
+                  ("affinity_hits", int),
+                  ("affinity_misses", int),
+                  ("share_cap_ok", bool),
+                  ("warmup_blocks", int),
+                  ("warmup_s", (int, float)),
+                  ("warmup_first_hit_ok", bool),
+                  ("predictive_first_grow_tick", int),
+                  ("watermark_first_grow_tick", int),
+                  ("predictive_earlier", bool),
+                  ("predictive_peak_queue", (int, float)),
+                  ("watermark_peak_queue", (int, float)),
+                  ("predictive_no_flap", bool),
+                  ("greedy_parity", bool),
+                  ("disabled_parity", bool))
 # Typed shape of the train_chaos phase (docs/TRAINING.md "Fault
 # tolerance"): recovery/steps-lost/parity numbers the robustness gates
 # read. ``recovery_time_s`` may be absent only on a skipped phase.
@@ -549,6 +582,11 @@ def validate_serving_schema(serving: dict):
         problems.append("multitenant: missing or not an object")
     elif "phase_skipped" not in mt:
         _check_typed_phase("multitenant", mt, _MULTITENANT_KEYS, problems)
+    af = serving.get("affinity")
+    if not isinstance(af, dict):
+        problems.append("affinity: missing or not an object")
+    elif "phase_skipped" not in af:
+        _check_typed_phase("affinity", af, _AFFINITY_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -2592,6 +2630,309 @@ def bench_serving(on_tpu: bool):
             "disabled_parity": bool(disabled_parity),
         }
 
+    def run_affinity_phase():
+        """Fleet KV locality (docs/SERVING.md "Fleet KV locality"):
+        shared-prefix traffic (several prompt families over a common
+        system prompt) replayed in concurrent waves against a
+        multi-replica fleet, affinity ON vs OFF. Gates: ON beats OFF on
+        fleet p50/p95 TTFT AND aggregate prefix tokens saved, with
+        greedy byte-parity both ways; no replica exceeds the
+        affinity-share cap; a replica grown mid-run is warmed from the
+        fleet's digests and takes prefix hits on its first requests; a
+        deterministic scaling replay shows the predictive controller
+        issuing its first grow strictly earlier than the pure-watermark
+        baseline (reason ``predicted_pressure``) with a no-worse
+        backlog peak and no added flapping; and ``affinity: {enabled:
+        false}`` is byte-for-byte a config that never heard of the
+        block."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.inference.v2.scheduler import (
+            ContinuousBatchingScheduler)
+        from deepspeed_tpu.serving import (AutoscalerConfig, ServingConfig,
+                                           ServingFrontend)
+        from deepspeed_tpu.serving.autoscaler import (FleetController,
+                                                      FleetSignals,
+                                                      ReplicaInfo)
+
+        # MORE prefix families than one replica's bounded cache holds:
+        # cache-blind routing scatters each family across the fleet and
+        # LRU-churns every replica, while affinity PARTITIONS the family
+        # set — the fleet's aggregate effective cache is the win, not
+        # any single replica's
+        bs = int(vcfg.kv_block_size)
+        n_rep, families, shared_blocks = 3, 9, 7
+        cache_blocks = 32               # < families * shared_blocks / 2
+        if on_tpu:
+            tail_lo, tail_hi, max_new, n_waves = 8, 17, 6, 8
+        else:
+            tail_lo, tail_hi, max_new, n_waves = 4, 9, 3, 8
+        shared_len = shared_blocks * bs
+        heads = [rng.integers(0, cfg.vocab_size, size=shared_len).tolist()
+                 for _ in range(families)]
+        reqs = []                       # (wave, prompt); one request per
+        for w in range(n_waves):        # family per wave, shuffled order
+            for fam in rng.permutation(families):
+                tail = rng.integers(
+                    0, cfg.vocab_size,
+                    size=int(rng.integers(tail_lo, tail_hi))).tolist()
+                reqs.append((w, heads[int(fam)] + tail))
+        n_req = len(reqs)
+
+        # uncontended greedy reference — affinity moves PLACEMENT, so
+        # every stream from both fleets must match this byte for byte
+        rcfg = type(vcfg)(**vars(vcfg))
+        ref_sched = ContinuousBatchingScheduler(
+            InferenceEngineV2(engine.model, params=engine.params,
+                              config=rcfg))
+        ref = []
+        for i, (_, p) in enumerate(reqs):
+            ref_sched.submit(260_000 + i, p, max_new_tokens=max_new)
+            ref_sched.run_to_completion()
+            ref.append(ref_sched.finished[260_000 + i].generated)
+
+        def engine_factory(i):
+            ecfg = type(vcfg)(**vars(vcfg))
+            return InferenceEngineV2(engine.model, params=engine.params,
+                                     config=ecfg)
+
+        def drive(affinity_on):
+            extra = ({"affinity": {"enabled": True,
+                                   "refresh_interval_s": 0.05}}
+                     if affinity_on else {})
+            fe = ServingFrontend.from_engine_factory(
+                engine_factory,
+                ServingConfig(num_replicas=n_rep,
+                              max_queue_depth=max(64, 2 * n_req),
+                              prefix_cache={
+                                  "enabled": True,
+                                  "max_cached_blocks": cache_blocks},
+                              **extra))
+            try:
+                # compile warm-up outside the clock (too short to index)
+                fe.wait_all([fe.submit(heads[0][:4], max_new_tokens=2)],
+                            timeout=600)
+                handles = []
+                for w in range(n_waves):
+                    wave_reqs = [p for wi, p in reqs if wi == w]
+                    # bursts of fleet-width so both fleets run at the
+                    # same shallow queue depth: TTFT then measures
+                    # prefill work (hit vs full), not burst-queue
+                    # position, which is pure submission-order noise
+                    for j in range(0, len(wave_reqs), n_rep):
+                        burst = [(w, fe.submit(p, max_new_tokens=max_new))
+                                 for p in wave_reqs[j:j + n_rep]]
+                        assert fe.wait_all([h for _, h in burst],
+                                           timeout=600)
+                        handles.extend(burst)
+                        time.sleep(0.06)    # a digest refresh per burst
+                # TTFT is scored on steady-state waves only: wave 0
+                # carries one-time XLA compiles for both fleets, and a
+                # multi-second compile landing on either side's p95
+                # would drown the routing signal being measured
+                gens, ttfts = [], []
+                for w, h in handles:
+                    evs = h.drain()
+                    gens.append([ev.token for ev in evs])
+                    if w >= 1:
+                        ttfts.append(evs[0].t - h._req.arrival_t)
+                saved = sum(
+                    int(r.engine.prefix_stats()["tokens_saved"])
+                    for r in fe.router.replicas)
+                out = {"gens": gens, "ttfts": ttfts, "saved": saved}
+                if not affinity_on:
+                    return out
+                aff = fe._affinity
+                out["stats"] = aff.stats()
+                cap = (fe.config.affinity.max_share
+                       * aff._recent.maxlen)
+                counts = aff.share_counts()
+                out["share_cap_ok"] = all(c <= cap
+                                          for c in counts.values())
+                # grow-path warm-up: the new replica must join warm and
+                # take prefix hits on its very first routed requests
+                rid = fe.add_replica()
+                evs = [e for e in fe.journal.events()
+                       if e.get("kind") == "replica_warmup"]
+                assert evs, "grow path emitted no replica_warmup event"
+                out["warmup_blocks"] = int(evs[-1]["detail"]["blocks"])
+                out["warmup_s"] = float(evs[-1]["detail"]["warmup_s"])
+                grown = next(r for r in fe.router.replicas
+                             if r.replica_id == rid)
+                # retire the donors so the follow-up wave can only land
+                # on the grown replica — the gate is "did warm-up leave
+                # it hot", not "did the router happen to pick it over
+                # replicas holding the same blocks"
+                for old in [r.replica_id for r in fe.router.replicas
+                            if r.replica_id != rid]:
+                    assert fe.remove_replica(old)
+                extra_wave = [
+                    fe.submit(heads[k] + rng.integers(
+                        0, cfg.vocab_size,
+                        size=tail_lo).tolist(), max_new_tokens=max_new)
+                    for k in range(families)]
+                assert fe.wait_all(extra_wave, timeout=600)
+                for h in extra_wave:
+                    h.drain()
+                out["warmup_first_hit_ok"] = bool(
+                    int(grown.engine.prefix_stats()["tokens_saved"]) > 0)
+                return out
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        on = drive(affinity_on=True)
+        off = drive(affinity_on=False)
+
+        # ---- predictive vs watermark scaling, deterministic replay ----
+        def scaling_sim(predictive):
+            class SimFleet:
+                def __init__(self):
+                    self.n = 1
+                    self.queue = 0.0
+                    self.pred = None
+                    self.actions = []
+
+                def fleet_signals(self):
+                    infos = tuple(ReplicaInfo(i, "mixed", True, False,
+                                              0, 0)
+                                  for i in range(self.n))
+                    return FleetSignals(queue_depth=self.queue,
+                                        replicas=infos,
+                                        predicted_queue_depth=self.pred)
+
+                def add_replica(self, role):
+                    self.n += 1
+                    self.actions.append("add")
+                    return self.n - 1
+
+                def remove_replica(self, rid, reason="scale_down"):
+                    self.n -= 1
+                    self.actions.append("remove")
+                    return True
+
+                def set_replica_role(self, rid, role):
+                    return True
+
+                def set_proactive_brownout(self, frac):
+                    pass
+
+            fleet = SimFleet()
+            ctl = FleetController(AutoscalerConfig(
+                enabled=True, min_replicas=1, max_replicas=4,
+                scale_up_queue_per_replica=4.0,
+                scale_down_queue_per_replica=0.25,
+                scale_down_tokens_per_replica=1.0,
+                up_stable_ticks=2, down_stable_ticks=3,
+                scale_up_cooldown_s=3.0, scale_down_cooldown_s=6.0,
+                tick_interval_s=1.0), fleet, async_actions=False)
+            # a load ramp, sustained burst, then a long idle tail; each
+            # replica drains `service` requests per tick
+            arrivals = ([1, 1, 2, 2, 3, 3, 4, 5, 6, 8, 10, 10, 10, 10,
+                         8, 6, 4, 2, 1] + [0] * 15)
+            service, horizon = 2.5, 8.0
+            q, peak, first_grow = 0.0, 0.0, None
+            for t, a in enumerate(arrivals):
+                q = max(0.0, q + a - service * fleet.n)
+                peak = max(peak, q)
+                slope = max(0.0, a - service * fleet.n)
+                fleet.queue = q
+                fleet.pred = (q + horizon * slope) if predictive else None
+                before = len(fleet.actions)
+                ctl.tick(float(t))
+                if first_grow is None and len(fleet.actions) > before \
+                        and fleet.actions[-1] == "add":
+                    first_grow = t
+            return (first_grow, peak, list(fleet.actions),
+                    list(ctl.decision_log))
+
+        grow_pred, peak_pred, acts_pred, log_pred = scaling_sim(True)
+        grow_base, peak_base, acts_base, log_base = scaling_sim(False)
+        first_reason = next(d["reason"] for d in log_pred
+                            if d["action"] == "scale_up")
+        # no added flapping on this replay: every grow precedes every
+        # shrink (no add -> remove -> add churn), and prediction never
+        # changed HOW MUCH the fleet moved, only WHEN
+        no_flap = (acts_pred.index("remove")
+                   > len([a for a in acts_pred if a == "add"]) - 1
+                   if "remove" in acts_pred else True)
+        no_flap = no_flap and (
+            acts_pred.count("add") == acts_base.count("add")
+            and acts_pred.count("remove") == acts_base.count("remove"))
+
+        # ---- disabled byte-parity ------------------------------------
+        def parity_gens(affinity_block):
+            extra = ({"affinity": affinity_block}
+                     if affinity_block is not None else {})
+            fe = ServingFrontend([engine_factory(0)],
+                                 ServingConfig(max_queue_depth=64,
+                                               prefix_cache={
+                                                   "enabled": True},
+                                               **extra))
+            try:
+                hs = [fe.submit(p, max_new_tokens=max_new)
+                      for _, p in reqs[:6]]
+                assert fe.wait_all(hs, timeout=600)
+                return [[ev.token for ev in h.drain()] for h in hs]
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        disabled_parity = (parity_gens({"enabled": False})
+                           == parity_gens(None))
+
+        p50_on = float(np.percentile(on["ttfts"], 50)) * 1e3
+        p95_on = float(np.percentile(on["ttfts"], 95)) * 1e3
+        p50_off = float(np.percentile(off["ttfts"], 50)) * 1e3
+        p95_off = float(np.percentile(off["ttfts"], 95)) * 1e3
+        greedy_parity = on["gens"] == ref and off["gens"] == ref
+        assert greedy_parity, "affinity routing broke greedy parity"
+        assert disabled_parity, \
+            "affinity.enabled=false diverged from the block-less stack"
+        assert on["saved"] > off["saved"], \
+            (f"affinity saved {on['saved']} prefix tokens "
+             f"<= cache-blind routing's {off['saved']}")
+        assert p50_on < p50_off and p95_on < p95_off, \
+            (f"affinity TTFT p50/p95 {p50_on:.1f}/{p95_on:.1f}ms not "
+             f"under cache-blind {p50_off:.1f}/{p95_off:.1f}ms")
+        assert on["share_cap_ok"], "a replica exceeded the share cap"
+        assert on["warmup_blocks"] > 0, "warm-up imported no blocks"
+        assert on["warmup_first_hit_ok"], \
+            "grown replica took no prefix hits after warm-up"
+        assert grow_pred is not None and grow_base is not None
+        assert grow_pred < grow_base, \
+            (f"predictive first grow at tick {grow_pred} not earlier "
+             f"than watermark {grow_base}")
+        assert first_reason == "predicted_pressure", first_reason
+        assert peak_pred <= peak_base, (peak_pred, peak_base)
+        assert no_flap, (acts_pred, acts_base)
+        return {
+            "n_requests": n_req,
+            "n_replicas": int(n_rep),
+            "n_families": int(families),
+            "shared_prefix_tokens": int(shared_len),
+            "max_new": int(max_new),
+            "affinity_on_p50_ttft_ms": round(p50_on, 3),
+            "affinity_on_p95_ttft_ms": round(p95_on, 3),
+            "affinity_off_p50_ttft_ms": round(p50_off, 3),
+            "affinity_off_p95_ttft_ms": round(p95_off, 3),
+            "ttft_improved": bool(p50_on < p50_off and p95_on < p95_off),
+            "prefix_tokens_saved_on": int(on["saved"]),
+            "prefix_tokens_saved_off": int(off["saved"]),
+            "tokens_saved_improved": bool(on["saved"] > off["saved"]),
+            "affinity_hits": int(on["stats"]["hits"]),
+            "affinity_misses": int(on["stats"]["misses"]),
+            "share_cap_ok": bool(on["share_cap_ok"]),
+            "warmup_blocks": int(on["warmup_blocks"]),
+            "warmup_s": round(float(on["warmup_s"]), 4),
+            "warmup_first_hit_ok": bool(on["warmup_first_hit_ok"]),
+            "predictive_first_grow_tick": int(grow_pred),
+            "watermark_first_grow_tick": int(grow_base),
+            "predictive_earlier": bool(grow_pred < grow_base),
+            "predictive_peak_queue": round(float(peak_pred), 2),
+            "watermark_peak_queue": round(float(peak_base), 2),
+            "predictive_no_flap": bool(no_flap),
+            "greedy_parity": bool(greedy_parity),
+            "disabled_parity": bool(disabled_parity),
+        }
+
     # phase-resumable dispatch: per-phase budgets + artifact cache +
     # skip/degrade stamps (PhaseRunner docstring); every result carries
     # the shared engine's KV occupancy snapshot
@@ -2674,6 +3015,12 @@ def bench_serving(on_tpu: bool):
     # greedy parity + tenancy-disabled byte-parity asserted
     result["multitenant"] = runner.run("multitenant",
                                        run_multitenant_phase)
+    # fleet KV locality (docs/SERVING.md "Fleet KV locality"):
+    # shared-prefix waves with affinity routing ON vs OFF — fleet TTFT
+    # and prefix tokens saved must both improve with greedy parity both
+    # ways, warm-up + share-cap gates, and the predictive-vs-watermark
+    # scaling replay
+    result["affinity"] = runner.run("affinity", run_affinity_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

@@ -410,6 +410,24 @@ class InferenceEngineV2:
         evictions, tokens_saved, queries."""
         return self.state_manager.prefix_stats()
 
+    def prefix_digest(self, max_entries: int = 512) -> List[int]:
+        """Bounded chain-hash digest of the cached prefix content (device
+        index + KV tier) — the fleet router's affinity input; see
+        :meth:`DSStateManager.prefix_digest`."""
+        return self.state_manager.prefix_digest(max_entries)
+
+    def export_prefix_blocks(self, max_blocks: int = 64) -> List[tuple]:
+        """Host copies of the hottest cached prefix blocks (the replica
+        warm-up donor side) — see
+        :meth:`DSStateManager.export_prefix_blocks`."""
+        return self.state_manager.export_prefix_blocks(max_blocks)
+
+    def import_prefix_blocks(self, entries: List[tuple]) -> int:
+        """Seed the prefix cache with another replica's exported blocks
+        (the warm-up receiver side) — see
+        :meth:`DSStateManager.import_prefix_blocks`."""
+        return self.state_manager.import_prefix_blocks(entries)
+
     def configure_prefix_cache(self, enabled: bool,
                                max_blocks: Optional[int] = None) -> None:
         """Toggle prefix caching on a built engine — the serving layer's

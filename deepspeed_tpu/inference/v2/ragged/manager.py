@@ -893,6 +893,101 @@ class DSStateManager:
         out, self._restore_times = self._restore_times, []
         return out
 
+    # -- fleet KV locality (docs/SERVING.md "Fleet KV locality") -------------
+    def prefix_digest(self, max_entries: int = 512) -> List[int]:
+        """A bounded digest of the cached prefix content this replica
+        could serve without prefilling: the chain hashes of the device
+        index (MRU first — the entries most likely to survive until the
+        routed request arrives) plus the host/disk tier's keys (newest
+        first). The digest is advisory routing input: truncation or a
+        raced eviction only costs a router credit its match walk would
+        have earned, never correctness. Empty when the cache is off.
+
+        The list() snapshots below are single C-level calls, the same
+        cross-thread tolerance the serving layer's ``tier_stats`` reads
+        already rely on — the router tick reads this while the replica
+        worker mutates the index."""
+        if not self.prefix_cache_enabled or max_entries <= 0:
+            return []
+        out: List[int] = []
+        for key in reversed(list(self._index)):
+            if len(out) >= max_entries:
+                return out
+            out.append(hash(key))
+        if self._tier is not None:
+            host_keys, disk_keys = self._tier.lru_keys()
+            for keys in (host_keys, disk_keys):
+                for key in reversed(keys):
+                    if len(out) >= max_entries:
+                        return out
+                    if key and key[0] == "__preempt__":
+                        continue    # parked sequences aren't prefix content
+                    out.append(hash(key))
+        return out
+
+    def export_prefix_blocks(self, max_blocks: int = 64) -> List[tuple]:
+        """Device→host copies of the hottest cached prefix blocks, MRU
+        first, as ``(index_key, {pool_name: per-block ndarray})`` pairs
+        in tier-entry format — the donor side of replica warm-up. One
+        batched ``jnp.take`` gather per pool tensor (the
+        ``_spill_blocks`` idiom); the donor's own index is untouched.
+        Empty when the cache is off or empty."""
+        if not self.prefix_cache_enabled or max_blocks <= 0:
+            return []
+        pairs = [(key, b) for key, b
+                 in reversed(list(self._index.items()))][:max_blocks]
+        if not pairs:
+            return []
+        ids = jnp.asarray([b for _, b in pairs], dtype=jnp.int32)
+        arrs = {name: jnp.take(pool, ids, axis=1)
+                for name, pool in self.kv_cache.items()}
+        for a in arrs.values():
+            try:
+                a.copy_to_host_async()
+            except Exception:       # backend without async host copy
+                pass
+        host = {name: np.asarray(a) for name, a in arrs.items()}
+        return [(key, {name: host[name][:, i] for name in host})
+                for i, (key, _) in enumerate(pairs)]
+
+    def import_prefix_blocks(self, entries: List[tuple]) -> int:
+        """Seed the prefix cache with exported blocks (the grown-replica
+        side of warm-up): allocate, scatter every slab back in ONE
+        batched ``.at[:, ids].set`` per pool tensor (the
+        ``_restore_chain`` idiom), and register each block under its
+        original chain key as cache-referenced-only (evictable — warmed
+        content yields to real traffic on pressure). Entries already
+        indexed or beyond the free-block / ``prefix_cache_max_blocks``
+        budget are skipped. Returns how many blocks landed."""
+        if not self.prefix_cache_enabled or not entries:
+            return 0
+        budget = self.allocator.free_blocks
+        if self.prefix_cache_max_blocks:
+            budget = min(budget, max(0, self.prefix_cache_max_blocks
+                                     - len(self._index)))
+        take: List[tuple] = []
+        for key, entry in entries:
+            if len(take) >= budget:
+                break
+            if key in self._index:
+                continue
+            take.append((key, entry))
+        if not take:
+            return 0
+        m = len(take)
+        blocks = self.allocator.allocate(m)
+        ids = jnp.asarray(blocks, dtype=jnp.int32)
+        for name, pool in self.kv_cache.items():
+            stacked = np.stack([take[i][1][name] for i in range(m)], axis=1)
+            self.kv_cache[name] = pool.at[:, ids].set(
+                jnp.asarray(stacked, dtype=pool.dtype))
+        for (key, _), b in zip(take, blocks):
+            self._index[key] = b
+            self._block_hash[b] = key
+            self._evictable += 1    # the allocate ref is the cache's ref,
+            #                         exactly as in _restore_chain
+        return m
+
     def clear_prefix_cache(self) -> None:
         """Drop every index entry, releasing the cache's references.
         Blocks still shared by live sequences stay allocated until those

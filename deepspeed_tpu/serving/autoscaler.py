@@ -111,6 +111,12 @@ class FleetSignals:
     # leaves either end None to inherit). Empty on homogeneous fleets;
     # growth then targets the caller engine_factory (model=None).
     model_bounds: Tuple[Tuple[str, int, int], ...] = ()
+    # trend-projected queue depth (docs/SERVING.md "Fleet KV locality"):
+    # queue_depth plus the windowed submit-minus-completion rate times
+    # the prediction horizon. None = no prediction (affinity off,
+    # predictive off, or the window has no history yet) — the
+    # pure-watermark decisions byte for byte.
+    predicted_queue_depth: Optional[float] = None
 
 
 class FleetController:
@@ -161,6 +167,10 @@ class FleetController:
         # hysteresis streaks + per-direction cooldown anchors
         self._up_streak = 0
         self._down_streak = 0
+        # whether the CURRENT tick's up condition held only through the
+        # trend projection (docs/SERVING.md "Fleet KV locality") — the
+        # deciding tick labels its grow "predicted_pressure"
+        self._up_predicted = False
         self._rerole_streak = 0          # signed: +prefill-starved, -decode
         self._last_scale_t: Optional[float] = None
         self._last_rerole_t: Optional[float] = None
@@ -250,6 +260,16 @@ class FleetController:
         q_per = signals.queue_depth / n_acc
         tokens_per = sum(r.outstanding for r in accepting) / n_acc
         up_cond = q_per > cfg.scale_up_queue_per_replica
+        # predictive scaling (docs/SERVING.md "Fleet KV locality"): the
+        # trend-projected queue depth may only ADD a grow trigger —
+        # capacity arrives before the watermark trips — while shrink
+        # stays on the actual signals (shedding real capacity on a
+        # forecast would be flap fuel). None = watermark byte for byte.
+        q_pred = signals.predicted_queue_depth
+        self._up_predicted = (not up_cond and q_pred is not None
+                              and q_pred / n_acc
+                              > cfg.scale_up_queue_per_replica)
+        up_cond = up_cond or self._up_predicted
         down_cond = (not up_cond
                      and q_per <= cfg.scale_down_queue_per_replica
                      and tokens_per <= cfg.scale_down_tokens_per_replica)
@@ -289,7 +309,9 @@ class FleetController:
                 and self._cooled(now, cfg.scale_up_cooldown_s):
             if n_total < cfg.max_replicas:
                 return ("scale_up", self._grow_role(signals),
-                        "queue_pressure", self._grow_model(signals))
+                        ("predicted_pressure" if self._up_predicted
+                         else "queue_pressure"),
+                        self._grow_model(signals))
             # at max with a parked corpse aboard: evict the corpse so
             # the NEXT round can grow live capacity — otherwise a
             # sustained burst (down_cond never holds under load) would

@@ -389,6 +389,74 @@ class AutoscalerConfig(DSConfigModel):
         return self
 
 
+class AffinityConfig(DSConfigModel):
+    """``affinity: {...}`` block (docs/CONFIG.md, docs/SERVING.md "Fleet
+    KV locality"): fleet-wide KV placement. Four coupled pieces: (1)
+    every replica advertises a bounded **prefix digest** (chain hashes
+    of its prefix index + host/disk tier contents — local replicas
+    polled on the router's ~1/s tick, remote ones on the fabric status
+    stream, no new RPC); (2) the router scores digest overlap into the
+    pick as a prefill-token **credit** so shared-prefix traffic herds
+    to warm replicas, with a per-replica **share cap** so herding can
+    never re-create the hot-replica pile-up the split cost model fixed;
+    (3) the autoscaler's grow path **warms up** a new replica's prefix
+    cache from a donor before it enters the rotation; (4) scaling goes
+    **predictive** — the controller grows on the windowed submit-rate
+    trend before the watermark trips. Disabled (the default) builds
+    none of it: pick path, status stream, grow path and watermark
+    decisions are byte-for-byte the historical stack."""
+
+    enabled: bool = False
+    # bounded digest size per replica (chain hashes). The digest is
+    # advisory: truncation only costs credit accuracy, never correctness
+    digest_max_entries: int = 512
+    # credit weight: predicted prefill tokens saved are subtracted from
+    # the pick's load term times this (and times the disaggregation
+    # prefill_token_cost, so credits and loads stay in one currency)
+    credit_weight: float = 1.0
+    # share cap: a replica already holding >= max_share of the last
+    # share_window affinity-steered picks gets zero credit for the pick
+    max_share: float = 0.5
+    share_window: int = 32
+    # local-digest poll cadence on the router tick (remote digests
+    # refresh at the fabric status cadence regardless)
+    refresh_interval_s: float = 1.0
+    # grow-path warm-up: pre-populate a new replica's prefix cache with
+    # up to warmup_max_blocks of a donor's hottest blocks before it
+    # starts accepting; a warm-up that exceeds the timeout (or fails)
+    # degrades to the historical cold start, never fails the grow
+    warmup_enabled: bool = True
+    warmup_timeout_s: float = 5.0
+    warmup_max_blocks: int = 64
+    # predictive scaling: project queue depth predict_horizon_s ahead
+    # from the submit/completion rate trend over predict_window_s of
+    # windowed metrics; the projection can only ADD a grow trigger —
+    # shrink stays on the actual watermarks
+    predictive: bool = True
+    predict_horizon_s: float = 10.0
+    predict_window_s: float = 30.0
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.digest_max_entries < 1:
+            raise ValueError("affinity.digest_max_entries must be >= 1")
+        if not (0.0 < self.max_share <= 1.0):
+            raise ValueError(
+                "affinity.max_share must be in (0, 1] — 0 would cap "
+                "every replica, above 1 never caps")
+        if self.share_window < 1:
+            raise ValueError("affinity.share_window must be >= 1")
+        if self.credit_weight < 0.0:
+            raise ValueError("affinity.credit_weight must be >= 0")
+        if self.warmup_max_blocks < 0:
+            raise ValueError("affinity.warmup_max_blocks must be >= 0")
+        for name in ("refresh_interval_s", "warmup_timeout_s",
+                     "predict_horizon_s", "predict_window_s"):
+            if getattr(self, name) <= 0.0:
+                raise ValueError(f"affinity.{name} must be > 0")
+        return self
+
+
 class FabricConfig(DSConfigModel):
     """``fabric: {...}`` block (docs/CONFIG.md, docs/SERVING.md
     "Multi-host serving"): the cross-process serving fabric. With
@@ -712,6 +780,10 @@ class ServingConfig(DSConfigModel):
     # autoscaling"): grow/shrink/re-role the replica pool + proactive
     # brownout; disabled = the static fleet byte for byte
     autoscaler: AutoscalerConfig = Field(default_factory=AutoscalerConfig)
+    # fleet-wide KV locality (docs/SERVING.md "Fleet KV locality"):
+    # prefix-affinity routing + grow-path warm-up + predictive scaling;
+    # disabled = cache-blind routing and watermark scaling byte for byte
+    affinity: AffinityConfig = Field(default_factory=AffinityConfig)
     # cross-process serving fabric (docs/SERVING.md "Multi-host
     # serving"): adopt replica server processes as RemoteHandle
     # replicas; disabled = the in-process stack byte for byte

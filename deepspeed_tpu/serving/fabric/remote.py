@@ -181,6 +181,12 @@ class RemoteHandle:
         self._last_occupancy: dict = {}
         self._last_param_stats: dict = {}
         self._last_tier_stats: dict = {}
+        # last digest the server's status stream carried (docs/SERVING.md
+        # "Fleet KV locality") — last-write-wins publication from the
+        # transport reader, like the snapshots above; empty until a
+        # digest-bearing status arrives (a digest-less peer stays
+        # cache-blind forever, which is correct, never an error)
+        self._last_prefix_digest: frozenset = frozenset()
         self._counters_last: Dict[str, float] = {}
         self._rx_chunks: Dict[int, list] = {}
         self._dead_reason: Optional[str] = None
@@ -329,6 +335,15 @@ class RemoteHandle:
     def outstanding_decode_tokens(self) -> int:
         with self._lock:
             return self._out_decode
+
+    def prefix_digest(self, max_entries: int = 512) -> frozenset:
+        """The last prefix digest this peer's status stream carried
+        (docs/SERVING.md "Fleet KV locality") — already bounded by the
+        SERVER's ``affinity.digest_max_entries``, so ``max_entries`` is
+        accepted only for signature parity with the local Replica.
+        Empty for a digest-less (pre-affinity) peer: cache-blind, never
+        an error."""
+        return self._last_prefix_digest
 
     @property
     def accepting(self) -> bool:
@@ -643,6 +658,13 @@ class RemoteHandle:
         self._last_occupancy = msg.get("occupancy") or {}
         self._last_param_stats = msg.get("param_stats") or {}
         self._last_tier_stats = msg.get("tier_stats") or {}
+        # OPTIONAL field: only servers with affinity enabled send it; a
+        # frame without one keeps the previous digest (absence means
+        # "nothing new", not "cache emptied" — the server re-sends at
+        # every status tick while enabled)
+        digest = msg.get("prefix_digest")
+        if digest is not None:
+            self._last_prefix_digest = frozenset(int(h) for h in digest)
         counters = msg.get("counters") or {}
         if self.metrics is not None:
             for name in self._FORWARDED_COUNTERS:

@@ -416,14 +416,26 @@ class ReplicaServer:
                 counters = {n: float(snap.get(n, 0.0))
                             for n in RemoteHandle._FORWARDED_COUNTERS}
                 eng = self._engine
-                self._send_event({
+                ev = {
                     "t": "ev", "ev": "status",
                     "state": rep.state.value,
                     "thread_alive": rep.thread.is_alive(),
                     "occupancy": eng.occupancy(),
                     "param_stats": eng.param_stats(),
                     "tier_stats": eng.tier_stats(),
-                    "counters": counters})
+                    "counters": counters}
+                # fleet KV locality (docs/SERVING.md "Fleet KV
+                # locality"): the prefix digest rides the status stream
+                # as an OPTIONAL field — extra dict fields are
+                # backward-compatible on the wire, and a frontend never
+                # requires one (a digest-less peer is cache-blind)
+                aff = getattr(self.config, "affinity", None)
+                if aff is not None and aff.enabled:
+                    fn = getattr(eng, "prefix_digest", None)
+                    if fn is not None:
+                        ev["prefix_digest"] = [
+                            int(h) for h in fn(aff.digest_max_entries)]
+                self._send_event(ev)
             except Exception as e:  # pragma: no cover - defensive
                 logger.error(f"fabric replica server {self.replica_id}: "
                              f"status tick failed: {e!r}")

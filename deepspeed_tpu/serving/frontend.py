@@ -326,12 +326,23 @@ class ServingFrontend:
         # ~1/s observability tick on the router loop: windowed-metrics
         # snapshots always; SLO alert evaluation when enabled
         tick_hooks = [self._observability_tick]
+        # fleet KV locality (docs/SERVING.md "Fleet KV locality"):
+        # prefix-affinity routing state — digests refresh on the router
+        # tick, pick(req) scores overlap as a prefill-token credit.
+        # None when disabled: the cache-blind pick path byte for byte.
+        self._affinity = None
+        if self.config.affinity.enabled:
+            from .affinity import AffinityState
+
+            self._affinity = AffinityState(self.config.affinity,
+                                           metrics=self.metrics)
         self.router = ReplicaRouter(replicas, self.admission, self.metrics,
                                     tracer=self.tracer,
                                     recorder=self.recorder,
                                     disaggregation=self._disagg,
                                     tick_hooks=tick_hooks,
-                                    tenancy=self._tenancy)
+                                    tenancy=self._tenancy,
+                                    affinity=self._affinity)
         self.supervisor = None
         if ft.enabled:
             from .supervisor import ReplicaSupervisor
@@ -817,6 +828,12 @@ class ServingFrontend:
                 engine = (fac() if fac is not None
                           else self._engine_factory(rid))
                 replica = self._build_replica(rid, engine)
+                # restore-before-rotation (docs/SERVING.md "Fleet KV
+                # locality"): warm the new replica's prefix cache from
+                # a donor BEFORE the router can route to it; a warm-up
+                # failure or timeout degrades to the historical cold
+                # start, never fails the grow
+                self._warmup_replica(rid, replica)
                 self.router.add_replica(replica)
             except Exception:
                 self._role_overrides.pop(rid, None)
@@ -825,6 +842,57 @@ class ServingFrontend:
             if self.supervisor is not None:
                 self.supervisor.register_slot(rid)
         return rid
+
+    def _warmup_replica(self, rid: int, replica) -> None:
+        """Pre-populate a grown replica's prefix cache from the warmest
+        accepting local donor of its model pool (docs/SERVING.md "Fleet
+        KV locality"): the donor's hottest blocks are exported
+        device→host and scattered into the new engine before the router
+        can route to it, so the replica's first shared-prefix request
+        hits instead of paying full prefill. Remote donors are skipped
+        (their KV would need a new RPC — the status-stream digest is
+        advisory only) and everything is exception-isolated: warm-up
+        can delay a grow by at most ``warmup_timeout_s``, never fail
+        it."""
+        aff = self.config.affinity
+        if not (aff.enabled and aff.warmup_enabled):
+            return
+        imp = getattr(getattr(replica, "engine", None),
+                      "import_prefix_blocks", None)
+        if imp is None or getattr(replica, "is_remote", False):
+            return
+        t0 = time.monotonic()
+        self.metrics.gauge("replicas_warming").inc()
+        try:
+            mid = self._replica_models.get(rid, "default")
+            donor, warmth = None, 0
+            for r in self.router.replicas:
+                if getattr(r, "is_remote", False) or not r.accepting:
+                    continue
+                if getattr(r, "model_id", "default") != mid:
+                    continue
+                fn = getattr(r, "prefix_digest", None)
+                if fn is None:
+                    continue
+                w = len(fn(aff.digest_max_entries))
+                if w > warmth:
+                    donor, warmth = r, w
+            if donor is None:
+                return                  # whole fleet cold: nothing to copy
+            entries = donor.engine.export_prefix_blocks(
+                aff.warmup_max_blocks)
+            if time.monotonic() - t0 > aff.warmup_timeout_s:
+                entries = []            # donor too slow: cold start
+            blocks = imp(entries) if entries else 0
+            warmup_s = time.monotonic() - t0
+            self.metrics.histogram("replica_warmup_s").observe(warmup_s)
+            self.journal.emit("replica_warmup", replica=rid,
+                              blocks=blocks, source=donor.replica_id,
+                              warmup_s=warmup_s)
+        except Exception as e:
+            logger.error(f"replica {rid} prefix warm-up failed: {e!r}")
+        finally:
+            self.metrics.gauge("replicas_warming").dec()
 
     def remove_replica(self, replica_id: int, reason: str = "scale_down",
                        timeout_s: float = 30.0) -> bool:
@@ -1053,15 +1121,36 @@ class ServingFrontend:
              spec.max_replicas if spec.max_replicas is not None
              else asc.max_replicas)
             for name, spec in sorted(self._models.items()))
+        depth = len(self.admission)
+        # predictive scaling (docs/SERVING.md "Fleet KV locality"):
+        # project the queue depth predict_horizon_s ahead from the
+        # windowed submit-minus-completion rate. window_rate is None
+        # until the ring has history — the controller then runs pure
+        # watermarks, byte for byte (and predicted_load stays 0).
+        predicted = None
+        aff = self.config.affinity
+        if aff.enabled and aff.predictive:
+            w = aff.predict_window_s
+            sub = self.windowed.window_rate("requests_submitted", w)
+            if sub is not None:
+                done = 0.0
+                for name in ("requests_completed", "requests_failed",
+                             "requests_shed", "requests_expired",
+                             "requests_cancelled"):
+                    done += self.windowed.window_rate(name, w) or 0.0
+                predicted = (depth + aff.predict_horizon_s
+                             * max(0.0, sub - done))
+                self.metrics.gauge("predicted_load").set(predicted)
         return FleetSignals(
-            queue_depth=len(self.admission), replicas=infos,
+            queue_depth=depth, replicas=infos,
             burn_slow_max=burn,
             prefill_token_cost=(dis.prefill_token_cost
                                 if dis is not None else 1.0),
             decode_token_cost=(dis.decode_token_cost
                                if dis is not None else 1.0),
             disaggregated=dis is not None,
-            model_bounds=bounds)
+            model_bounds=bounds,
+            predicted_queue_depth=predicted)
 
     def set_proactive_brownout(self, fraction: Optional[float]) -> None:
         """Autoscaler brownout actuator: degrade (or restore, with

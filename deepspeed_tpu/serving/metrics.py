@@ -420,7 +420,14 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # retries = reconnect/backoff attempts against replica
               # servers; disconnects = transport losses that turned a
               # remote handle DEAD (each one fires the failover path)
-              "rpc_retries", "handle_disconnects"):
+              "rpc_retries", "handle_disconnects",
+              # fleet KV locality (docs/SERVING.md "Fleet KV locality"):
+              # hits = picks the affinity credit steered to a warm
+              # replica; misses = hashable prompts no replica (or only
+              # a share-capped one) held; fleet tokens-saved = predicted
+              # prefill tokens the winning credits covered
+              "router_affinity_hits", "router_affinity_misses",
+              "prefix_tokens_saved_fleet"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -468,7 +475,12 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               "brownout_proactive_active",
               # serving fabric: RPC calls currently awaiting a replica
               # server's response (docs/SERVING.md "Multi-host serving")
-              "rpc_inflight"):
+              "rpc_inflight",
+              # fleet KV locality (docs/SERVING.md "Fleet KV locality"):
+              # replicas currently inside the grow path's prefix-cache
+              # warm-up; the trend-projected queue depth the predictive
+              # autoscaler acts on (0 until the window has history)
+              "replicas_warming", "predicted_load"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md
@@ -485,7 +497,10 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # evacuate), the transport-overhead signal the bench
               # fabric phase stamps (docs/SERVING.md "Multi-host
               # serving")
-              "rpc_call_s"):
+              "rpc_call_s",
+              # grow-path prefix-cache warm-up wall time, one sample per
+              # grown replica (docs/SERVING.md "Fleet KV locality")
+              "replica_warmup_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
     # RankedLock debug-mode hold times (docs/CONCURRENCY.md): zero
     # samples unless enable_lock_debug() attached this registry

@@ -215,6 +215,20 @@ class Replica:
         self._out_decode = max(0, self._out_decode
                                - req.remaining_new_tokens)
 
+    def prefix_digest(self, max_entries: int = 512):
+        """Bounded chain-hash digest of this replica's cached prefix
+        content — the router's affinity input (docs/SERVING.md "Fleet
+        KV locality"). Feature-detected like ``_publish_prefix_stats``:
+        an engine without a prefix cache (or a sick one) is simply
+        cache-blind, never an error."""
+        fn = getattr(self.engine, "prefix_digest", None)
+        if fn is None:
+            return frozenset()
+        try:
+            return frozenset(fn(max_entries))
+        except Exception:
+            return frozenset()
+
     @property
     def accepting(self) -> bool:
         return self.state == ReplicaState.HEALTHY

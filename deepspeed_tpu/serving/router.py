@@ -58,11 +58,16 @@ class ReplicaRouter:
                  metrics: Optional[MetricsRegistry] = None,
                  poll_interval_s: float = 0.05,
                  tracer=None, recorder=None, disaggregation=None,
-                 tick_hooks=None, tenancy=None):
+                 tick_hooks=None, tenancy=None, affinity=None):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
         from ..telemetry import NOOP_TRACER
 
+        # AffinityState when fleet KV locality is on (docs/SERVING.md
+        # "Fleet KV locality"): pick(req) scores prefix-digest overlap
+        # into the cost as a prefill-token credit; digests refresh on
+        # the router tick. None = the cache-blind pick, byte for byte.
+        self.affinity = affinity
         # DisaggregationConfig when the pool is role-split (docs/
         # SERVING.md "Disaggregated serving"): selection becomes
         # phase-aware and the load signal becomes the weighted
@@ -201,7 +206,35 @@ class ReplicaRouter:
                     candidates = preferred
         if not candidates:
             return None
+        aff = self.affinity
+        if aff is not None and req is not None:
+            # fleet KV locality (docs/SERVING.md "Fleet KV locality"):
+            # the request's block chain is hashed ONCE here, overlap
+            # credits are memoized per candidate inside choose(), and
+            # None (no hashable prefix / no warm replica) falls through
+            # to the cache-blind selection below. The _loop free-slot
+            # probe passes req=None and never enters this branch.
+            choice = aff.choose(
+                req, candidates, self._cost,
+                self._kv_block_size(candidates),
+                (self.disaggregation.prefill_token_cost
+                 if self.disaggregation is not None else 1.0))
+            if choice is not None:
+                return choice
         return min(candidates, key=self._cost)
+
+    @staticmethod
+    def _kv_block_size(candidates) -> int:
+        """The fleet's KV block size for chain hashing, from the first
+        candidate that exposes one (remote handles mirror it from the
+        hello exchange). Fleets are block-size-homogeneous — a mixed
+        fleet would already break prefix handoff and tier restore."""
+        for r in candidates:
+            bs = getattr(getattr(r, "engine", None), "config", None)
+            bs = getattr(bs, "kv_block_size", None)
+            if bs:
+                return int(bs)
+        return 16
 
     def _any_accepting(self) -> bool:
         return any(r.accepting for r in self.replicas)
@@ -400,6 +433,14 @@ class ReplicaRouter:
             # release KV charges of finished requests + age the
             # token-rate windows (quota clears even with zero traffic)
             self.tenancy.reconcile()
+        if self.affinity is not None:
+            # refresh the fleet's prefix digests (cadence-gated
+            # internally; remote handles answer from their last status
+            # frame, so this never blocks on the wire)
+            try:
+                self.affinity.refresh(self.replicas)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.error(f"affinity digest refresh failed: {e!r}")
         if self.recorder is not None:
             self.recorder.maybe_snapshot()
         for hook in self.tick_hooks:

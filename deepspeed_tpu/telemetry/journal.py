@@ -84,6 +84,12 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     "scale_down": frozenset({"replica", "fleet_size", "reason"}),
     "replica_reroled": frozenset({"replica", "from_role", "to_role"}),
     "brownout_proactive": frozenset({"active", "fraction"}),
+    # fleet KV locality (docs/SERVING.md "Fleet KV locality"): the grow
+    # path warmed a new replica's prefix cache from a donor's exported
+    # blocks before rotation — how many blocks landed, whose cache they
+    # came from, and how long the warm-up took
+    "replica_warmup": frozenset({"replica", "blocks", "source",
+                                 "warmup_s"}),
     # serving fabric (docs/SERVING.md "Multi-host serving"): a remote
     # replica handle lost its transport (the handle went DEAD and its
     # in-flight requests failed over) / a rebuilt handle re-attached to

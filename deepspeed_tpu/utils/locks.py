@@ -47,6 +47,7 @@ LOCK_RANKS = {
     "serving.supervisor": 30,      # replica restart slots
     "serving.router.membership": 40,   # fleet list rebinds (reentrant)
     "serving.autoscaler": 50,      # controller counters/ledger
+    "serving.affinity": 55,        # fleet prefix-digest table + share window
     # ------------------------------------------------- request flow
     "serving.queue": 60,           # admission heap (condition)
     "serving.tenancy": 65,         # tenant ledger (quota/fair-share)

@@ -40,7 +40,12 @@ _INFORMATIONAL = ("noise_floor", "wall_", "budget_s",
                   # multitenant phase: how badly the FAIRNESS-OFF
                   # baseline starves tenant B — it documents the
                   # problem, it is not a property of the shipped path
-                  "starvation_ratio")
+                  "starvation_ratio",
+                  # affinity phase: gate booleans asserted inside the
+                  # phase itself ("ttft_improved" would otherwise match
+                  # the "ttft" latency fragment and flag a 0->1 flip as
+                  # a regression)
+                  "_improved")
 _LOWER_IS_BETTER = (
     "ttft", "tpot", "latency", "_ms", "_time_s", "time_s", "wait",
     "steps_lost", "overhead", "shed_rate", "ppl",
@@ -70,6 +75,9 @@ _LOWER_IS_BETTER = (
     # multitenant phase: how far tenant B's p95 TTFT sits above its
     # solo run (fair-share on), and requests a tenant lost to shedding
     "isolation_ratio", "tenant_b_shed",
+    # affinity phase: grow-path warm-up wall time (export -> import) —
+    # it delays when the router may target the grown replica
+    "warmup_s",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
@@ -95,6 +103,9 @@ _HIGHER_IS_BETTER = (
     # tenant B near solo latency — zero would mean fairness starved
     # the flood instead (work conservation lost)
     "flood_tokens",
+    # affinity phase: picks the router steered by digest overlap —
+    # fewer means the locality signal stopped reaching the pick path
+    "affinity_hits",
 )
 
 

@@ -55,7 +55,16 @@
 #                  deficit-weighted-fair admission on stays within 1.5x
 #                  of its solo run while A still progresses, the same
 #                  flood starves B with tenancy off, and greedy parity
-#                  + tenancy-disabled byte-parity are asserted) — wires
+#                  + tenancy-disabled byte-parity are asserted,
+#                  or TIER1_PHASE=affinity for the fleet KV-locality
+#                  phase — shared-prefix families beyond one replica's
+#                  bounded cache, affinity ON must beat cache-blind
+#                  routing on fleet p50/p95 TTFT and aggregate prefix
+#                  tokens saved, a grown replica must take prefix hits
+#                  from digest warm-up, the predictive controller's
+#                  first grow must land strictly before the watermark
+#                  baseline's without added flapping, and greedy parity
+#                  + affinity-disabled byte-parity are asserted) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

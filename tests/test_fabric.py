@@ -996,3 +996,113 @@ class TestMixedModelFleet:
                                       "engine": ENGINE_KW,
                                       "replicas": 0,
                                       "peers": srv.peers}}))
+
+
+# ========================================= fleet KV locality digests
+class TestFabricPrefixDigest:
+    """ISSUE 17 (docs/SERVING.md "Fleet KV locality"): the prefix digest
+    rides the status stream as an OPTIONAL field. A peer that sends one
+    participates in affinity routing; a peer that never does is
+    cache-blind — zero credit, never refused."""
+
+    def test_status_digest_codec_roundtrip(self):
+        ev = {"t": "ev", "ev": "status", "state": "healthy",
+              "counters": {},
+              "prefix_digest": [0, 12345, -(2 ** 63), 2 ** 63 - 1]}
+        back = fcodec.decode_frame(fcodec.encode_frame(ev))
+        assert back["prefix_digest"] == ev["prefix_digest"]
+
+    def test_status_without_digest_decodes_to_absent(self):
+        # the historical status event: no digest field at all — the
+        # consumer must read absence (None), not an empty list
+        ev = {"t": "ev", "ev": "status", "state": "healthy",
+              "counters": {}}
+        back = fcodec.decode_frame(fcodec.encode_frame(ev))
+        assert "prefix_digest" not in back
+        assert back.get("prefix_digest") is None
+
+    def test_digestless_peer_is_cache_blind_not_refused(self):
+        """Server with affinity off (the historical server): its status
+        events carry no digest. An affinity-enabled frontend must adopt
+        it, route to it, and score it at zero credit — degraded, never
+        an error."""
+        sys_prompt = prompts(1, 31, lo=40, hi=41)[0]
+        ps = [sys_prompt + p for p in prompts(4, 32, lo=4, hi=8)]
+        ref = local_reference(ps, 4)
+        with _Servers(1) as srv:        # server affinity: disabled
+            fe = ServingFrontend([], fabric_cfg(
+                srv.peers,
+                affinity={"enabled": True, "refresh_interval_s": 0.05}))
+            try:
+                got = []
+                for p in ps:
+                    got.extend(run_fleet(fe, [p], 4))
+                time.sleep(0.5)          # status ticks + digest refresh
+                remote = fe.router.replicas[0]
+                assert remote.prefix_digest() == frozenset()
+                assert fe._affinity.digest_of(
+                    remote.replica_id) == frozenset()
+                st = fe._affinity.stats()
+                assert st["hits"] == 0 and st["tokens_saved"] == 0
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+        assert got == ref, "digest-less peer broke greedy parity"
+
+    def test_subprocess_peer_digest_earns_affinity_credit(self, tmp_path):
+        """The real thing: a serve_replica.py subprocess with affinity +
+        prefix cache on. Its digest must arrive via the status stream
+        (no new RPC exists to fetch it) and earn affinity credit for
+        shared-prefix repeats — with greedy parity intact."""
+        spec = {"model": MODEL_KW, "engine": ENGINE_KW, "seed": SEED,
+                "serving": {"prefix_cache": {"enabled": True},
+                            "affinity": {"enabled": True,
+                                         "refresh_interval_s": 0.05}}}
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(spec))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "serve_replica.py"),
+             "--spec", str(spec_path), "--listen", "127.0.0.1:0",
+             "--loopback-ok"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+        try:
+            line = proc.stdout.readline()
+            assert line.startswith("FABRIC_LISTENING "), line
+            addr = line.split()[1]
+            sys_prompt = prompts(1, 33, lo=40, hi=41)[0]
+            ps = [sys_prompt + p for p in prompts(4, 34, lo=4, hi=8)]
+            ref = local_reference(ps, 4)
+            fe = ServingFrontend([], fabric_cfg(
+                [addr], heartbeat_s=1.0,
+                affinity={"enabled": True, "refresh_interval_s": 0.05}))
+            try:
+                got = []
+                for p in ps:             # warm the remote prefix index
+                    got.extend(run_fleet(fe, [p], 4))
+                remote = fe.router.replicas[0]
+                aff = fe._affinity
+                deadline = time.monotonic() + 15
+                while not aff.digest_of(remote.replica_id) \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                assert aff.digest_of(remote.replica_id), \
+                    "peer digest never arrived on the status stream"
+                # shared-prefix repeats: the remote digest must now win
+                # affinity credit in pick()
+                for p in ps:
+                    got2 = run_fleet(fe, [p], 4)
+                    assert got2 == [ref[ps.index(p)]]
+                st = aff.stats()
+                assert st["hits"] > 0 and st["tokens_saved"] > 0, st
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+            assert got == ref, "affinity peer broke greedy parity"
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -597,6 +597,29 @@ def test_bench_schema_validator():
                            "zero_wedges": True,
                            "greedy_parity": True, "disabled_parity": True,
                            "kv_occupancy": dict(occ)}
+    good["affinity"] = {"n_requests": 72, "n_replicas": 3,
+                        "n_families": 9, "shared_prefix_tokens": 112,
+                        "max_new": 3,
+                        "affinity_on_p50_ttft_ms": 44.3,
+                        "affinity_on_p95_ttft_ms": 1591.1,
+                        "affinity_off_p50_ttft_ms": 91.5,
+                        "affinity_off_p95_ttft_ms": 1869.8,
+                        "ttft_improved": True,
+                        "prefix_tokens_saved_on": 5600,
+                        "prefix_tokens_saved_off": 2352,
+                        "tokens_saved_improved": True,
+                        "affinity_hits": 50, "affinity_misses": 22,
+                        "share_cap_ok": True,
+                        "warmup_blocks": 32, "warmup_s": 0.49,
+                        "warmup_first_hit_ok": True,
+                        "predictive_first_grow_tick": 5,
+                        "watermark_first_grow_tick": 8,
+                        "predictive_earlier": True,
+                        "predictive_peak_queue": 28.0,
+                        "watermark_peak_queue": 35.5,
+                        "predictive_no_flap": True,
+                        "greedy_parity": True, "disabled_parity": True,
+                        "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
     # multitenant typed checks: bool-for-int rejected, missing named
     bad_mt = dict(good)
@@ -606,6 +629,14 @@ def test_bench_schema_validator():
     assert any("multitenant.isolation_ok" in p for p in problems_mt)
     assert any("multitenant.fair_beats_off: missing" in p
                for p in problems_mt)
+    # affinity typed checks: bool-for-int rejected, missing named
+    bad_af = dict(good)
+    bad_af["affinity"] = {"affinity_hits": True, "share_cap_ok": 1}
+    problems_af = bench.validate_serving_schema(bad_af)
+    assert any("affinity.affinity_hits" in p for p in problems_af)
+    assert any("affinity.share_cap_ok" in p for p in problems_af)
+    assert any("affinity.warmup_first_hit_ok: missing" in p
+               for p in problems_af)
     # fabric typed checks: bool-for-int rejected, missing fields named
     bad_fb = dict(good)
     bad_fb["fabric"] = {"rpc_calls": True, "parity": 1}
