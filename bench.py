@@ -292,7 +292,7 @@ _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "telemetry", "chaos", "train_chaos", "kv_quant",
                    "weight_quant",
                    "disagg", "slo", "kv_tier", "overload", "autoscale",
-                   "fabric", "multitenant", "affinity")
+                   "fabric", "multitenant", "affinity", "federation")
 # Typed shape of the multitenant phase (docs/SERVING.md "Multi-model &
 # multi-tenant serving"): tenant-B interactive p95 TTFT solo vs under a
 # tenant-A flood with deficit-weighted-fair admission ON (isolation:
@@ -347,6 +347,33 @@ _FABRIC_KEYS = (("replicas", int),
                 ("parity", bool),
                 ("disabled_parity", bool),
                 ("zero_wedges", bool))
+# Typed shape of the federation phase (docs/SERVING.md "Frontend
+# federation"): the two-frontend shared pool vs one standalone frontend
+# (greedy byte-parity, requests_federated > 0 so it isn't vacuous), the
+# adopter-side per-peer RPC overhead, the exporter killed mid-decode
+# (lossless failover with the kill-to-drained recovery time stamped),
+# and the federation-disabled byte-parity bit the acceptance gates read.
+_FEDERATION_KEYS = (("frontends", int),
+                    ("n_requests", int),
+                    ("prompt_len", int),
+                    ("max_new", int),
+                    ("exported_replicas", int),
+                    ("requests_federated", int),
+                    ("standalone_p50_ttft_ms", (int, float)),
+                    ("standalone_p95_ttft_ms", (int, float)),
+                    ("federated_p50_ttft_ms", (int, float)),
+                    ("federated_p95_ttft_ms", (int, float)),
+                    ("peer_rpc_calls", int),
+                    ("peer_rpc_p50_ms", (int, float)),
+                    ("peer_rpc_p95_ms", (int, float)),
+                    ("kill_n_requests", int),
+                    ("kill_max_new", int),
+                    ("requests_failed_over", int),
+                    ("failover_recovery_s", (int, float)),
+                    ("parity", bool),
+                    ("kill_parity", bool),
+                    ("disabled_parity", bool),
+                    ("zero_wedges", bool))
 # Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
 # TTFT comparison with the device pool sized below the prefix working
 # set, spill/restore counts, and the parity bits the acceptance gates
@@ -587,6 +614,11 @@ def validate_serving_schema(serving: dict):
         problems.append("affinity: missing or not an object")
     elif "phase_skipped" not in af:
         _check_typed_phase("affinity", af, _AFFINITY_KEYS, problems)
+    fd = serving.get("federation")
+    if not isinstance(fd, dict):
+        problems.append("federation: missing or not an object")
+    elif "phase_skipped" not in fd:
+        _check_typed_phase("federation", fd, _FEDERATION_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -2933,6 +2965,185 @@ def bench_serving(on_tpu: bool):
             "disabled_parity": bool(disabled_parity),
         }
 
+    def run_federation_phase():
+        """Frontend federation (docs/SERVING.md "Frontend federation"):
+        the same burst run (a) on one standalone frontend owning both
+        engines — the reference, (b) with the ``federation`` block
+        present but DISABLED (asserted byte-for-byte (a)), (c) through a
+        two-frontend shared pool — an exporter publishing its local
+        replica on ``fabric.listen`` and an adopter routing the burst
+        across its own engine plus the adopted export (greedy
+        byte-parity asserted, with requests_federated > 0 so it isn't
+        vacuous; per-peer RPC overhead stamped from ``peer_rpc_s``) —
+        and (d) the same pool with the exporter's listener torn down
+        mid-decode: every in-flight federated stream fails over to the
+        adopter's local replica and resumes byte-losslessly (the PR 5
+        requeue/resume path), with the kill-to-drained recovery time
+        stamped."""
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+
+        # seeded weights shared by every frontend in the phase — what
+        # makes cross-frontend byte-parity meaningful
+        model_kw = dict(vocab_size=512, hidden_size=128,
+                        intermediate_size=256, num_layers=2, num_heads=4,
+                        max_seq_len=256, norm="rmsnorm",
+                        activation="silu", position="rope")
+        eng_kw = dict(max_ragged_batch_size=256,
+                      max_ragged_sequence_count=8, max_chunk_tokens=32,
+                      kv_blocks=64, kv_block_size=16,
+                      max_tracked_sequences=32)
+        n_req, plen, max_new = (16, 64, 12) if on_tpu else (8, 24, 8)
+        # the kill burst decodes long enough that the exporter dies with
+        # federated streams genuinely mid-generation
+        kill_n, kill_max_new = 4, 96
+        fmodel = CausalLM(TransformerConfig(**model_kw))
+        fparams = fmodel.init(jax.random.PRNGKey(0))
+
+        def engine_factory(i=0):
+            return InferenceEngineV2(
+                fmodel, params=fparams,
+                config=RaggedInferenceEngineConfig(**eng_kw))
+
+        ps = [rng.integers(0, model_kw["vocab_size"],
+                           size=plen).tolist() for _ in range(n_req)]
+        kps = ps[:kill_n]
+
+        def fed_cfg(peers=(), enabled=True, **extra):
+            return ServingConfig(
+                max_queue_depth=64,
+                fabric={"enabled": True, "listen": "127.0.0.1:0",
+                        "heartbeat_s": 0.5, "rpc_timeout_s": 60.0,
+                        "federation": {"enabled": enabled,
+                                       "peers": list(peers)}},
+                **extra)
+
+        def drain(fe, hs):
+            completed = fe.wait_all(hs, timeout=600)
+            ttfts, gens = [], []
+            for h in hs:
+                evs = h.drain()
+                gens.append([ev.token for ev in evs])
+                if evs:
+                    ttfts.append(evs[0].t - h._req.arrival_t)
+            finished = all(h.state == RequestState.FINISHED for h in hs)
+            return {"completed": bool(completed and finished),
+                    "gens": gens, "ttfts": ttfts,
+                    "snap": fe.metrics_snapshot()}
+
+        def run(fe, prompts, new_tokens):
+            return drain(fe, [fe.submit(p, max_new_tokens=new_tokens)
+                              for p in prompts])
+
+        def standalone(prompts, new_tokens, cfg=None):
+            fe = ServingFrontend(
+                [engine_factory(0), engine_factory(1)],
+                cfg or ServingConfig(max_queue_depth=64))
+            try:
+                return run(fe, prompts, new_tokens)
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+        def pool(run_fn):
+            """Exporter + adopter two-frontend pool; ``run_fn`` drives
+            the burst through the adopter."""
+            fe_exp = ServingFrontend([engine_factory(0)], fed_cfg())
+            fe_adp = None
+            try:
+                fe_adp = ServingFrontend(
+                    [engine_factory(1)],
+                    fed_cfg(peers=[fe_exp.federation_address],
+                            fault_tolerance={"enabled": True,
+                                             "max_retries": 3,
+                                             "restart_backoff_s": 0.1}))
+                return run_fn(fe_exp, fe_adp)
+            finally:
+                if fe_adp is not None:
+                    fe_adp.shutdown(drain=False, timeout=5)
+                fe_exp.shutdown(drain=False, timeout=5)
+
+        ref = standalone(ps, max_new)
+        kill_ref = standalone(kps, kill_max_new)
+        disabled = standalone(ps, max_new, cfg=fed_cfg(enabled=False))
+
+        # (c) shared pool: the burst routes across the adopter's local
+        # engine AND the exporter's published replica
+        def shared_run(_fe_exp, fe_adp):
+            exported = sum(1 for r in fe_adp.router.replicas
+                           if getattr(r, "is_federated", False))
+            out = run(fe_adp, ps, max_new)
+            out["exported"] = exported
+            return out
+
+        shared = pool(shared_run)
+
+        # (d) exporter death mid-decode: failover + lossless resume
+        def kill_run(fe_exp, fe_adp):
+            fed_rid = next(r.replica_id for r in fe_adp.router.replicas
+                           if getattr(r, "is_federated", False))
+            hs = [fe_adp.submit(p, max_new_tokens=kill_max_new)
+                  for p in kps]
+            deadline = time.monotonic() + 120
+            live = False
+            while time.monotonic() < deadline and not live:
+                live = any(h._req.replica_id == fed_rid
+                           and h._req.n_generated >= 2 for h in hs)
+                time.sleep(0.002)
+            assert live, "no stream ever ran on the federated replica"
+            t_kill = time.monotonic()
+            fe_exp._federation_server.stop()    # no goodbye frames
+            out = drain(fe_adp, hs)
+            out["recovery_s"] = time.monotonic() - t_kill
+            return out
+
+        killed = pool(kill_run)
+
+        assert ref["completed"] and disabled["completed"] \
+            and shared["completed"] and killed["completed"], \
+            "federation phase left unfinished requests"
+        assert disabled["gens"] == ref["gens"], \
+            "federation.enabled=false diverged from the plain fabric stack"
+        assert shared["snap"]["requests_federated"] >= 1, \
+            "no request routed to the peer — parity would be vacuous"
+        assert shared["gens"] == ref["gens"], \
+            "the federated shared pool broke greedy byte-parity"
+        assert killed["snap"]["requests_failed_over"] >= 1, \
+            "exporter death failed over nothing — recovery is vacuous"
+        assert killed["gens"] == kill_ref["gens"], \
+            "cross-frontend failover broke greedy byte-parity"
+        pct = lambda xs, q: (round(float(np.percentile(xs, q)) * 1e3, 3)  # noqa: E731
+                             if xs else -1.0)
+        rpc = shared["snap"]["peer_rpc_s"]
+        return {
+            "frontends": 2,
+            "n_requests": int(n_req), "prompt_len": int(plen),
+            "max_new": int(max_new),
+            "exported_replicas": int(shared["exported"]),
+            "requests_federated": int(
+                shared["snap"]["requests_federated"]),
+            "standalone_p50_ttft_ms": pct(ref["ttfts"], 50),
+            "standalone_p95_ttft_ms": pct(ref["ttfts"], 95),
+            "federated_p50_ttft_ms": pct(shared["ttfts"], 50),
+            "federated_p95_ttft_ms": pct(shared["ttfts"], 95),
+            "peer_rpc_calls": int(rpc["count"]),
+            "peer_rpc_p50_ms": round(rpc["p50"] * 1e3, 3),
+            "peer_rpc_p95_ms": round(rpc["p95"] * 1e3, 3),
+            "kill_n_requests": int(kill_n),
+            "kill_max_new": int(kill_max_new),
+            "requests_failed_over": int(
+                killed["snap"]["requests_failed_over"]),
+            "failover_recovery_s": round(float(killed["recovery_s"]), 3),
+            "parity": bool(shared["gens"] == ref["gens"]),
+            "kill_parity": bool(killed["gens"] == kill_ref["gens"]),
+            "disabled_parity": bool(disabled["gens"] == ref["gens"]),
+            "zero_wedges": bool(ref["completed"] and shared["completed"]
+                                and killed["completed"]),
+        }
+
     # phase-resumable dispatch: per-phase budgets + artifact cache +
     # skip/degrade stamps (PhaseRunner docstring); every result carries
     # the shared engine's KV occupancy snapshot
@@ -3021,6 +3232,12 @@ def bench_serving(on_tpu: bool):
     # ways, warm-up + share-cap gates, and the predictive-vs-watermark
     # scaling replay
     result["affinity"] = runner.run("affinity", run_affinity_phase)
+    # frontend federation (docs/SERVING.md "Frontend federation"):
+    # two-frontend shared pool vs one standalone frontend — greedy
+    # byte-parity with requests actually federated, the exporter torn
+    # down mid-decode → lossless failover with the recovery time
+    # stamped, and federation-disabled byte-parity asserted
+    result["federation"] = runner.run("federation", run_federation_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

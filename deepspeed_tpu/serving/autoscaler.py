@@ -84,6 +84,10 @@ class ReplicaInfo:
     # named model pool (docs/SERVING.md "Multi-model & multi-tenant
     # serving"); "default" on homogeneous fleets
     model_id: str = "default"
+    # federated export adopted from a peer frontend (docs/SERVING.md
+    # "Frontend federation"): borrowed capacity whose lifecycle the
+    # exporting frontend owns — never a shrink victim here
+    federated: bool = False
 
     @property
     def outstanding(self) -> float:
@@ -317,7 +321,8 @@ class FleetController:
             # sustained burst (down_cond never holds under load) would
             # pin the fleet below max forever with a zero-cost seat
             # occupied
-            parked = [r for r in signals.replicas if r.parked]
+            parked = [r for r in signals.replicas
+                      if r.parked and not r.federated]
             if parked:
                 victim = min(parked,
                              key=lambda r: r.replica_id).replica_id
@@ -384,6 +389,7 @@ class FleetController:
         pool_min = {m: mn for m, mn, _mx in signals.model_bounds}
         counts = self._pool_counts(signals)
         parked = [r for r in signals.replicas if r.parked
+                  and not r.federated
                   and (pool is None or r.model_id == pool)]
         if parked:
             return min(parked, key=lambda r: r.replica_id).replica_id
@@ -392,6 +398,8 @@ class FleetController:
             return None         # never remove the last accepting replica
         candidates = []
         for r in accepting:
+            if r.federated:
+                continue        # the exporting frontend owns its lifecycle
             if pool is not None and r.model_id != pool:
                 continue
             floor = pool_min.get(r.model_id)

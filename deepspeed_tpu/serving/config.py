@@ -457,6 +457,57 @@ class AffinityConfig(DSConfigModel):
         return self
 
 
+class FederationConfig(DSConfigModel):
+    """``fabric.federation: {...}`` block (docs/CONFIG.md,
+    docs/SERVING.md "Frontend federation"): the two-tier serving fleet.
+    With ``enabled``, a frontend EXPORTS a slice of its local replica
+    pool on ``fabric.listen`` (a :class:`FederationServer`) and ADOPTS
+    the exports of every frontend in ``peers`` as routable federated
+    replicas — a shared replica pool across edge frontends, with
+    cross-frontend failover (peer death = the requeue/resume path,
+    lossless under greedy decoding) and evacuation onto peers.
+    Disabled (the default) builds none of it — byte for byte the
+    single-frontend stack."""
+
+    enabled: bool = False
+    # peer FRONTEND federation addresses ("host:port" — each peer's
+    # fabric.listen) whose exported replicas this frontend adopts
+    peers: List[str] = Field(default_factory=list)
+    # stable identity for self-peering/loop refusal; "" derives one
+    # from host + pid at frontend construction. Two frontends must
+    # never share an id — a hello carrying the server's own id is
+    # refused ("self_peering"), and a lower epoch for a known id is
+    # refused ("stale_epoch") so a restarted frontend's stale twin
+    # cannot shadow it.
+    frontend_id: str = ""
+    # how many local replicas to export to peers (0 = all local
+    # replicas; federated/remote members are NEVER re-exported — that
+    # is the loop refusal's structural half)
+    export_max_replicas: int = 0
+    # per-peer cap on in-flight federated requests this frontend may
+    # hold against ONE peer (0 = bounded only by the exported
+    # replicas' seat counts) — the capacity-accounting knob that keeps
+    # an edge frontend from soaking a peer's whole pool
+    peer_max_inflight: int = 0
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.enabled:
+            for addr in self.peers:
+                host, sep, port = str(addr).rpartition(":")
+                if not sep or not host or not port.isdigit():
+                    raise ValueError(
+                        f"fabric.federation.peers entry {addr!r} is "
+                        "not host:port")
+            if self.export_max_replicas < 0:
+                raise ValueError(
+                    "fabric.federation.export_max_replicas must be >= 0")
+            if self.peer_max_inflight < 0:
+                raise ValueError(
+                    "fabric.federation.peer_max_inflight must be >= 0")
+        return self
+
+
 class FabricConfig(DSConfigModel):
     """``fabric: {...}`` block (docs/CONFIG.md, docs/SERVING.md
     "Multi-host serving"): the cross-process serving fabric. With
@@ -490,9 +541,17 @@ class FabricConfig(DSConfigModel):
     # hard bound on one wire frame; an oversized KV payload degrades to
     # the re-prefill fallback (typed FrameTooLarge, never a crash)
     max_frame_bytes: int = 64 * 1024 * 1024
+    # frontend federation (docs/SERVING.md "Frontend federation"):
+    # export local replicas on ``listen`` / adopt peer frontends'
+    # exports. Disabled = the single-frontend fabric, byte for byte.
+    federation: FederationConfig = Field(default_factory=FederationConfig)
 
     @model_validator(mode="after")
     def _validate(self):
+        if self.federation.enabled and not self.enabled:
+            raise ValueError("fabric.federation.enabled requires "
+                             "fabric.enabled — federation rides the "
+                             "fabric transport")
         if self.enabled:
             if self.heartbeat_s <= 0:
                 raise ValueError("fabric.heartbeat_s must be > 0 — the "

@@ -25,6 +25,14 @@ _LAZY = {
     "RemoteHandle": ("deepspeed_tpu.serving.fabric.remote", "RemoteHandle"),
     "ReplicaServer": ("deepspeed_tpu.serving.fabric.server",
                       "ReplicaServer"),
+    "FederatedHandle": ("deepspeed_tpu.serving.fabric.federation",
+                        "FederatedHandle"),
+    "FederationPeer": ("deepspeed_tpu.serving.fabric.federation",
+                       "FederationPeer"),
+    "FederationServer": ("deepspeed_tpu.serving.fabric.federation",
+                         "FederationServer"),
+    "FederationRefused": ("deepspeed_tpu.serving.fabric.federation",
+                          "FederationRefused"),
 }
 
 
@@ -42,4 +50,6 @@ __all__ = ["CODEC_VERSION", "CodecError", "FrameTooLarge",
            "payload_chunks", "payload_from_chunks", "request_from_wire",
            "request_to_wire", "Connection", "ConnectionLost", "FabricError",
            "RPCTimeout", "advertised_address", "dial", "parse_address",
-           "HANDLE_SURFACE", "LocalHandle", "RemoteHandle", "ReplicaServer"]
+           "HANDLE_SURFACE", "LocalHandle", "RemoteHandle", "ReplicaServer",
+           "FederatedHandle", "FederationPeer", "FederationServer",
+           "FederationRefused"]

@@ -125,6 +125,13 @@ class RemoteHandle:
     #: process (shrinking it drops the connection, not the chips)
     is_remote = True
 
+    #: hello-refusal markers that are PERMANENT for this peer pair —
+    #: retrying the connect cannot fix them, so the backoff loop
+    #: re-raises the typed error verbatim instead of burning the
+    #: breaker. Subclasses extend (federation adds its peering
+    #: refusals).
+    _PERMANENT_HELLO_MARKERS: tuple = ()
+
     #: server-private engine/scheduler counters forwarded into the fleet
     #: registry as deltas (the Replica._publish_prefix_stats idiom
     #: across the process boundary). Deliberately excludes the
@@ -187,6 +194,12 @@ class RemoteHandle:
         # digest-bearing status arrives (a digest-less peer stays
         # cache-blind forever, which is correct, never an error)
         self._last_prefix_digest: frozenset = frozenset()
+        # digest-DELTA stream state (the PR 18 wire thinning): the
+        # server numbers delta frames with a monotonic epoch per
+        # connection; None until a numbered full snapshot arrives (an
+        # old server never numbers, so deltas never apply — it keeps
+        # sending full snapshots anyway)
+        self._digest_epoch: Optional[int] = None
         self._counters_last: Dict[str, float] = {}
         self._rx_chunks: Dict[int, list] = {}
         self._dead_reason: Optional[str] = None
@@ -202,6 +215,22 @@ class RemoteHandle:
         self.engine = None                  # _EngineFacade after connect
 
     # ------------------------------------------------------------ connect
+    def _hello_payload(self, reset: bool) -> dict:
+        """The hello frame; subclasses extend (federation adds frontend
+        identity + export binding). ``digest_deltas`` advertises the
+        digest-delta decode capability on the status stream — the PR 17
+        optional-field idiom: old servers ignore the flag, and an old
+        CLIENT never sets it, so a new server keeps sending it full
+        snapshots."""
+        return {
+            "codec_version": CODEC_VERSION,
+            "replica_id": self.replica_id,
+            "role": self.role,
+            "model_id": self.model_id,
+            "max_frame_bytes": int(self.fabric.max_frame_bytes),
+            "digest_deltas": True,
+            "reset": bool(reset)}
+
     def connect(self, reset: bool = False) -> None:
         """Dial the replica server and run the hello exchange (codec
         version check, role assignment, optional fresh-engine reset —
@@ -218,13 +247,7 @@ class RemoteHandle:
                     heartbeat_s=self.fabric.heartbeat_s,
                     on_event=self._on_event,
                     name=f"fabric-r{self.replica_id}")
-                info = self._call("hello", {
-                    "codec_version": CODEC_VERSION,
-                    "replica_id": self.replica_id,
-                    "role": self.role,
-                    "model_id": self.model_id,
-                    "max_frame_bytes": int(self.fabric.max_frame_bytes),
-                    "reset": bool(reset)})
+                info = self._call("hello", self._hello_payload(reset))
                 # model identity check (docs/SERVING.md "Multi-model &
                 # multi-tenant serving"): adopting a peer that hosts a
                 # different model would silently misroute every request
@@ -262,6 +285,8 @@ class RemoteHandle:
                     from .codec import VersionMismatch
 
                     raise VersionMismatch(detail=str(e))
+                if any(m in str(e) for m in self._PERMANENT_HELLO_MARKERS):
+                    raise
                 _, backoff = self._restart.record_failure(time.monotonic())
                 if backoff is None:
                     raise ConnectionLost(
@@ -272,6 +297,7 @@ class RemoteHandle:
                 time.sleep(backoff)
         self.engine = _EngineFacade(self, info)
         self._server_thread_alive = True
+        self._digest_epoch = None   # fresh stream: next digest is full
         # a reset connect is the supervisor-restart path: this handle is
         # fresh, but the PEER is being re-attached after a disconnect —
         # journal the recovery half of replica_disconnected
@@ -658,13 +684,37 @@ class RemoteHandle:
         self._last_occupancy = msg.get("occupancy") or {}
         self._last_param_stats = msg.get("param_stats") or {}
         self._last_tier_stats = msg.get("tier_stats") or {}
-        # OPTIONAL field: only servers with affinity enabled send it; a
-        # frame without one keeps the previous digest (absence means
-        # "nothing new", not "cache emptied" — the server re-sends at
-        # every status tick while enabled)
+        # OPTIONAL fields: only servers with affinity enabled send them;
+        # a frame without any keeps the previous digest (absence means
+        # "nothing new", not "cache emptied"). Two wire shapes decode:
+        # a full ``prefix_digest`` snapshot (every pre-delta peer, plus
+        # the first frame of a delta stream) always replaces outright,
+        # and ``digest_add``/``digest_del`` under a monotonic
+        # ``digest_epoch`` apply on top of the last numbered snapshot.
         digest = msg.get("prefix_digest")
         if digest is not None:
             self._last_prefix_digest = frozenset(int(h) for h in digest)
+            ep = msg.get("digest_epoch")
+            self._digest_epoch = int(ep) if ep is not None else None
+        else:
+            add, dele = msg.get("digest_add"), msg.get("digest_del")
+            if add is not None or dele is not None:
+                ep = msg.get("digest_epoch")
+                if self._digest_epoch is not None and ep is not None \
+                        and int(ep) == self._digest_epoch + 1:
+                    cur = set(self._last_prefix_digest)
+                    cur.difference_update(int(h) for h in (dele or ()))
+                    cur.update(int(h) for h in (add or ()))
+                    self._last_prefix_digest = frozenset(cur)
+                    self._digest_epoch = int(ep)
+                else:
+                    # out-of-sequence delta — impossible on one ordered
+                    # TCP stream, so purely defensive: drop to
+                    # cache-blind (advisory signal; routing stays
+                    # correct) and resync the epoch so later deltas
+                    # rebuild partial warmth
+                    self._last_prefix_digest = frozenset()
+                    self._digest_epoch = int(ep) if ep is not None else None
         counters = msg.get("counters") or {}
         if self.metrics is not None:
             for name in self._FORWARDED_COUNTERS:

@@ -53,6 +53,52 @@ from .transport import Connection, FabricError, parse_address
 STATUS_INTERVAL_S = 0.25
 
 
+class DigestStream:
+    """Per-connection prefix-digest DELTA encoder for the status stream
+    (docs/SERVING.md "Fleet KV locality"): the first frame of a
+    connection carries the full ``prefix_digest`` snapshot (+
+    ``digest_epoch`` 0), later frames carry only ``digest_add`` /
+    ``digest_del`` entries under a monotonic epoch — wire bytes scale
+    with cache CHURN instead of ``digest_max_entries``. A tick with no
+    churn sends nothing (absence already means "nothing new" on this
+    stream). Clients that did not advertise ``digest_deltas`` in the
+    hello get the historical full snapshot every tick — the PR 17 wire
+    shape, byte for byte — and old peers that keep SENDING full
+    snapshots still decode client-side (the optional-field idiom: a
+    ``prefix_digest`` field always replaces outright)."""
+
+    def __init__(self):
+        self._last = None
+        self._epoch = 0
+
+    def reset(self) -> None:
+        """New connection / hello: the next frame is a full snapshot."""
+        self._last = None
+        self._epoch = 0
+
+    def stamp(self, ev: dict, digest, deltas: bool) -> None:
+        cur = set(int(h) for h in digest)
+        if not deltas:
+            ev["prefix_digest"] = sorted(cur)
+            return
+        if self._last is None:
+            self._epoch = 0
+            ev["prefix_digest"] = sorted(cur)
+            ev["digest_epoch"] = 0
+            self._last = cur
+            return
+        add, dele = cur - self._last, self._last - cur
+        if not add and not dele:
+            return
+        self._epoch += 1
+        ev["digest_epoch"] = self._epoch
+        if add:
+            ev["digest_add"] = sorted(add)
+        if dele:
+            ev["digest_del"] = sorted(dele)
+        self._last = cur
+
+
 class ReplicaServer:
     # lock discipline (docs/CONCURRENCY.md): the request table and the
     # staged-chunk accumulator are hit from the transport reader thread
@@ -79,6 +125,12 @@ class ReplicaServer:
         self._reqs: Dict[int, object] = {}
         self._stage_rx: Dict[int, list] = {}
         self._conn: Optional[Connection] = None
+        # digest-delta stream state for the (single) frontend
+        # connection: reset at every hello, so each connection starts
+        # with a full snapshot (touched by the hello handler and the
+        # status thread only — the races are benign last-write-wins)
+        self._digest = DigestStream()
+        self._digest_deltas = False
         self._engine = None
         self.replica: Optional[Replica] = None
         self._role = "mixed"
@@ -291,6 +343,10 @@ class ReplicaServer:
             conn.send_max_bytes = (min(self.max_frame_bytes, client_bound)
                                    if self.max_frame_bytes
                                    else client_bound)
+        # digest deltas are OPT-IN per connection: a client that never
+        # advertised keeps getting the full-snapshot wire shape
+        self._digest_deltas = bool(p.get("digest_deltas", False))
+        self._digest.reset()
         role = str(p.get("role", "mixed"))
         reset = bool(p.get("reset", False))
         if (self.replica is None or reset or self._role != role
@@ -426,15 +482,17 @@ class ReplicaServer:
                     "counters": counters}
                 # fleet KV locality (docs/SERVING.md "Fleet KV
                 # locality"): the prefix digest rides the status stream
-                # as an OPTIONAL field — extra dict fields are
+                # as OPTIONAL fields — extra dict fields are
                 # backward-compatible on the wire, and a frontend never
-                # requires one (a digest-less peer is cache-blind)
+                # requires one (a digest-less peer is cache-blind).
+                # Clients that advertised digest_deltas in the hello
+                # get add/evict deltas after the first full snapshot.
                 aff = getattr(self.config, "affinity", None)
                 if aff is not None and aff.enabled:
                     fn = getattr(eng, "prefix_digest", None)
                     if fn is not None:
-                        ev["prefix_digest"] = [
-                            int(h) for h in fn(aff.digest_max_entries)]
+                        self._digest.stamp(ev, fn(aff.digest_max_entries),
+                                           self._digest_deltas)
                 self._send_event(ev)
             except Exception as e:  # pragma: no cover - defensive
                 logger.error(f"fabric replica server {self.replica_id}: "

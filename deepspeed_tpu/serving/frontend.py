@@ -166,10 +166,18 @@ class ServingFrontend:
         self._models = dict(self.config.models)
         self._default_model = self.config.resolve_default_model()
         model_peer_count = sum(len(s.peers) for s in self._models.values())
-        if not engines and not peer_addrs and not self._models:
+        fed_peer_count = (len(fab.federation.peers)
+                          if self._fabric is not None
+                          and fab.federation.enabled else 0)
+        if not engines and not peer_addrs and not self._models \
+                and not fed_peer_count:
+            # an edge frontend with NO local chips is a legitimate
+            # federation topology: it serves entirely off peers' exports
             raise ValueError("ServingFrontend needs at least one engine "
-                             "(or fabric.peers, or a models: registry)")
-        if (peer_addrs or model_peer_count) and sample_fn is not None:
+                             "(or fabric.peers, fabric.federation.peers, "
+                             "or a models: registry)")
+        if (peer_addrs or model_peer_count or fed_peer_count) \
+                and sample_fn is not None:
             # a frontend-level callable cannot cross the wire: remote
             # replicas would silently fall back to greedy sampling while
             # local ones use the custom sampler — same request,
@@ -287,6 +295,32 @@ class ServingFrontend:
         self._next_replica_id = next_rid
         self._role_overrides: dict = {}
         self._fleet_lock = RankedLock("serving.frontend.fleet")
+        # frontend federation (docs/SERVING.md "Frontend federation"):
+        # a two-tier fleet — this frontend EXPORTS a slice of its local
+        # pool on fabric.listen and ADOPTS peers' exports as routable
+        # members. All None/empty when disabled: no identity derived,
+        # no listener bound, no peers dialed — the historical stack
+        # byte for byte. The server starts BEFORE peer adoption so a
+        # misconfigured self-peer gets the typed refusal, not a
+        # connection error.
+        self._federation = None
+        self._federation_server = None
+        self._federation_peers: list = []
+        self._federated_refs: dict = {}
+        if self._fabric is not None and fab.federation.enabled:
+            from .fabric.federation import (FederationServer,
+                                            derive_epoch,
+                                            derive_frontend_id)
+
+            self._federation = fab.federation
+            self._federation_id = (fab.federation.frontend_id
+                                   or derive_frontend_id())
+            self._federation_epoch = derive_epoch()
+            self._federation_server = FederationServer(
+                self, listen=fab.listen,
+                frontend_id=self._federation_id,
+                epoch=self._federation_epoch)
+            self._federation_server.start()
         # evacuated KV rides the same bounded host-RAM staging budget
         # as disagg handoffs (built lazily when no handoff stager
         # exists) — a removal of a fully-loaded replica must not
@@ -323,6 +357,7 @@ class ServingFrontend:
                      for rid, name in model_locals]
         replicas += [self._build_remote(rid, addr)
                      for rid, addr in sorted(self._peer_addrs.items())]
+        replicas += self._adopt_federation_peers()
         # ~1/s observability tick on the router loop: windowed-metrics
         # snapshots always; SLO alert evaluation when enabled
         tick_hooks = [self._observability_tick]
@@ -428,6 +463,11 @@ class ServingFrontend:
         its historical salvage-engine path (a mixed fleet without a
         factory must keep the same local-restart behavior it had before
         fabric)."""
+        ref = self._federated_refs.get(replica_id)
+        if ref is not None:
+            # federated slot: restart = a fresh mirror over the SAME
+            # export on the SAME peer (the exporter owns the replica)
+            return ref
         addr = self._peer_addrs.get(replica_id)
         if addr is not None:
             return _PeerRef(addr)
@@ -462,6 +502,80 @@ class ServingFrontend:
         handle.connect(reset=reset)
         return handle
 
+    def _adopt_federation_peers(self) -> list:
+        """Dial each ``fabric.federation.peers`` frontend, run the
+        bootstrap hello (identity exchange + export discovery) and
+        build a :class:`FederatedHandle` router member per adopted
+        export. Typed peering refusals (self-peering, stale epoch)
+        raise — they are config bugs; an unreachable peer is logged
+        and skipped — edge frontends boot independently. Exports of
+        models this frontend does not serve are skipped: a request can
+        only route to pools its submit() validates."""
+        fed = self._federation
+        if fed is None or not fed.peers:
+            return []
+        from .fabric.federation import (FederationPeer, FederationRefused,
+                                        _ExportRef)
+        from .fabric.transport import FabricError
+
+        handles = []
+        known = set(self._models) if self._models else {"default"}
+        for addr in fed.peers:
+            peer = FederationPeer(addr, self.config.fabric,
+                                  frontend_id=self._federation_id,
+                                  epoch=self._federation_epoch)
+            try:
+                peer.connect()
+            except FederationRefused:
+                raise               # config/topology bug: loud
+            except (OSError, FabricError) as e:
+                logger.warning(f"federation peer {addr} unreachable at "
+                               f"boot ({e!r}); continuing without it")
+                continue
+            self._federation_peers.append(peer)
+            for exp in peer.exports:
+                mid = str(exp.get("model_id", "default"))
+                if mid not in known:
+                    logger.warning(
+                        f"federation peer {addr} exports replica "
+                        f"{exp.get('export')} of unknown model {mid!r}; "
+                        "skipping")
+                    continue
+                with self._fleet_lock:
+                    rid = self._next_replica_id
+                    self._next_replica_id += 1
+                    if self._models:
+                        self._replica_models[rid] = mid
+                ref = _ExportRef(addr, exp, peer)
+                self._federated_refs[rid] = ref
+                handles.append(self._build_federated(rid, ref))
+        return handles
+
+    def _build_federated(self, replica_id: int, ref,
+                         reset: bool = False):
+        """One FederatedHandle over a peer frontend's exported replica
+        — the boot path AND the supervisor's restart path. The evacuate
+        hand-back is ALWAYS wired (unlike plain remotes, where removal
+        sets it): the exporter's autoscaler can spontaneously evacuate
+        the shared replica, and those hand-backs must land in this
+        frontend's requeue path, not drop."""
+        from .fabric.federation import FederatedHandle
+
+        ft = self.config.fault_tolerance
+        handle = FederatedHandle(
+            replica_id, ref.address, self.config.fabric,
+            export=ref.export, frontend_id=self._federation_id,
+            epoch=self._federation_epoch, peer=ref.peer,
+            metrics=self.metrics, tracer=self.tracer,
+            recorder=self._replica_recorder, journal=self.journal,
+            on_failover=self._failover if ft.enabled else None,
+            on_handoff=self._handoff_remote)
+        handle._evac_handback = self._evacuate_handback
+        handle.connect(reset=reset)
+        if ref.peer is not None:
+            ref.peer.register(handle)
+        return handle
+
     def _build_replica(self, replica_id: int, engine) -> Replica:
         """One replica over ``engine`` with this frontend's full wiring —
         the constructor path AND the supervisor's restart path, so a
@@ -472,6 +586,12 @@ class ServingFrontend:
         if isinstance(engine, _PeerRef):
             return self._build_remote(replica_id, engine.address,
                                       reset=True)
+        from .fabric.federation import _ExportRef
+
+        if isinstance(engine, _ExportRef):
+            # federated slot restart: fresh mirror, same export (the
+            # peer ignores the reset bit — it owns the engine)
+            return self._build_federated(replica_id, engine, reset=True)
         # engine-level config blocks (weight/kv quant, prefix cache,
         # tier, admission) — the shared path also used by the fabric
         # replica server, so local and remote engines configure alike
@@ -502,6 +622,14 @@ class ServingFrontend:
                        on_handoff=(self._handoff if role == "prefill"
                                    else None),
                        journal=self.journal)
+
+    @property
+    def federation_address(self) -> Optional[str]:
+        """host:port of this frontend's federation listener (None when
+        federation is disabled) — what peers put in
+        ``fabric.federation.peers``."""
+        srv = self._federation_server
+        return srv.address if srv is not None else None
 
     @classmethod
     def from_engine_factory(cls, engine_factory: Callable[[int], object],
@@ -754,6 +882,14 @@ class ServingFrontend:
         decoding) or completed because nothing more was owed. False →
         the caller fails it terminally (retries exhausted, deadline
         passed, cancellation, or shutdown)."""
+        if getattr(req, "_federated", False) \
+                and self._federation_server is not None:
+            # federated mirror (docs/SERVING.md "Frontend federation"):
+            # the real stream and the retry budget live on the ADOPTING
+            # frontend — send the ordered failover marker back over the
+            # federation channel instead of requeueing into THIS
+            # frontend's admission queue
+            return self._federation_server.detach_failover(req)
         ft = self.config.fault_tolerance
         if self._closed or req.cancel_requested.is_set() or req.expired():
             return False
@@ -844,16 +980,20 @@ class ServingFrontend:
         return rid
 
     def _warmup_replica(self, rid: int, replica) -> None:
-        """Pre-populate a grown replica's prefix cache from the warmest
-        accepting local donor of its model pool (docs/SERVING.md "Fleet
-        KV locality"): the donor's hottest blocks are exported
-        device→host and scattered into the new engine before the router
-        can route to it, so the replica's first shared-prefix request
-        hits instead of paying full prefill. Remote donors are skipped
-        (their KV would need a new RPC — the status-stream digest is
-        advisory only) and everything is exception-isolated: warm-up
-        can delay a grow by at most ``warmup_timeout_s``, never fail
-        it."""
+        """Pre-populate a grown replica's prefix cache with the
+        FLEET-hottest blocks merged across ALL accepting local donors of
+        its model pool (docs/SERVING.md "Fleet KV locality"): every
+        donor exports its MRU-first blocks device→host, the per-donor
+        streams are interleaved by hotness rank (each donor's warmest
+        block before any donor's second-warmest), deduplicated by chain
+        key, capped at ``warmup_max_blocks``, and scattered into the new
+        engine before the router can route to it — so the replica's
+        first shared-prefix request hits instead of paying full prefill,
+        regardless of which sibling owned the prefix. Remote donors are
+        skipped (their KV would need a new RPC — the status-stream
+        digest is advisory only) and everything is exception-isolated:
+        warm-up can delay a grow by at most ``warmup_timeout_s``, never
+        fail it."""
         aff = self.config.affinity
         if not (aff.enabled and aff.warmup_enabled):
             return
@@ -865,7 +1005,7 @@ class ServingFrontend:
         self.metrics.gauge("replicas_warming").inc()
         try:
             mid = self._replica_models.get(rid, "default")
-            donor, warmth = None, 0
+            donors = []                 # (warmth, replica) — all of them
             for r in self.router.replicas:
                 if getattr(r, "is_remote", False) or not r.accepting:
                     continue
@@ -875,19 +1015,42 @@ class ServingFrontend:
                 if fn is None:
                     continue
                 w = len(fn(aff.digest_max_entries))
-                if w > warmth:
-                    donor, warmth = r, w
-            if donor is None:
+                if w > 0:
+                    donors.append((w, r))
+            if not donors:
                 return                  # whole fleet cold: nothing to copy
-            entries = donor.engine.export_prefix_blocks(
-                aff.warmup_max_blocks)
+            # warmest donor first so rank ties resolve toward the
+            # busiest cache; each donor exports at most the full budget
+            # (dedup below may discard shared prefixes)
+            donors.sort(key=lambda p: (-p[0], p[1].replica_id))
+            exports = []                # (donor_id, MRU-first entries)
+            for _, donor in donors:
+                if time.monotonic() - t0 > aff.warmup_timeout_s:
+                    break               # donors too slow: ship what we have
+                got = donor.engine.export_prefix_blocks(
+                    aff.warmup_max_blocks)
+                if got:
+                    exports.append((donor.replica_id, got))
+            # merge hottest-first: rank i of every donor before rank i+1
+            # of any, first exporter of a duplicate chain key wins
+            seen, entries, sources = set(), [], set()
+            for i in range(max((len(e) for _, e in exports), default=0)):
+                for donor_id, got in exports:
+                    if len(entries) >= aff.warmup_max_blocks:
+                        break
+                    if i < len(got) and got[i][0] not in seen:
+                        seen.add(got[i][0])
+                        entries.append(got[i])
+                        sources.add(donor_id)
+                if len(entries) >= aff.warmup_max_blocks:
+                    break
             if time.monotonic() - t0 > aff.warmup_timeout_s:
-                entries = []            # donor too slow: cold start
+                entries = []            # donors too slow: cold start
             blocks = imp(entries) if entries else 0
             warmup_s = time.monotonic() - t0
             self.metrics.histogram("replica_warmup_s").observe(warmup_s)
             self.journal.emit("replica_warmup", replica=rid,
-                              blocks=blocks, source=donor.replica_id,
+                              blocks=blocks, source=sorted(sources),
                               warmup_s=warmup_s)
         except Exception as e:
             logger.error(f"replica {rid} prefix warm-up failed: {e!r}")
@@ -1045,6 +1208,13 @@ class ServingFrontend:
         import on the destination when available, marked so the import
         side keeps it out of the disagg handoff counters — or settle it
         if cancel/deadline/shutdown already claimed it."""
+        if getattr(req, "_federated", False) \
+                and self._federation_server is not None:
+            # federated mirror: stream the exported KV back to the
+            # adopting frontend (its requeue path stages or re-prefills
+            # — lossless either way), never into this one's queue
+            self._federation_server.return_evacuated(req, payload)
+            return
         if (self._closed or req.cancel_requested.is_set()
                 or req.expired()):
             if req.cancel_requested.is_set():
@@ -1103,6 +1273,7 @@ class ServingFrontend:
                         r.outstanding_prefill_tokens,
                         r.outstanding_decode_tokens,
                         remote=bool(getattr(r, "is_remote", False)),
+                        federated=bool(getattr(r, "is_federated", False)),
                         model_id=getattr(r, "model_id", "default"))
             for r in self.router.replicas)
         burn = 0.0
@@ -1204,6 +1375,15 @@ class ServingFrontend:
             self.alerts.maybe_evaluate()
         self._maybe_journal_tier_pressure()
         self._refresh_admission_gauges()
+        if self._federation is not None:
+            # distinct live peer frontends, both directions (adopted
+            # FROM + connected TO this exporter) — identity-deduped so
+            # mutual peering counts each peer once
+            ids = {p.peer_id for p in self._federation_peers
+                   if p.alive and p.peer_id}
+            if self._federation_server is not None:
+                ids |= self._federation_server.live_peer_ids()
+            self.metrics.gauge("federation_peers").set(len(ids))
 
     def _refresh_admission_gauges(self) -> None:
         """Sum the fleet's reservation shortfall and parked-sequence
@@ -1543,3 +1723,11 @@ class ServingFrontend:
             self.metrics.counter("requests_shed").inc()
         self.router.stop(drain=drain,
                          timeout=max(1.0, deadline - time.monotonic()))
+        # federation teardown LAST: in-flight federated mirrors on the
+        # exported replicas were settled by the router stop above, and
+        # closing the bootstrap connections is what signals peer_lost
+        # to the adopters
+        if self._federation_server is not None:
+            self._federation_server.stop()
+        for peer in self._federation_peers:
+            peer.close()

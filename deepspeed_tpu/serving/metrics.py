@@ -427,7 +427,11 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # a share-capped one) held; fleet tokens-saved = predicted
               # prefill tokens the winning credits covered
               "router_affinity_hits", "router_affinity_misses",
-              "prefix_tokens_saved_fleet"):
+              "prefix_tokens_saved_fleet",
+              # frontend federation (docs/SERVING.md "Frontend
+              # federation"): requests this frontend assigned onto a
+              # peer's exported replica
+              "requests_federated"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -480,7 +484,12 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # replicas currently inside the grow path's prefix-cache
               # warm-up; the trend-projected queue depth the predictive
               # autoscaler acts on (0 until the window has history)
-              "replicas_warming", "predicted_load"):
+              "replicas_warming", "predicted_load",
+              # frontend federation (docs/SERVING.md "Frontend
+              # federation"): live peer frontends — connected peers on
+              # the exporting side, peers with >= 1 live adopted
+              # export on the adopting side
+              "federation_peers"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md
@@ -500,7 +509,11 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               "rpc_call_s",
               # grow-path prefix-cache warm-up wall time, one sample per
               # grown replica (docs/SERVING.md "Fleet KV locality")
-              "replica_warmup_s"):
+              "replica_warmup_s",
+              # frontend federation: per-RPC wall time against peer
+              # frontends (hello/assign/evacuate over an export
+              # channel) — the cross-frontend transport-overhead signal
+              "peer_rpc_s"):
         reg.histogram(h, DEFAULT_LATENCY_BUCKETS)
     # RankedLock debug-mode hold times (docs/CONCURRENCY.md): zero
     # samples unless enable_lock_debug() attached this registry

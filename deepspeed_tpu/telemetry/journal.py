@@ -96,6 +96,13 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     # its replica server (supervisor restart or reconnect)
     "replica_disconnected": frozenset({"replica", "reason"}),
     "replica_reconnected": frozenset({"replica"}),
+    # frontend federation (docs/SERVING.md "Frontend federation"): a
+    # peer frontend's hello was accepted / a peer connection died (its
+    # federated in-flight work fails over on the ADOPTING side) / one
+    # local replica was bound to a peer's export channel
+    "peer_connected": frozenset({"peer", "epoch"}),
+    "peer_lost": frozenset({"peer", "reason"}),
+    "replica_exported": frozenset({"replica", "peer"}),
     # multi-tenant serving (docs/SERVING.md "Multi-model & multi-tenant
     # serving"): a tenant crossed into throttled state — its sliding-
     # window dispatch rate exceeded token_rate, or a KV budget refusal

@@ -53,6 +53,7 @@ LOCK_RANKS = {
     "serving.tenancy": 65,         # tenant ledger (quota/fair-share)
     "serving.replica": 70,         # per-replica delivery/accounting
     "serving.fabric.remote": 72,   # remote-handle mirror/accounting
+    "serving.fabric.federation": 73,   # federation-server peer/export tables
     "serving.fabric.server": 74,   # replica-server request table
     "serving.fabric.transport": 76,    # RPC pending-call table
     "serving.handoff": 80,         # KV staging budget

@@ -106,6 +106,10 @@ _HIGHER_IS_BETTER = (
     # affinity phase: picks the router steered by digest overlap —
     # fewer means the locality signal stopped reaching the pick path
     "affinity_hits",
+    # federation phase: requests the adopter actually routed to a peer
+    # frontend's export — zero means the shared pool collapsed to
+    # local-only and the phase's parity went vacuous
+    "requests_federated",
 )
 
 

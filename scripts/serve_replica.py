@@ -26,6 +26,13 @@ for a frontend to adopt as a :class:`RemoteHandle` replica.
                                     # hello — a frontend adopting this
                                     # replica under a DIFFERENT model
                                     # name refuses it (ModelMismatch)
+      "mesh":       null,           # OR {axis: size, ...} (e.g.
+                                    # {"tensor": 4}) — the engine is
+                                    # built over a MeshTopology spanning
+                                    # this host's devices; -1 means "all
+                                    # remaining". Too few local devices
+                                    # aborts boot with a descriptive
+                                    # required-vs-available error
       "serving":    {... ServingConfig dict (engine blocks, speculative,
                       disaggregation/handoff chunking, faults...) ...}
     }
@@ -77,6 +84,23 @@ def main(argv=None) -> int:
     from deepspeed_tpu.serving.fabric.server import ReplicaServer
     from deepspeed_tpu.serving.fabric.transport import advertised_address
 
+    mesh = None
+    if spec.get("mesh"):
+        from deepspeed_tpu.parallel.topology import MeshTopology
+        sizes = {str(k): int(v) for k, v in dict(spec["mesh"]).items()}
+        need = 1
+        for v in sizes.values():
+            if v != -1:
+                need *= v
+        have = len(jax.devices())
+        if have < need or have % max(need, 1):
+            print(f"serve_replica: mesh spec {sizes} requires "
+                  f"{'a multiple of ' if -1 in sizes.values() else ''}"
+                  f"{need} device(s) but this host has {have}: "
+                  f"{[str(d) for d in jax.devices()]}", file=sys.stderr)
+            return 2
+        mesh = MeshTopology.build(**sizes)
+
     model = CausalLM(TransformerConfig(**spec["model"]))
     if spec.get("checkpoint"):
         from deepspeed_tpu.runtime.checkpointing import load_params_for_model
@@ -87,7 +111,8 @@ def main(argv=None) -> int:
     def engine_factory():
         return InferenceEngineV2(
             model, params=params,
-            config=RaggedInferenceEngineConfig(**spec.get("engine", {})))
+            config=RaggedInferenceEngineConfig(**spec.get("engine", {})),
+            mesh=mesh)
 
     config = ServingConfig(**spec.get("serving", {}))
     server = ReplicaServer(engine_factory, config, listen=args.listen,

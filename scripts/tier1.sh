@@ -64,7 +64,15 @@
 #                  from digest warm-up, the predictive controller's
 #                  first grow must land strictly before the watermark
 #                  baseline's without added flapping, and greedy parity
-#                  + affinity-disabled byte-parity are asserted) — wires
+#                  + affinity-disabled byte-parity are asserted,
+#                  or TIER1_PHASE=federation for the frontend-federation
+#                  phase — a two-frontend shared pool (exporter +
+#                  adopter) must match the standalone frontend
+#                  byte-for-byte with requests actually federated,
+#                  tearing the exporter down mid-decode must fail every
+#                  federated stream over to the adopter's local replica
+#                  byte-losslessly (recovery time stamped), and
+#                  federation-disabled byte-parity is asserted) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

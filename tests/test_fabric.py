@@ -1106,3 +1106,194 @@ class TestFabricPrefixDigest:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+# ================================================ digest delta stream
+class TestDigestDeltas:
+    """ISSUE 18: the status stream sends digest add/evict DELTAS under a
+    monotonic epoch instead of a full snapshot every tick (full snapshot
+    on connect/reset) — wire bytes scale with cache churn, not
+    ``digest_max_entries``. Back-compat both ways, the PR 17
+    optional-field idiom: an old SERVER keeps sending full snapshots
+    (always decoded, replace outright); an old CLIENT never advertises
+    ``digest_deltas`` and keeps receiving full snapshots."""
+
+    def _handle(self):
+        from deepspeed_tpu.serving.fabric.remote import RemoteHandle
+
+        return RemoteHandle(1, "127.0.0.1:1",
+                            fabric_cfg([]).fabric)
+
+    @staticmethod
+    def _status(**fields):
+        ev = {"t": "ev", "ev": "status", "state": "healthy",
+              "thread_alive": True, "occupancy": {}, "param_stats": {},
+              "tier_stats": {}, "counters": {}}
+        ev.update(fields)
+        return ev
+
+    def test_stream_encoder_full_then_deltas(self):
+        from deepspeed_tpu.serving.fabric.server import DigestStream
+
+        ds = DigestStream()
+        ev = {}
+        ds.stamp(ev, {3, 1, 2}, deltas=True)
+        assert ev == {"prefix_digest": [1, 2, 3], "digest_epoch": 0}
+        ev = {}
+        ds.stamp(ev, {3, 1, 2}, deltas=True)
+        assert ev == {}, "no churn must send nothing"
+        ev = {}
+        ds.stamp(ev, {2, 3, 4, 5}, deltas=True)
+        assert ev == {"digest_epoch": 1, "digest_add": [4, 5],
+                      "digest_del": [1]}
+        ds.reset()
+        ev = {}
+        ds.stamp(ev, {7}, deltas=True)
+        assert ev == {"prefix_digest": [7], "digest_epoch": 0}, \
+            "reset must restart with a full snapshot"
+
+    def test_stream_encoder_non_opted_client_gets_pr17_shape(self):
+        from deepspeed_tpu.serving.fabric.server import DigestStream
+
+        ds = DigestStream()
+        for digest in ({1, 2}, {1, 2}, {2, 3}):
+            ev = {}
+            ds.stamp(ev, digest, deltas=False)
+            assert ev == {"prefix_digest": sorted(digest)}, \
+                "a non-opted client must get a full snapshot every tick"
+
+    def test_client_applies_deltas_in_sequence(self):
+        h = self._handle()
+        h._ev_status(self._status(prefix_digest=[1, 2, 3],
+                                  digest_epoch=0))
+        assert h.prefix_digest() == frozenset({1, 2, 3})
+        h._ev_status(self._status(digest_epoch=1, digest_add=[4],
+                                  digest_del=[1]))
+        assert h.prefix_digest() == frozenset({2, 3, 4})
+        h._ev_status(self._status())        # digest-less tick: keep
+        assert h.prefix_digest() == frozenset({2, 3, 4})
+        h._ev_status(self._status(digest_epoch=2, digest_del=[2]))
+        assert h.prefix_digest() == frozenset({3, 4})
+
+    def test_client_drops_to_blind_on_sequence_gap(self):
+        h = self._handle()
+        h._ev_status(self._status(prefix_digest=[1, 2], digest_epoch=0))
+        h._ev_status(self._status(digest_epoch=5, digest_add=[9]))
+        assert h.prefix_digest() == frozenset(), \
+            "an out-of-sequence delta must drop to cache-blind"
+        # the epoch resynced: the NEXT in-sequence delta rebuilds warmth
+        h._ev_status(self._status(digest_epoch=6, digest_add=[9]))
+        assert h.prefix_digest() == frozenset({9})
+
+    def test_old_server_full_snapshots_still_decode(self):
+        """An old peer never numbers its frames: every full snapshot
+        replaces outright, and a delta can never (mis)apply."""
+        h = self._handle()
+        h._ev_status(self._status(prefix_digest=[1, 2]))    # no epoch
+        assert h.prefix_digest() == frozenset({1, 2})
+        assert h._digest_epoch is None
+        h._ev_status(self._status(prefix_digest=[3]))
+        assert h.prefix_digest() == frozenset({3})
+
+    def test_new_client_hello_advertises_deltas(self):
+        assert self._handle()._hello_payload(False)["digest_deltas"] \
+            is True
+
+    def test_end_to_end_deltas_over_real_server(self):
+        """Live ReplicaServer + RemoteHandle with affinity on: the
+        digest arrives (via the delta stream — the handle advertises
+        ``digest_deltas``) and matches what a full snapshot would say."""
+        scfg = ServingConfig(prefix_cache={"enabled": True},
+                             affinity={"enabled": True,
+                                       "refresh_interval_s": 0.05})
+        with _Servers(1, server_config=scfg) as srv:
+            fe = ServingFrontend([], fabric_cfg(
+                srv.peers,
+                affinity={"enabled": True, "refresh_interval_s": 0.05}))
+            try:
+                sys_prompt = prompts(1, 41, lo=40, hi=41)[0]
+                ps = [sys_prompt + p for p in prompts(3, 42, lo=4, hi=8)]
+                for p in ps:
+                    run_fleet(fe, [p], 4)
+                remote = fe.router.replicas[0]
+                deadline = time.monotonic() + 15
+                while not remote.prefix_digest() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                assert remote.prefix_digest(), \
+                    "digest never arrived over the delta stream"
+                assert remote._digest_epoch is not None, \
+                    "a delta-advertising client must get numbered frames"
+                want = srv.servers[0].replica.prefix_digest(
+                    scfg.affinity.digest_max_entries)
+                deadline = time.monotonic() + 10
+                while remote.prefix_digest() != want \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                assert remote.prefix_digest() == want, \
+                    "delta-reassembled digest diverged from the source"
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+
+# ==================================================== TP mesh replicas
+class TestSubprocessMesh:
+    """ISSUE 18 satellite: the serve_replica.py spec's ``mesh:`` field
+    builds the hosted engine over a TP MeshTopology slice of the host's
+    devices (arxiv 2605.25645's replica-pools-as-mesh-slices shape)."""
+
+    def _spawn(self, tmp_path, mesh, devices):
+        spec = {"model": MODEL_KW, "engine": ENGINE_KW, "seed": SEED,
+                "mesh": mesh, "serving": {}}
+        spec_path = tmp_path / "mesh_spec.json"
+        spec_path.write_text(json.dumps(spec))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count="
+                             f"{devices}")
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "serve_replica.py"),
+             "--spec", str(spec_path), "--listen", "127.0.0.1:0",
+             "--loopback-ok"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+
+    def test_too_few_devices_is_descriptive_error(self, tmp_path):
+        proc = self._spawn(tmp_path, {"tensor": 64}, devices=2)
+        try:
+            _, err = proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
+        assert proc.returncode == 2, err
+        assert "requires" in err and "64" in err and "2" in err, \
+            f"error must say required vs available: {err!r}"
+
+    @pytest.mark.skipif(
+        __import__("jax").device_count() < 2,
+        reason="TP mesh replica needs >= 2 devices")
+    def test_tp2_mesh_replica_decode_parity(self, tmp_path):
+        """A tensor=2 mesh replica server must serve byte-identical
+        greedy streams to the unsharded in-process fleet."""
+        proc = self._spawn(tmp_path, {"tensor": 2, "data": 1}, devices=2)
+        try:
+            line = proc.stdout.readline()       # blocks until jax is up
+            assert line.startswith("FABRIC_LISTENING "), \
+                (line, proc.stderr.read() if proc.poll() is not None
+                 else "")
+            addr = line.split()[1]
+            ps = prompts(4, 43)
+            ref = local_reference(ps, 5)
+            fe = ServingFrontend([], fabric_cfg([addr], heartbeat_s=1.0))
+            try:
+                got = run_fleet(fe, ps, 5, timeout=300)
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+            assert got == ref, "TP-mesh replica broke greedy byte-parity"
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()

@@ -620,6 +620,22 @@ def test_bench_schema_validator():
                         "predictive_no_flap": True,
                         "greedy_parity": True, "disabled_parity": True,
                         "kv_occupancy": dict(occ)}
+    good["federation"] = {"frontends": 2, "n_requests": 8,
+                          "prompt_len": 24, "max_new": 8,
+                          "exported_replicas": 1,
+                          "requests_federated": 4,
+                          "standalone_p50_ttft_ms": 3379.3,
+                          "standalone_p95_ttft_ms": 3647.8,
+                          "federated_p50_ttft_ms": 3271.0,
+                          "federated_p95_ttft_ms": 3568.3,
+                          "peer_rpc_calls": 5, "peer_rpc_p50_ms": 0.6,
+                          "peer_rpc_p95_ms": 1.0,
+                          "kill_n_requests": 4, "kill_max_new": 96,
+                          "requests_failed_over": 2,
+                          "failover_recovery_s": 0.268,
+                          "parity": True, "kill_parity": True,
+                          "disabled_parity": True, "zero_wedges": True,
+                          "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
     # multitenant typed checks: bool-for-int rejected, missing named
     bad_mt = dict(good)
@@ -637,6 +653,14 @@ def test_bench_schema_validator():
     assert any("affinity.share_cap_ok" in p for p in problems_af)
     assert any("affinity.warmup_first_hit_ok: missing" in p
                for p in problems_af)
+    # federation typed checks: bool-for-int rejected, missing named
+    bad_fd = dict(good)
+    bad_fd["federation"] = {"requests_federated": True, "kill_parity": 1}
+    problems_fd = bench.validate_serving_schema(bad_fd)
+    assert any("federation.requests_federated" in p for p in problems_fd)
+    assert any("federation.kill_parity" in p for p in problems_fd)
+    assert any("federation.failover_recovery_s: missing" in p
+               for p in problems_fd)
     # fabric typed checks: bool-for-int rejected, missing fields named
     bad_fb = dict(good)
     bad_fb["fabric"] = {"rpc_calls": True, "parity": 1}
