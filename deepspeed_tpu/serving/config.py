@@ -598,6 +598,23 @@ class FaultToleranceConfig(DSConfigModel):
     brownout_threshold: float = 0.0
 
 
+class ObservabilityConfig(DSConfigModel):
+    """``observability: {...}`` fleet ops surface (docs/OBSERVABILITY.md
+    "Fleet observability"): a stdlib ``http.server`` scrape endpoint on
+    the frontend serving ``/metrics`` (Prometheus text), ``/health``
+    (the fleet health report as JSON), ``/trace`` (the merged
+    cross-process Chrome trace), and ``/dump`` (the fleet debug dump) —
+    the surface ``scripts/fleetctl.py`` drives. Disabled (the default)
+    binds nothing and builds nothing: byte-for-byte the endpoint-less
+    stack."""
+
+    enabled: bool = False
+    # host:port to bind; port 0 picks a free port (the frontend
+    # publishes the resolved address as ``observability_address`` and
+    # journals it as ``obs_listen``)
+    listen: str = "127.0.0.1:0"
+
+
 class FaultsConfig(DSConfigModel):
     """``faults: {...}`` TEST-ONLY deterministic fault injection
     (docs/CONFIG.md, serving/faults.py): a seeded schedule of replica
@@ -847,6 +864,11 @@ class ServingConfig(DSConfigModel):
     # serving"): adopt replica server processes as RemoteHandle
     # replicas; disabled = the in-process stack byte for byte
     fabric: FabricConfig = Field(default_factory=FabricConfig)
+    # fleet ops surface (docs/OBSERVABILITY.md "Fleet observability"):
+    # /metrics, /health, /trace, /dump over stdlib http.server;
+    # disabled = no listener, byte-for-byte the endpoint-less stack
+    observability: ObservabilityConfig = Field(
+        default_factory=ObservabilityConfig)
     # test-only deterministic fault injection (chaos suite / bench chaos
     # phase); disabled = no injection hooks anywhere on the hot path
     faults: FaultsConfig = Field(default_factory=FaultsConfig)

@@ -235,6 +235,10 @@ def request_to_wire(req) -> Dict[str, Any]:
         "generated_tokens": [int(t) for t in req.generated_tokens],
         "attempts": int(req.attempts),
         "no_prefill": bool(req.no_prefill),
+        # trace context (docs/OBSERVABILITY.md "Fleet observability"):
+        # the chain name the server's spans must join — another
+        # optional field, same compat story as the tenancy labels
+        "trace_id": req.trace_id,
     }
 
 
@@ -264,6 +268,7 @@ def request_from_wire(d: Dict[str, Any]):
         req._events.get_nowait()
     req.attempts = int(d.get("attempts", 1))
     req.no_prefill = bool(d.get("no_prefill", False))
+    req.trace_id = d.get("trace_id")
     return req
 
 

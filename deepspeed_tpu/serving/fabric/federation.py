@@ -52,7 +52,8 @@ from ..request import DoneEvent, FinishReason, RequestState
 from .codec import (CODEC_VERSION, FrameTooLarge, payload_chunks,
                     payload_from_chunks, request_from_wire)
 from .remote import RemoteHandle
-from .server import STATUS_INTERVAL_S, DigestStream
+from .server import (JOURNAL_EVENTS_PER_STATUS, STATUS_INTERVAL_S,
+                     DigestStream)
 from .transport import Connection, FabricError, dial, parse_address
 
 #: typed hello-refusal markers a retry can never fix — the connect
@@ -279,6 +280,11 @@ class _Channel:
         self._lock = RankedLock("serving.fabric.federation")
         self.reqs: Dict[int, object] = {}
         self.stage_rx: Dict[int, list] = {}
+        # journal forwarding cursor (docs/OBSERVABILITY.md "Fleet
+        # observability"): touched only by the server's status thread;
+        # starts at 0 so a fresh channel replays the exporter's ring —
+        # the adopter's FleetJournal dedupes by per-source seq
+        self.journal_fwd_seq = 0
 
 
 class FederationServer:
@@ -742,6 +748,24 @@ class FederationServer:
                             ch.digest.stamp(ev,
                                             fn(aff.digest_max_entries),
                                             ch.deltas)
+                    # fleet observability: federation peers forward the
+                    # exporting frontend's journal the same way replica
+                    # servers do (OPTIONAL status field, bounded per
+                    # frame, per-channel cursor). Channels to one peer
+                    # each replay independently — the adopter's
+                    # FleetJournal dedupes by per-source seq, so the
+                    # fleet view stays exactly-once. Spans are NOT
+                    # forwarded here: the exporter publishes its own
+                    # traces; only the shared-replica server side owns
+                    # cross-process request spans.
+                    jev = self.journal.events(
+                        since_seq=ch.journal_fwd_seq)[
+                            :JOURNAL_EVENTS_PER_STATUS]
+                    if jev:
+                        ev["journal"] = {
+                            "source": f"frontend-{self.frontend_id}",
+                            "events": jev}
+                        ch.journal_fwd_seq = int(jev[-1]["seq"])
                     self._ch_send(ch, ev)
                 except Exception as e:  # pragma: no cover - defensive
                     logger.error(f"federation server {self.frontend_id}: "

@@ -33,6 +33,7 @@ import random
 import time
 from typing import Callable, Dict, Optional
 
+from ...telemetry.fleet import ingest_remote_spans, source_id_offset
 from ...utils.locks import RankedLock
 from ...utils.logging import logger
 from ...utils.restart import RestartPolicy
@@ -41,6 +42,10 @@ from ..request import FinishReason, RequestState, ServingRequest
 from .codec import CODEC_VERSION, FrameTooLarge, ModelMismatch, \
     payload_chunks, payload_from_chunks, request_to_wire
 from .transport import ConnectionLost, FabricError, dial
+
+#: default byte bound for the ``dump`` RPC response (well under the
+#: 64 MiB frame ceiling; callers may lower it per pull)
+DUMP_MAX_BYTES = 4 * 1024 * 1024
 
 class _ModelCfgFacade:
     def __init__(self, max_seq_len: int):
@@ -152,7 +157,7 @@ class RemoteHandle:
 
     def __init__(self, replica_id: int, address: str, fabric_config, *,
                  role: str = "mixed", metrics=None, tracer=None,
-                 recorder=None, journal=None,
+                 recorder=None, journal=None, fleet=None,
                  on_failover: Optional[Callable] = None,
                  on_handoff: Optional[Callable] = None,
                  model_id: str = "default"):
@@ -170,6 +175,22 @@ class RemoteHandle:
         self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.recorder = recorder
         self.journal = journal
+        # fleet observability (docs/OBSERVABILITY.md "Fleet
+        # observability"): the frontend's FleetJournal, fed by the
+        # journal batches the server's status stream carries; None in a
+        # bare handle (events are then dropped, never an error)
+        self.fleet = fleet
+        # remote span ids land in this handle's private id range; the
+        # hello fills in the server's identity for source/pid stamping
+        self._span_offset = source_id_offset(int(replica_id))
+        self._source = f"replica-{replica_id}@{address}"
+        self._server_pid: Optional[int] = None
+        # last-write-wins publications from the transport reader (the
+        # _last_occupancy idiom): status recency + rpc accounting for
+        # the fleet ops surface
+        self._last_status_t = 0.0
+        self._rpc_calls = 0
+        self._rpc_time_s = 0.0
         self._on_failover = on_failover
         # (req, payload, replica_id) — the frontend's remote-handoff
         # staging entry point (export already ran server-side)
@@ -229,6 +250,11 @@ class RemoteHandle:
             "model_id": self.model_id,
             "max_frame_bytes": int(self.fabric.max_frame_bytes),
             "digest_deltas": True,
+            # a tracing frontend asks the server to trace too (the
+            # propagated req-<uid> chains need server-side spans); old
+            # servers ignore the flag, a non-tracing frontend never
+            # sets it — the byte-parity story stays intact
+            "telemetry": bool(self.tracer.enabled),
             "reset": bool(reset)}
 
     def connect(self, reset: bool = False) -> None:
@@ -298,6 +324,13 @@ class RemoteHandle:
         self.engine = _EngineFacade(self, info)
         self._server_thread_alive = True
         self._digest_epoch = None   # fresh stream: next digest is full
+        # server identity for span/journal source tagging (older servers
+        # report neither; the address-based fallback stays)
+        pid = info.get("pid")
+        self._server_pid = int(pid) if pid is not None else None
+        src = info.get("source")
+        if src:
+            self._source = str(src)
         # a reset connect is the supervisor-restart path: this handle is
         # fresh, but the PEER is being re-attached after a disconnect —
         # journal the recovery half of replica_disconnected
@@ -331,10 +364,12 @@ class RemoteHandle:
                              timeout_s=(timeout_s if timeout_s is not None
                                         else self.fabric.rpc_timeout_s))
         finally:
+            dt = time.monotonic() - t0
+            self._rpc_calls += 1
+            self._rpc_time_s += dt
             if self.metrics is not None:
                 self.metrics.gauge("rpc_inflight").dec()
-                self.metrics.histogram("rpc_call_s").observe(
-                    time.monotonic() - t0)
+                self.metrics.histogram("rpc_call_s").observe(dt)
 
     def _notify(self, msg: dict) -> bool:
         conn = self._conn
@@ -446,10 +481,17 @@ class RemoteHandle:
                         req._charged_prefill = len(req.resume_prompt())
                         self._out_prefill += req._charged_prefill
                     break
+            rpc_span = req.spans.get("rpc") if req.spans is not None \
+                else None
             ok = bool(self._call("assign", {
                 "req": request_to_wire(req),
                 "staged_meta": staged_meta,
-                "trace": req.trace_id is not None}))
+                "trace": req.trace_id is not None,
+                # the frontend-local id the server's root span parents
+                # onto (docs/OBSERVABILITY.md "Fleet observability") —
+                # optional field, old servers ignore it
+                "trace_parent": (rpc_span.span_id
+                                 if rpc_span is not None else None)}))
             rpc_failed = False
         except FabricError as e:
             logger.warning(f"fabric replica {self.replica_id}: assign of "
@@ -725,6 +767,36 @@ class RemoteHandle:
                 if v > last:
                     self.metrics.counter(name).inc(v - last)
                 self._counters_last[name] = v
+        # fleet observability (docs/OBSERVABILITY.md "Fleet
+        # observability"): the status stream's OPTIONAL span/journal
+        # deltas. Spans rebase onto the local clock via the transport's
+        # heartbeat offset and shift into this handle's id range;
+        # journal batches land in the frontend's FleetJournal, which
+        # dedupes by per-source seq (exactly-once across reconnect
+        # replays).
+        spans = msg.get("spans")
+        if spans and self.tracer.enabled:
+            conn = self._conn
+            n = ingest_remote_spans(
+                self.tracer, spans, offset=self._span_offset,
+                clock_offset_s=(conn.clock_offset_s
+                                if conn is not None else 0.0),
+                source=self._source, pid=self._server_pid)
+            if n and self.metrics is not None:
+                self.metrics.counter("spans_forwarded").inc(n)
+        j = msg.get("journal")
+        if j and self.fleet is not None:
+            accepted, dropped = self.fleet.ingest(
+                str(j.get("source") or self._source),
+                j.get("events") or ())
+            if self.metrics is not None:
+                if accepted:
+                    self.metrics.counter(
+                        "journal_events_forwarded").inc(accepted)
+                if dropped:
+                    self.metrics.counter(
+                        "journal_events_dropped").inc(dropped)
+        self._last_status_t = time.monotonic()
         srv_state = msg.get("state")
         if srv_state == ReplicaState.DEAD.value:
             self._mark_dead("server replica died")
@@ -807,6 +879,44 @@ class RemoteHandle:
             self._mark_dead(conn.close_reason if conn is not None
                             and conn.close_reason else "transport lost")
         return self.state
+
+    # -------------------------------------------------------- observability
+    def pull_dump(self, max_bytes: int = DUMP_MAX_BYTES) -> Optional[dict]:
+        """Pull the server's bounded flight record over the ``dump`` RPC
+        (``{"source", "role", "pid", "record", "trimmed"}``); None when
+        the call fails or the peer predates the method — a fleet dump
+        degrades to fewer processes, never to an error."""
+        try:
+            out = self._call("dump", {"max_bytes": int(max_bytes)})
+            return out if isinstance(out, dict) else None
+        except FabricError as e:
+            logger.warning(f"fabric replica {self.replica_id}: dump RPC "
+                           f"failed ({e!r})")
+            return None
+
+    def ops_status(self, now: Optional[float] = None) -> dict:
+        """One health_report() row for this remote: connection health,
+        rpc latency, clock offset, status recency, forwarded occupancy
+        (docs/OBSERVABILITY.md "Fleet observability")."""
+        now = time.monotonic() if now is None else now
+        conn = self._conn
+        return {
+            "replica": int(self.replica_id), "address": self.address,
+            "role": self.role, "state": self.state.value,
+            "source": self._source, "pid": self._server_pid,
+            "connected": bool(conn is not None and conn.alive),
+            "clock_offset_s": (conn.clock_offset_s
+                               if conn is not None else 0.0),
+            "clock_offset_rtt_s": (conn.clock_offset_rtt_s
+                                   if conn is not None else None),
+            "last_status_age_s": (now - self._last_status_t
+                                  if self._last_status_t else None),
+            "rpc_calls": int(self._rpc_calls),
+            "rpc_avg_s": (self._rpc_time_s / self._rpc_calls
+                          if self._rpc_calls else 0.0),
+            "active": self.active_count,
+            "occupancy": dict(self._last_occupancy),
+        }
 
     # ----------------------------------------------------------- lifecycle
     def drain(self) -> None:

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ...telemetry.journal import OpsJournal
+from ...telemetry.tracer import NOOP_TRACER
 from ...utils.locks import RankedLock
 from ...utils.logging import logger
 from ..metrics import serving_metrics
@@ -45,12 +47,19 @@ from ..replica import Replica, ReplicaState
 from ..request import FinishReason, RequestState, DoneEvent
 from .codec import (CODEC_VERSION, FrameTooLarge, payload_chunks,
                     payload_from_chunks, request_from_wire)
-from .remote import RemoteHandle
+from .remote import DUMP_MAX_BYTES, RemoteHandle
 from .transport import Connection, FabricError, parse_address
 
 #: status cadence — also the server->client liveness signal, so it must
 #: undercut the client's stale window (STALE_HEARTBEATS x heartbeat_s)
 STATUS_INTERVAL_S = 0.25
+
+#: telemetry forwarding bounds per status frame (docs/OBSERVABILITY.md
+#: "Fleet observability"): the status stream is the liveness signal —
+#: it must stay small and regular, so span/journal deltas are capped and
+#: the remainder rides the next tick
+SPANS_PER_STATUS = 256
+JOURNAL_EVENTS_PER_STATUS = 64
 
 
 class DigestStream:
@@ -137,6 +146,24 @@ class ReplicaServer:
         # server-private registry: the replica records into it and the
         # status loop forwards the engine-level counters as deltas
         self.registry = serving_metrics()
+        # fleet observability (docs/OBSERVABILITY.md "Fleet
+        # observability"): the server's own journal is ALWAYS on (events
+        # are rare and tiny; they forward on the status stream tagged
+        # with this source), while the tracer follows the server's
+        # telemetry config — or the frontend's, via the hello's
+        # telemetry flag (_maybe_enable_telemetry)
+        self.source = f"replica-{self.replica_id}@{socket.gethostname()}"
+        self.journal = OpsJournal(source=self.source)
+        tel = getattr(self.config, "telemetry", None)
+        self.tracer = tel.build_tracer() if tel is not None else NOOP_TRACER
+        self.recorder = (tel.build_recorder(
+            self.tracer, self.registry, role=f"replica-{self.replica_id}")
+            if tel is not None else None)
+        # per-connection forwarding cursors (hello resets them: a new
+        # frontend gets the journal ring replayed — the client dedupes
+        # by seq — but NOT stale spans from before it connected)
+        self._span_cursor = 0
+        self._journal_fwd_seq = 0
         self._stop = threading.Event()
         host, port = parse_address(listen)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -246,8 +273,29 @@ class ReplicaServer:
             decode_reserve_tokens=(dis.decode_reserve_tokens
                                    if dis is not None else 0),
             on_handoff=(self._on_replica_handoff if role == "prefill"
-                        else None))
+                        else None),
+            tracer=self.tracer, recorder=self.recorder,
+            journal=self.journal)
         self.replica.start()
+
+    def _maybe_enable_telemetry(self, want: bool) -> bool:
+        """Hello-time telemetry upgrade: a tracing frontend lights up a
+        server that booted without its own ``telemetry:`` block (the
+        propagated spans have to come from somewhere). One-way — a
+        later non-tracing frontend doesn't darken an enabled server —
+        and a no-op (so byte-parity with the pre-observability stack
+        holds) when neither side asked. Returns True when the tracer
+        changed, which forces a replica rebuild to rebind it."""
+        if not want or self.tracer.enabled:
+            return False
+        from ...telemetry.config import TelemetryConfig
+
+        tel = getattr(self.config, "telemetry", None) or TelemetryConfig()
+        tel = tel.model_copy(update={"enabled": True})
+        self.tracer = tel.build_tracer()
+        self.recorder = tel.build_recorder(
+            self.tracer, self.registry, role=f"replica-{self.replica_id}")
+        return True
 
     def _on_replica_failover(self, req) -> bool:
         """Replica-death hand-back: mark the request so its pump sends
@@ -311,7 +359,8 @@ class ReplicaServer:
         try:
             handler = {"hello": self._rpc_hello,
                        "assign": self._rpc_assign,
-                       "evacuate": self._rpc_evacuate}.get(method)
+                       "evacuate": self._rpc_evacuate,
+                       "dump": self._rpc_dump}.get(method)
             if handler is None:
                 conn.respond(call_id, error=f"unknown method {method!r}")
                 return
@@ -347,16 +396,33 @@ class ReplicaServer:
         # advertised keeps getting the full-snapshot wire shape
         self._digest_deltas = bool(p.get("digest_deltas", False))
         self._digest.reset()
+        tel_changed = self._maybe_enable_telemetry(
+            bool(p.get("telemetry", False)))
+        # forwarding cursors restart with the connection: the journal
+        # ring replays (the client dedupes by per-source seq), spans
+        # start from now
+        self._span_cursor = self.tracer.completed_total
+        self._journal_fwd_seq = 0
         role = str(p.get("role", "mixed"))
         reset = bool(p.get("reset", False))
         if (self.replica is None or reset or self._role != role
+                or tel_changed
                 or self.replica.state in (ReplicaState.DEAD,
                                           ReplicaState.STOPPED)):
             self._build_replica(role, fresh_engine=reset)
+        # fleet-visible connection record: rides the status stream into
+        # the frontend's FleetJournal, so every server process has at
+        # least one journaled event tagged with its source
+        try:
+            self.journal.emit("server_hello", replica=self.replica_id,
+                              role=self._role, reset=reset)
+        except Exception:           # journal must never kill serving
+            pass
         eng = self._engine
         return {"replica_id": self.replica_id, "role": self._role,
                 "codec_version": CODEC_VERSION, "pid": os.getpid(),
-                "model_id": self.model_id,
+                "model_id": self.model_id, "source": self.source,
+                "telemetry": self.tracer.enabled,
                 "max_frame_bytes": int(self.max_frame_bytes),
                 "max_seq_len": int(eng.model.cfg.max_seq_len),
                 "max_seats": int(eng.config.max_ragged_sequence_count),
@@ -366,6 +432,19 @@ class ReplicaServer:
         if self.replica is None:
             raise RuntimeError("assign before hello")
         req = request_from_wire(p["req"])
+        if req.trace_id is not None and self.tracer.enabled:
+            # propagated trace context: the server-side root span joins
+            # the frontend's req-<uid> chain. remote_parent_id is the
+            # FRONTEND-local id of the rpc span that carried this assign
+            # — fleet.ingest_remote_spans re-parents on it verbatim,
+            # which is what stitches the cross-process edge.
+            attrs = {"replica": self.replica_id, "role": self._role,
+                     "pid": os.getpid(), "uid": req.uid}
+            parent = p.get("trace_parent")
+            if parent is not None:
+                attrs["remote_parent_id"] = int(parent)
+            req.spans = {"request": self.tracer.begin(
+                "server", trace_id=req.trace_id, attrs=attrs)}
         with self._lock:
             chunks = self._stage_rx.pop(req.uid, [])
         req.staged_kv = payload_from_chunks(p.get("staged_meta"), chunks)
@@ -378,6 +457,9 @@ class ReplicaServer:
         else:
             with self._lock:
                 self._reqs.pop(req.uid, None)
+            if req.spans:
+                req.spans["request"].set("refused", True)
+                req.end_span("request")
         return ok
 
     def _rpc_evacuate(self, p: dict, conn: Connection) -> bool:
@@ -385,6 +467,38 @@ class ReplicaServer:
             return False
         self.replica.request_evacuation(self._evac_handback)
         return True
+
+    def _rpc_dump(self, p: dict, conn: Connection) -> dict:
+        """Bounded flight-record pull (the frontend's fleet
+        ``debug_dump``). The record is trimmed OLDEST-FIRST — spans,
+        then metric snapshots — until its JSON fits the client's byte
+        bound: a partial recent record beats a dead connection from an
+        oversized frame."""
+        max_bytes = int(p.get("max_bytes", DUMP_MAX_BYTES)
+                        or DUMP_MAX_BYTES)
+        out = {"source": self.source, "role": self._role,
+               "pid": os.getpid(), "record": None, "trimmed": 0}
+        if self.recorder is None:
+            return out
+        rec = self.recorder.record()
+        import json as _json
+        while True:
+            size = len(_json.dumps(rec, default=str))
+            if size <= max_bytes:
+                break
+            spans, snaps = rec.get("spans") or [], \
+                rec.get("metric_snapshots") or []
+            if spans:
+                drop = max(1, len(spans) // 2)
+                rec["spans"] = spans[drop:]
+            elif snaps:
+                drop = max(1, len(snaps) // 2)
+                rec["metric_snapshots"] = snaps[drop:]
+            else:
+                return out          # irreducibly oversized: send nothing
+            out["trimmed"] += drop
+        out["record"] = rec
+        return out
 
     def _evac_handback(self, req, payload, replica_id: int) -> None:
         """Runs on the replica worker thread: stream the exported KV (if
@@ -493,6 +607,24 @@ class ReplicaServer:
                     if fn is not None:
                         self._digest.stamp(ev, fn(aff.digest_max_entries),
                                            self._digest_deltas)
+                # fleet observability (docs/OBSERVABILITY.md "Fleet
+                # observability"): completed spans and journal events
+                # delta-forward as OPTIONAL status fields (the digest
+                # idiom — no new RPC, old frontends ignore them, absent
+                # fields cost zero bytes). Bounded per frame; leftovers
+                # ride the next tick.
+                if self.tracer.enabled:
+                    spans, self._span_cursor = self.tracer.drain_completed(
+                        self._span_cursor, limit=SPANS_PER_STATUS)
+                    if spans:
+                        ev["spans"] = spans
+                jev = self.journal.events(
+                    since_seq=self._journal_fwd_seq)[
+                        :JOURNAL_EVENTS_PER_STATUS]
+                if jev:
+                    ev["journal"] = {"source": self.journal.source,
+                                     "events": jev}
+                    self._journal_fwd_seq = int(jev[-1]["seq"])
                 self._send_event(ev)
             except Exception as e:  # pragma: no cover - defensive
                 logger.error(f"fabric replica server {self.replica_id}: "

@@ -9,9 +9,12 @@ message shapes, every one a codec frame (fabric/codec.py) behind a
   multiplexed: many calls may be in flight, matched by id;
 - **notifies** ``{"t": "ev", ...}`` — one-way, both directions (token
   streams, status updates, cancellation);
-- **heartbeats** ``{"t": "ping"}`` / ``{"t": "pong"}`` — liveness.
-  *Any* received frame refreshes the peer-liveness clock; an idle,
-  healthy connection stays alive on pings alone.
+- **heartbeats** ``{"t": "ping", "ts"}`` / ``{"t": "pong", "echo",
+  "peer_ts"}`` — liveness, plus a peer clock-offset estimate from the
+  round-trip (``clock_offset_s``; the fields are optional so legacy
+  bare pings interoperate). *Any* received frame refreshes the
+  peer-liveness clock; an idle, healthy connection stays alive on
+  pings alone.
 
 Threading model (docs/CONCURRENCY.md): a writer thread owns the socket's
 send side and drains a plain ``queue.Queue`` outbox — no ranked lock is
@@ -54,6 +57,11 @@ STALE_HEARTBEATS = 3.0
 #: connections (network partitions, frozen hosts), where seconds of
 #: extra latency are the right trade.
 STALE_FLOOR_S = 10.0
+
+#: clock-offset samples older than this are replaced by the next pong
+#: even at a worse RTT — monotonic clocks don't jump, but a one-shot
+#: minimum-RTT sample from hours ago shouldn't pin the estimate forever
+CLOCK_OFFSET_MAX_AGE_S = 60.0
 
 
 class FabricError(Exception):
@@ -166,6 +174,11 @@ class Connection:
         self._dead = False
         self._close_reason = ""
         self._last_rx = time.monotonic()
+        # (offset_s, rtt_s, t_sampled): remote-minus-local monotonic
+        # clock estimate from heartbeat round-trips. Written only by the
+        # reader thread, read lock-free elsewhere (the _last_rx idiom) —
+        # a single-tuple swap is atomic under the GIL.
+        self._clk = (0.0, float("inf"), 0.0)
         self._outbox: "queue.Queue[Optional[bytes]]" = queue.Queue()
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"{name}-reader")
@@ -202,6 +215,22 @@ class Connection:
     @property
     def close_reason(self) -> str:
         return self._close_reason
+
+    @property
+    def clock_offset_s(self) -> float:
+        """Best-estimate PEER-minus-LOCAL monotonic clock offset, from
+        timestamped heartbeat round-trips (``peer_ts - (t0+t3)/2`` —
+        NTP's symmetric-delay assumption, good to ~RTT/2). 0.0 until the
+        first timestamped pong (an old peer never sends one). Remote
+        span timestamps rebase as ``t_local = t_remote - offset``."""
+        return self._clk[0]
+
+    @property
+    def clock_offset_rtt_s(self) -> Optional[float]:
+        """RTT of the sample behind :attr:`clock_offset_s` (its error
+        bound), or None before the first timestamped pong."""
+        rtt = self._clk[1]
+        return None if rtt == float("inf") else rtt
 
     # ------------------------------------------------------------- sending
     def send(self, msg: dict) -> None:
@@ -301,12 +330,31 @@ class Connection:
     def _handle(self, msg: dict) -> None:
         kind = msg.get("t")
         if kind == "ping":
+            # echo the sender's timestamp plus our own clock so the
+            # pinger can estimate our clock offset; a bare legacy ping
+            # gets a bare pong (optional-field compat, codec.py)
+            pong = {"t": "pong"}
+            ts = msg.get("ts")
+            if isinstance(ts, (int, float)):
+                pong["echo"] = ts
+                pong["peer_ts"] = time.monotonic()
             try:
-                self.send({"t": "pong"})
+                self.send(pong)
             except FabricError:
                 pass
             return
         if kind == "pong":
+            echo, peer_ts = msg.get("echo"), msg.get("peer_ts")
+            if isinstance(echo, (int, float)) \
+                    and isinstance(peer_ts, (int, float)):
+                t3 = time.monotonic()
+                rtt = max(0.0, t3 - float(echo))
+                off = float(peer_ts) - (float(echo) + t3) / 2.0
+                _, best_rtt, best_t = self._clk
+                # keep the tightest-RTT sample (smallest error bound),
+                # but age it out so the estimate tracks slow drift
+                if rtt <= best_rtt or t3 - best_t > CLOCK_OFFSET_MAX_AGE_S:
+                    self._clk = (off, rtt, t3)
             return
         if kind in ("resp", "err"):
             with self._lock:
@@ -332,7 +380,7 @@ class Connection:
             if self._dead:
                 return
             try:
-                self.send({"t": "ping"})
+                self.send({"t": "ping", "ts": time.monotonic()})
             except FabricError:
                 return
 

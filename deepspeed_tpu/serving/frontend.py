@@ -17,11 +17,13 @@ serving phase.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..telemetry import FlightRecorder  # noqa: F401  (re-export surface)
+from ..telemetry.fleet import FleetJournal
 from ..telemetry.journal import OpsJournal
 from ..telemetry.slo import AlertEngine
 from ..telemetry.windowed import WindowedMetrics
@@ -213,6 +215,13 @@ class ServingFrontend:
         self.journal = OpsJournal(capacity=slo.journal_capacity,
                                   source="serving",
                                   path=slo.journal_path)
+        # fleet observability (docs/OBSERVABILITY.md "Fleet
+        # observability"): the FleetJournal wraps the local journal with
+        # per-source rings for the remote journal batches the status
+        # streams carry (replica servers, federation peers). Passive and
+        # bounded like the journal itself — always on; it holds nothing
+        # until a remote source actually forwards.
+        self.fleet = FleetJournal(self.journal)
         self.windowed = WindowedMetrics(self.metrics,
                                         bucket_s=slo.window_bucket_s,
                                         history_s=slo.window_history_s)
@@ -419,6 +428,19 @@ class ServingFrontend:
         self.router.start()
         if self.supervisor is not None:
             self.supervisor.start()
+        # fleet ops surface (docs/OBSERVABILITY.md "Fleet
+        # observability"): the scrape endpoint binds LAST — its routes
+        # read the live frontend (health_report/debug_dump), so nothing
+        # may be reachable before the router runs. None when disabled:
+        # no listener, no thread, the endpoint-less stack byte for byte.
+        self._obs_endpoint = None
+        obs = self.config.observability
+        if obs.enabled:
+            from ..telemetry.fleet import ObsEndpoint
+
+            self._obs_endpoint = ObsEndpoint(self, listen=obs.listen)
+            self.journal.emit("obs_listen",
+                              address=self._obs_endpoint.address)
 
     def _validate_disaggregation(self, n_engines: int) -> None:
         """Reject role maps that cannot serve (docs/SERVING.md
@@ -495,7 +517,7 @@ class ServingFrontend:
             replica_id, address, self.config.fabric,
             role=self._role_of(replica_id), metrics=self.metrics,
             tracer=self.tracer, recorder=self._replica_recorder,
-            journal=self.journal,
+            journal=self.journal, fleet=self.fleet,
             model_id=self._replica_models.get(replica_id, "default"),
             on_failover=self._failover if ft.enabled else None,
             on_handoff=self._handoff_remote)
@@ -568,6 +590,7 @@ class ServingFrontend:
             epoch=self._federation_epoch, peer=ref.peer,
             metrics=self.metrics, tracer=self.tracer,
             recorder=self._replica_recorder, journal=self.journal,
+            fleet=self.fleet,
             on_failover=self._failover if ft.enabled else None,
             on_handoff=self._handoff_remote)
         handle._evac_handback = self._evacuate_handback
@@ -1384,6 +1407,10 @@ class ServingFrontend:
             if self._federation_server is not None:
                 ids |= self._federation_server.live_peer_ids()
             self.metrics.gauge("federation_peers").set(len(ids))
+        # distinct remote journal sources currently held (0 on fleets
+        # with no remote members — the gauge exists either way)
+        self.metrics.gauge("fleet_telemetry_sources").set(
+            len(self.fleet.sources()))
 
     def _refresh_admission_gauges(self) -> None:
         """Sum the fleet's reservation shortfall and parked-sequence
@@ -1538,6 +1565,14 @@ class ServingFrontend:
         to whatever scrapes/serves /metrics (docs/OBSERVABILITY.md)."""
         return self.metrics.render_prometheus()
 
+    @property
+    def observability_address(self) -> Optional[str]:
+        """``host:port`` of the scrape endpoint (resolved — port 0 in
+        the config binds a free port), or ``None`` when
+        ``observability:`` is disabled."""
+        ep = getattr(self, "_obs_endpoint", None)
+        return ep.address if ep is not None else None
+
     # --------------------------------------------------------- health report
     def health_report(self, window_s: float = 60.0,
                       recent_events: int = 20) -> dict:
@@ -1618,6 +1653,44 @@ class ServingFrontend:
                            if self.autoscaler is not None else None),
             "events": self.journal.events(limit=recent_events),
         }
+        # fleet observability (docs/OBSERVABILITY.md "Fleet
+        # observability"): per-remote-replica transport/clock/recency
+        # status, federation peer books, and the FleetJournal's
+        # per-source tallies. All empty/None on a purely local fleet —
+        # the report shape is stable either way.
+        remotes = [r.ops_status() for r in self.router.replicas
+                   if hasattr(r, "ops_status")]
+        report["remotes"] = remotes
+        fed = None
+        if self._federation is not None:
+            peers = []
+            now = time.monotonic()
+            for p in self._federation_peers:
+                ages = [now - h._last_status_t
+                        for h in p._handles.values() if h._last_status_t]
+                peers.append({
+                    "address": p.address,
+                    "peer_id": p.peer_id,
+                    "alive": p.alive,
+                    "inflight": p.inflight(),
+                    "exports_adopted": sum(
+                        1 for rid in self._federated_refs
+                        if self._federated_refs[rid].peer is p),
+                    "last_status_age_s": min(ages) if ages else None})
+            fed = {
+                "frontend_id": self._federation_id,
+                "epoch": self._federation_epoch,
+                "listen": (self._federation_server.address
+                           if self._federation_server is not None
+                           else None),
+                "peers": peers,
+                "peers_live": sorted(
+                    self._federation_server.live_peer_ids()
+                    if self._federation_server is not None else []),
+            }
+        report["federation"] = fed
+        report["fleet_journal"] = self.fleet.sources()
+        report["observability_address"] = self.observability_address
         return report
 
     def health_report_text(self, window_s: float = 60.0,
@@ -1647,6 +1720,33 @@ class ServingFrontend:
             f"shed={c['requests_shed']:.0f} "
             f"failed={c['requests_failed']:.0f} "
             f"failed_over={c['requests_failed_over']:.0f}")
+        for rem in r.get("remotes") or []:
+            age = rem.get("last_status_age_s")
+            lines.append(
+                f"remote {rem['replica']} ({rem['source']}): "
+                + ("up" if rem["connected"] else "DOWN")
+                + f" rpc={rem['rpc_calls']}"
+                f"@{rem['rpc_avg_s'] * 1e3:.1f}ms "
+                f"clk={rem['clock_offset_s'] * 1e3:+.1f}ms "
+                f"active={rem['active']} "
+                + (f"status_age={age:.1f}s" if age is not None
+                   else "status_age=-"))
+        if r.get("federation") is not None:
+            f = r["federation"]
+            lines.append(
+                f"federation {f['frontend_id']}: "
+                f"peers_connected={len(f['peers_live'])} "
+                f"adopted_from={sum(1 for p in f['peers'] if p['alive'])}"
+                f"/{len(f['peers'])}")
+            for p in f["peers"]:
+                age = p.get("last_status_age_s")
+                lines.append(
+                    f"  peer {p['peer_id'] or p['address']}: "
+                    + ("up" if p["alive"] else "DOWN")
+                    + f" exports={p['exports_adopted']} "
+                    f"seats_in_use={p['inflight']} "
+                    + (f"status_age={age:.1f}s" if age is not None
+                       else "status_age=-"))
         if r.get("tenants"):
             for name, t in sorted(r["tenants"].items()):
                 lines.append(
@@ -1683,13 +1783,42 @@ class ServingFrontend:
 
     # ------------------------------------------------------------ telemetry
     def debug_dump(self, dump_dir: Optional[str] = None) -> dict:
-        """On-demand flight-recorder dump: recent spans (open ones
-        included) + metric snapshots, written as raw JSON and Chrome
-        ``trace_event`` JSON (chrome://tracing / Perfetto). Returns
-        ``{"json": path, "chrome_trace": path}``. Works with telemetry
-        disabled too (metrics only; the span list is empty)."""
+        """On-demand FLEET flight-recorder dump (docs/OBSERVABILITY.md
+        "Fleet observability"): the local recorder dump (recent spans,
+        open ones included, + metric snapshots, as raw JSON and Chrome
+        ``trace_event`` JSON) plus one bounded ``dump`` RPC per remote
+        replica, each written alongside as
+        ``fleet_<source>_<pid>.json``. Returns ``{"json": path,
+        "chrome_trace": path, "remotes": {source: path | None}}`` —
+        ``None`` marks a remote whose dump RPC failed (the local dump
+        never blocks on a sick peer). Works with telemetry disabled too
+        (metrics only; the span lists are empty)."""
+        import json as _json
+
         self.recorder.snapshot_metrics()
-        return self.recorder.dump(dump_dir=dump_dir, reason="debug")
+        out = self.recorder.dump(dump_dir=dump_dir, reason="debug")
+        d = self.recorder._resolve_dir(dump_dir)
+        remotes: Dict[str, Optional[str]] = {}
+        for rep in self.router.replicas:
+            fn = getattr(rep, "pull_dump", None)
+            if fn is None:
+                continue
+            dump = fn()
+            src = (dump or {}).get("source") or getattr(
+                rep, "_source", f"replica-{rep.replica_id}")
+            if dump is None:
+                remotes[str(src)] = None
+                continue
+            safe = str(src).replace("/", "_").replace(":", "_")
+            path = os.path.join(d, f"fleet_{safe}_{dump.get('pid')}.json")
+            with open(path, "w") as f:
+                _json.dump(dump, f)
+            remotes[str(src)] = path
+        if remotes:
+            out = dict(out, remotes=remotes)
+            self.journal.emit("fleet_dump",
+                              sources=sorted(remotes), dir=d)
+        return out
 
     # ------------------------------------------------------------ shutdown
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None) -> None:
@@ -1710,6 +1839,10 @@ class ServingFrontend:
             if self._closed:
                 return
             self._closed = True
+        # scrape endpoint first: no HTTP reader may observe (or block
+        # on) a half-torn frontend
+        if getattr(self, "_obs_endpoint", None) is not None:
+            self._obs_endpoint.stop()
         if self.autoscaler is not None:
             # no membership changes may race the teardown below
             self.autoscaler.stop()

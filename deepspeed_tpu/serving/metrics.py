@@ -431,7 +431,15 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # frontend federation (docs/SERVING.md "Frontend
               # federation"): requests this frontend assigned onto a
               # peer's exported replica
-              "requests_federated"):
+              "requests_federated",
+              # fleet observability (docs/OBSERVABILITY.md "Fleet
+              # observability"): remote spans ingested off the status
+              # stream; journal events accepted into / dropped by the
+              # FleetJournal (schema-invalid only — per-source seq
+              # duplicates are deduped, not dropped); HTTP requests the
+              # ObsEndpoint served
+              "spans_forwarded", "journal_events_forwarded",
+              "journal_events_dropped", "obs_requests"):
         reg.counter(c)
     for g in ("queue_depth", "replicas_healthy", "outstanding_tokens",
               # phase-split router load + KV handoff staging occupancy +
@@ -489,7 +497,11 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # federation"): live peer frontends — connected peers on
               # the exporting side, peers with >= 1 live adopted
               # export on the adopting side
-              "federation_peers"):
+              "federation_peers",
+              # fleet observability (docs/OBSERVABILITY.md "Fleet
+              # observability"): distinct remote journal sources the
+              # FleetJournal currently holds events from
+              "fleet_telemetry_sources"):
         reg.gauge(g)
     for h in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_latency_s",
               # staging→import handoff time (docs/SERVING.md

@@ -42,15 +42,18 @@ class TelemetryConfig(DSConfigModel):
         return Tracer(enabled=True, max_spans=self.max_spans,
                       xla_annotations=self.xla_annotations)
 
-    def build_recorder(self, tracer, metrics=None):
+    def build_recorder(self, tracer, metrics=None, role="frontend"):
         """Flight recorder over ``tracer``; ``metrics`` (an object with
-        ``snapshot()``) is registered as the first snapshot provider."""
+        ``snapshot()``) is registered as the first snapshot provider.
+        ``role`` lands in dump filenames so fleet processes sharing a
+        dump dir never collide."""
         from .flight_recorder import FlightRecorder
 
         rec = FlightRecorder(tracer, max_snapshots=self.max_metric_snapshots,
                              dump_dir=self.dump_dir,
                              max_error_dumps=self.max_error_dumps,
-                             error_dump_window_s=self.error_dump_window_s)
+                             error_dump_window_s=self.error_dump_window_s,
+                             role=role)
         if metrics is not None:
             rec.add_metrics_provider("serving", metrics.snapshot)
         return rec

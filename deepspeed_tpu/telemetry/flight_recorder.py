@@ -44,9 +44,15 @@ class FlightRecorder:
 
     def __init__(self, tracer: Tracer, max_snapshots: int = 32,
                  dump_dir: Optional[str] = None, max_error_dumps: int = 3,
-                 error_dump_window_s: float = 3600.0):
+                 error_dump_window_s: float = 3600.0,
+                 role: str = "frontend"):
         self.tracer = tracer
         self.dump_dir = dump_dir
+        # dump filenames are stamped <seq>_<reason>_<role>_<pid>: a
+        # subprocess replica fleet shares one dump dir (the kv_tier
+        # kvtier_<pid> precedent), and per-process seq counters alone
+        # would collide
+        self.role = str(role)
         # error dumps are limited to max_error_dumps per sliding window
         # (NOT per lifetime — a long-running service must still capture
         # next week's incident after this week's burned a few slots)
@@ -58,6 +64,42 @@ class FlightRecorder:
         self._last_snapshot_t = 0.0
         self._dump_seq = 0
         self._error_dump_times: "deque[float]" = deque()
+        if dump_dir:
+            self._sweep_stale_dumps(dump_dir)
+
+    @staticmethod
+    def _sweep_stale_dumps(dump_dir: str) -> int:
+        """Delete dump files whose owning pid (the trailing filename
+        token) is dead — a bench/test fleet's previous run must not leave
+        its obituaries to be mistaken for this run's. Files of LIVE
+        processes (including this one) and unparseable names are never
+        touched; any OS error ends the sweep silently (telemetry must
+        never kill its host over housekeeping)."""
+        swept = 0
+        try:
+            names = os.listdir(dump_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if not (name.startswith("flightrec_")
+                    or name.startswith("trace_")) \
+                    or not name.endswith(".json"):
+                continue
+            stem = name[:-len(".json")]
+            pid_s = stem.rsplit("_", 1)[-1]
+            if not pid_s.isdigit() or int(pid_s) == os.getpid():
+                continue
+            try:
+                os.kill(int(pid_s), 0)
+            except ProcessLookupError:
+                try:
+                    os.remove(os.path.join(dump_dir, name))
+                    swept += 1
+                except OSError:
+                    return swept
+            except OSError:
+                pass                        # alive or not ours: keep
+        return swept
 
     def add_metrics_provider(self, name: str,
                              fn: Callable[[], dict]) -> None:
@@ -125,7 +167,7 @@ class FlightRecorder:
         with self._lock:
             self._dump_seq += 1
             seq = self._dump_seq
-        tag = f"{seq:03d}_{reason}_{os.getpid()}"
+        tag = f"{seq:03d}_{reason}_{self.role}_{os.getpid()}"
         record = self.record()
         record["reason"] = reason
         raw_path = os.path.join(d, f"flightrec_{tag}.json")

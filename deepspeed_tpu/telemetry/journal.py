@@ -103,6 +103,16 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     "peer_connected": frozenset({"peer", "epoch"}),
     "peer_lost": frozenset({"peer", "reason"}),
     "replica_exported": frozenset({"replica", "peer"}),
+    # fleet observability (docs/OBSERVABILITY.md "Fleet observability"):
+    # the frontend's scrape endpoint came up (where operators should
+    # point fleetctl/Prometheus), and a fleet-wide debug dump completed
+    # (how many processes contributed, where the files landed)
+    "obs_listen": frozenset({"address"}),
+    "fleet_dump": frozenset({"sources", "dir"}),
+    # a replica server accepted a frontend hello (emitted SERVER-side;
+    # reaches the frontend's FleetJournal over the status stream, so
+    # every server process contributes at least one sourced event)
+    "server_hello": frozenset({"replica", "role", "reset"}),
     # multi-tenant serving (docs/SERVING.md "Multi-model & multi-tenant
     # serving"): a tenant crossed into throttled state — its sliding-
     # window dispatch rate exceeded token_rate, or a KV budget refusal

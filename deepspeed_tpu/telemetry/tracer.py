@@ -137,7 +137,8 @@ class Tracer:
     # lock discipline (docs/CONCURRENCY.md): the span rings are written
     # from every instrumented thread; the thread-local nesting stack
     # needs no lock by construction
-    _GUARDED_BY = {"_spans": "_lock", "_open": "_lock"}
+    _GUARDED_BY = {"_spans": "_lock", "_open": "_lock",
+                   "_completed_total": "_lock"}
 
     def __init__(self, enabled: bool = True, max_spans: int = 8192,
                  clock=time.monotonic, xla_annotations: bool = False):
@@ -151,6 +152,9 @@ class Tracer:
         self._open: Dict[int, Span] = {}
         self._lock = RankedLock("telemetry.tracer")
         self._ids = itertools.count(1)
+        # monotone count of spans EVER completed (the ring forgets, this
+        # doesn't) — the cursor base for drain_completed()
+        self._completed_total = 0
         self._local = threading.local()
 
     # ------------------------------------------------------------- creation
@@ -206,6 +210,7 @@ class Tracer:
         with self._lock:
             self._open.pop(span.span_id, None)
             self._spans.append(span)
+            self._completed_total += 1
 
     # -------------------------------------------------------------- reading
     def export(self, include_open: bool = True) -> List[Dict[str, Any]]:
@@ -229,6 +234,59 @@ class Tracer:
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
+
+    # --------------------------------------------- cross-process forwarding
+    @property
+    def completed_total(self) -> int:
+        """Monotone count of spans ever completed — pass it as the
+        cursor to :meth:`drain_completed` to skip existing history."""
+        with self._lock:
+            return self._completed_total
+
+    def drain_completed(self, cursor: int,
+                        limit: int = 256) -> Tuple[List[Dict[str, Any]],
+                                                   int]:
+        """Completed spans recorded after ``cursor`` (a value this method
+        previously returned; start at 0), oldest first, at most ``limit``
+        per call — the fabric status stream's delta feed. Spans that
+        aged out of the ring before being drained are silently lost (the
+        ring is the retention policy; forwarding rides it, it does not
+        extend it). Returns ``(span_dicts, new_cursor)``."""
+        with self._lock:
+            total = self._completed_total
+            pending = total - int(cursor)
+            if pending <= 0:
+                return [], total
+            avail = min(pending, len(self._spans))
+            take = min(avail, int(limit))
+            start = len(self._spans) - avail
+            out = [self._spans[i].to_dict()
+                   for i in range(start, start + take)]
+            return out, total - (avail - take)
+
+    def ingest(self, d: Dict[str, Any]) -> None:
+        """Adopt one remote span dict (a :meth:`Span.to_dict` shipped
+        over the fabric) into the completed ring verbatim — no id
+        allocation, no clock read; the caller owns id-collision avoidance
+        (telemetry/fleet.py offsets remote ids per source) and clock
+        alignment (timestamps must already be rebased to this process's
+        monotonic clock). No-op when disabled."""
+        if not self.enabled:
+            return
+        s = Span.__new__(Span)
+        s.tracer = self
+        s.name = str(d.get("name", "remote"))
+        s.trace_id = d.get("trace_id")
+        s.span_id = int(d.get("span_id") or 0)
+        s.parent_id = d.get("parent_id")
+        s.t_start = float(d.get("t_start") or 0.0)
+        s.t_end = d.get("t_end")
+        s.attrs = dict(d.get("attrs") or {})
+        s.tid = int(d.get("tid") or 0)
+        s._xla_ctx = None
+        with self._lock:
+            self._spans.append(s)
+            self._completed_total += 1
 
 
 #: Process-wide disabled tracer: the default everywhere a tracer is

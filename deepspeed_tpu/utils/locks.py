@@ -64,6 +64,7 @@ LOCK_RANKS = {
     # ------------------------------------------------- observability
     "telemetry.slo": 120,          # alert state machines
     "telemetry.windowed": 130,     # snapshot ring
+    "telemetry.fleet": 135,        # fleet journal per-source rings
     "telemetry.journal": 140,      # ops event ring + sink
     "telemetry.recorder": 150,     # flight-recorder snapshots
     "telemetry.tracer": 160,       # span rings

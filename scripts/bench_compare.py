@@ -45,7 +45,16 @@ _INFORMATIONAL = ("noise_floor", "wall_", "budget_s",
                   # phase itself ("ttft_improved" would otherwise match
                   # the "ttft" latency fragment and flag a 0->1 flip as
                   # a regression)
-                  "_improved")
+                  "_improved",
+                  # telemetry/fleet_obs phases: span coverage would
+                  # otherwise match the "ttft" latency fragment and
+                  # flag an IMPROVEMENT as a regression; the >= 0.95
+                  # gate is asserted inside the phase itself
+                  "ttft_coverage",
+                  # fleet_obs phase: heartbeat-estimated clock skew
+                  # between processes — a property of the machine's
+                  # clocks, not of the code
+                  "clock_offset")
 _LOWER_IS_BETTER = (
     "ttft", "tpot", "latency", "_ms", "_time_s", "time_s", "wait",
     "steps_lost", "overhead", "shed_rate", "ppl",
@@ -78,6 +87,10 @@ _LOWER_IS_BETTER = (
     # affinity phase: grow-path warm-up wall time (export -> import) —
     # it delays when the router may target the grown replica
     "warmup_s",
+    # fleet_obs phase: remote journal events the FleetJournal refused
+    # (schema-invalid) — any rise means a producer drifted from
+    # EVENT_SCHEMAS
+    "events_dropped",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",

@@ -72,7 +72,18 @@
 #                  tearing the exporter down mid-decode must fail every
 #                  federated stream over to the adopter's local replica
 #                  byte-losslessly (recovery time stamped), and
-#                  federation-disabled byte-parity is asserted) — wires
+#                  federation-disabled byte-parity is asserted,
+#                  or TIER1_PHASE=fleet_obs for the fleet-wide
+#                  observability phase — a frontend + 2 subprocess
+#                  replica servers traced end to end: ONE merged
+#                  cross-process Chrome trace whose req-<uid> chains
+#                  stitch across pids with TTFT span coverage >= 0.95,
+#                  the frontend FleetJournal holding schema-valid
+#                  events from >= 2 remote sources exactly once, live
+#                  /metrics + /health + fleetctl status against the
+#                  observability endpoint, telemetry overhead < 2% vs
+#                  the noise floor, and observability-disabled
+#                  byte-parity asserted) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

@@ -636,6 +636,26 @@ def test_bench_schema_validator():
                           "parity": True, "kill_parity": True,
                           "disabled_parity": True, "zero_wedges": True,
                           "kv_occupancy": dict(occ)}
+    good["fleet_obs"] = {"replicas": 2, "n_requests": 8,
+                         "prompt_len": 24, "max_new": 6,
+                         "wall_off_s": 0.272, "wall_off_rerun_s": 0.302,
+                         "wall_on_s": 0.282, "noise_floor_pct": 11.4,
+                         "overhead_enabled_pct": 3.9,
+                         "spans_total": 192, "server_spans": 16,
+                         "spans_forwarded": 68,
+                         "min_ttft_coverage": 0.999,
+                         "ttft_coverage_ok": True,
+                         "chains_complete": True,
+                         "trace_path": "/tmp/trace_fleet_1.json",
+                         "trace_valid": True, "journal_sources": 2,
+                         "journal_events_forwarded": 6,
+                         "journal_events_dropped": 0,
+                         "journal_exactly_once": True,
+                         "clock_offset_ms": 0.08,
+                         "http_metrics_ok": True, "http_health_ok": True,
+                         "fleetctl_ok": True, "parity": True,
+                         "disabled_parity": True, "zero_wedges": True,
+                         "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
     # multitenant typed checks: bool-for-int rejected, missing named
     bad_mt = dict(good)
@@ -661,6 +681,14 @@ def test_bench_schema_validator():
     assert any("federation.kill_parity" in p for p in problems_fd)
     assert any("federation.failover_recovery_s: missing" in p
                for p in problems_fd)
+    # fleet_obs typed checks: bool-for-int rejected, missing named
+    bad_fo = dict(good)
+    bad_fo["fleet_obs"] = {"journal_sources": True, "fleetctl_ok": 1}
+    problems_fo = bench.validate_serving_schema(bad_fo)
+    assert any("fleet_obs.journal_sources" in p for p in problems_fo)
+    assert any("fleet_obs.fleetctl_ok" in p for p in problems_fo)
+    assert any("fleet_obs.min_ttft_coverage: missing" in p
+               for p in problems_fo)
     # fabric typed checks: bool-for-int rejected, missing fields named
     bad_fb = dict(good)
     bad_fb["fabric"] = {"rpc_calls": True, "parity": 1}
