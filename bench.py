@@ -293,7 +293,7 @@ _STAMPED_PHASES = ("ragged", "frontend", "prefix", "speculative",
                    "weight_quant",
                    "disagg", "slo", "kv_tier", "overload", "autoscale",
                    "fabric", "multitenant", "affinity", "federation",
-                   "fleet_obs")
+                   "fleet_obs", "net_chaos")
 # Typed shape of the multitenant phase (docs/SERVING.md "Multi-model &
 # multi-tenant serving"): tenant-B interactive p95 TTFT solo vs under a
 # tenant-A flood with deficit-weighted-fair admission ON (isolation:
@@ -410,6 +410,27 @@ _FLEET_OBS_KEYS = (("replicas", int),
                    ("parity", bool),
                    ("disabled_parity", bool),
                    ("zero_wedges", bool))
+# Typed shape of the net_chaos phase (docs/SERVING.md "Fleet chaos
+# engineering"): a 3-subprocess-replica fleet driven through a seeded
+# network-fault schedule — one gray-slow link (quarantine fires and a
+# probe re-admits, journaled exactly once), one mid-burst partition +
+# heal (supervisor re-dial; kill-to-recovered time stamped), one
+# corrupt-frame burst (CRC refusals, zero fatal) — with 100% completion,
+# greedy byte-parity, and chaos/quarantine-disabled byte-parity all
+# asserted in-phase.
+_NET_CHAOS_KEYS = (("replicas", int),
+                   ("n_requests", int),
+                   ("prompt_len", int),
+                   ("max_new", int),
+                   ("completed_under_chaos", (int, float)),
+                   ("recovery_time_s", (int, float)),
+                   ("quarantines_journaled", int),
+                   ("readmits_journaled", int),
+                   ("frames_corrupt", int),
+                   ("frames_corrupt_fatal", int),
+                   ("faults_injected", int),
+                   ("parity", bool),
+                   ("disabled_parity", bool))
 # Typed shape of the kv_tier phase (docs/SERVING.md "KV tiering"): the
 # TTFT comparison with the device pool sized below the prefix working
 # set, spill/restore counts, and the parity bits the acceptance gates
@@ -660,6 +681,11 @@ def validate_serving_schema(serving: dict):
         problems.append("fleet_obs: missing or not an object")
     elif "phase_skipped" not in fo:
         _check_typed_phase("fleet_obs", fo, _FLEET_OBS_KEYS, problems)
+    nc = serving.get("net_chaos")
+    if not isinstance(nc, dict):
+        problems.append("net_chaos: missing or not an object")
+    elif "phase_skipped" not in nc:
+        _check_typed_phase("net_chaos", nc, _NET_CHAOS_KEYS, problems)
     sl = serving.get("slo")
     if not isinstance(sl, dict):
         problems.append("slo: missing or not an object")
@@ -2571,6 +2597,238 @@ def bench_serving(on_tpu: bool):
             "zero_wedges": bool(local["completed"] and fab["completed"]),
         }
 
+    def run_net_chaos_phase():
+        """Fleet chaos engineering (docs/SERVING.md "Fleet chaos
+        engineering"): a 3-subprocess-replica fleet driven through a
+        seeded network-fault schedule — (1) a gray-slow link on replica
+        0 (tx latency: quarantine fires off deadline-missed RPCs, the
+        probe re-admits once the fault expires, both journaled exactly
+        once), (2) a mid-burst full partition on replica 1 (both
+        directions discarded without liveness refresh: staleness marks
+        it DEAD, in-flight work fails over, the supervisor re-dials
+        after the partition heals — kill-to-recovered time stamped),
+        and (3) an idle-window corrupt-frame burst on replica 2 (CRC
+        refusals: typed, benign, zero connections lost to corruption).
+        100% completion with greedy byte-parity is asserted under all
+        of it, and a chaos/quarantine-free run over the same servers
+        asserts the disabled path is byte-for-byte the PR 19 stack."""
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+        from deepspeed_tpu.serving import (RequestState, ServingConfig,
+                                           ServingFrontend)
+        from deepspeed_tpu.serving.fabric import transport as _ftrans
+        from deepspeed_tpu.serving.replica import ReplicaState
+
+        model_kw = dict(vocab_size=512, hidden_size=128,
+                        intermediate_size=256, num_layers=2, num_heads=4,
+                        max_seq_len=256, norm="rmsnorm",
+                        activation="silu", position="rope")
+        eng_kw = dict(max_ragged_batch_size=256,
+                      max_ragged_sequence_count=8, max_chunk_tokens=32,
+                      kv_blocks=64, kv_block_size=16,
+                      max_tracked_sequences=32)
+        n_req, plen, max_new = (12, 48, 10) if on_tpu else (9, 24, 6)
+        seed = 0
+        cmodel = CausalLM(TransformerConfig(**model_kw))
+        cparams = cmodel.init(jax.random.PRNGKey(seed))
+
+        def engine_factory(i=0):
+            return InferenceEngineV2(
+                cmodel, params=cparams,
+                config=RaggedInferenceEngineConfig(**eng_kw))
+
+        ps = [rng.integers(0, model_kw["vocab_size"],
+                           size=plen).tolist() for _ in range(n_req)]
+
+        def run(fe):
+            hs = [fe.submit(p, max_new_tokens=max_new) for p in ps]
+            completed = fe.wait_all(hs, timeout=600)
+            gens = [[ev.token for ev in h.drain()] for h in hs]
+            finished = sum(1 for h in hs
+                           if h.state == RequestState.FINISHED)
+            return {"completed": bool(completed and finished == n_req),
+                    "finished": finished, "gens": gens}
+
+        # in-process reference: 3 local replicas, no fabric at all
+        fe = ServingFrontend([engine_factory(i) for i in range(3)],
+                             ServingConfig(max_queue_depth=64))
+        try:
+            local = run(fe)
+        finally:
+            fe.shutdown(drain=False, timeout=5)
+
+        # 3 real subprocess replica servers, reused by both fabric runs
+        # (chaos interposes frontend-side only; greedy decode is
+        # stateless across reconnects, so reuse cannot skew parity)
+        spec = {"model": model_kw, "engine": eng_kw, "seed": seed,
+                "serving": {}}
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "scripts", "serve_replica.py")
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as fh:
+            json.dump(spec, fh)
+            spec_path = fh.name
+        env = dict(os.environ, JAX_PLATFORMS="cpu") if not on_tpu \
+            else dict(os.environ)
+        procs, addrs = [], []
+        stale_floor = _ftrans.STALE_FLOOR_S
+        try:
+            for i in range(3):
+                p = subprocess.Popen(
+                    [_sys.executable, script, "--spec", spec_path,
+                     "--listen", "127.0.0.1:0", "--replica-id", str(i),
+                     "--loopback-ok"],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, env=env)
+                procs.append(p)
+            for p in procs:
+                line = p.stdout.readline()
+                if not line.startswith("FABRIC_LISTENING "):
+                    raise RuntimeError(
+                        f"replica server never listened: {line!r}")
+                addrs.append(line.split()[1])
+
+            # (a) chaos + quarantine absent, v1 wire pinned: the PR 19
+            # byte-for-byte stack over the same servers
+            fe = ServingFrontend([], ServingConfig(
+                max_queue_depth=64,
+                fabric={"enabled": True, "peers": addrs,
+                        "heartbeat_s": 0.2, "rpc_timeout_s": 120.0,
+                        "frame_crc": False}))
+            try:
+                disabled = run(fe)
+            finally:
+                fe.shutdown(drain=False, timeout=5)
+
+            # (b) the chaos run: seeded schedule, quarantine scoring,
+            # supervised restarts, CRC sealing
+            schedule = [
+                {"kind": "latency", "link": "fabric-r0", "dir": "tx",
+                 "delay_s": 0.35, "duration_s": 8.0},
+                {"kind": "partition", "link": "fabric-r1",
+                 "at_frame_range": [60, 90], "duration_s": 1.2},
+                {"kind": "corrupt", "link": "fabric-r2", "dir": "rx",
+                 "at_frame": 4, "count": 3},
+            ]
+            # a 1.2s partition must out-live liveness detection inside
+            # the phase budget — drop the frontend-side staleness floor
+            _ftrans.STALE_FLOOR_S = 0.8
+            fe = ServingFrontend([], ServingConfig(
+                max_queue_depth=64,
+                fabric={"enabled": True, "peers": addrs,
+                        "heartbeat_s": 0.2, "rpc_timeout_s": 120.0,
+                        "quarantine": {
+                            "enabled": True, "rpc_slow_s": 0.25,
+                            "window": 8, "min_samples": 4,
+                            "slow_fraction": 0.75,
+                            "probe_backoff_s": 0.5,
+                            "probe_backoff_max_s": 2.0,
+                            "escalate_quarantines": 10,
+                            "escalate_window_s": 120.0}},
+                fault_tolerance={"enabled": True,
+                                 "restart_backoff_s": 1.5,
+                                 "restart_backoff_jitter": 0.1,
+                                 "max_restarts_in_window": 10,
+                                 "restart_window_s": 300.0},
+                chaos={"enabled": True, "seed": seed,
+                       "schedule": schedule}))
+            try:
+                inj = fe.net_chaos
+                h0, h1, h2 = fe.router.replicas
+                # idle window first: the corrupt burst lands on status/
+                # ping pushes (benign refusals), never on token frames
+                time.sleep(1.5)
+                # drive the gray link: deadline-missed probes through
+                # the latency shim feed the quarantine score
+                for _ in range(8):
+                    if h0.state == ReplicaState.QUARANTINED:
+                        break
+                    try:
+                        h0._call("probe", {}, timeout_s=0.3)
+                    except Exception:
+                        pass
+                assert h0.state == ReplicaState.QUARANTINED, \
+                    "gray-slow link never quarantined"
+                chaotic = run(fe)       # partition fires mid-burst
+                # partition heal: the supervisor re-dials replica 1
+                deadline = time.monotonic() + 60
+                restarts = []
+                while time.monotonic() < deadline:
+                    with fe.supervisor._lock:
+                        restarts = [dict(e) for e
+                                    in fe.supervisor.restart_log]
+                    if any(e["replica"] == h1.replica_id
+                           for e in restarts):
+                        break
+                    time.sleep(0.1)
+                r1_heals = [e for e in restarts
+                            if e["replica"] == h1.replica_id]
+                assert r1_heals, "partitioned replica never healed"
+                # latency expiry: the probe re-admits replica 0
+                deadline = time.monotonic() + 30
+                while fe.journal.count("replica_readmitted") < 1 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.1)
+                assert fe.journal.count("replica_quarantined") == 1, \
+                    "quarantine was not journaled exactly once"
+                assert fe.journal.count("replica_readmitted") == 1, \
+                    "re-admission was not journaled exactly once"
+                snap = fe.metrics_snapshot()
+                fired = inj.fired()
+                corrupt_fired = len(inj.fired("corrupt"))
+            finally:
+                _ftrans.STALE_FLOOR_S = stale_floor
+                fe.shutdown(drain=False, timeout=5)
+        finally:
+            _ftrans.STALE_FLOOR_S = stale_floor
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            try:
+                os.unlink(spec_path)
+            except OSError:
+                pass
+
+        assert local["completed"], "reference run left unfinished work"
+        assert disabled["completed"] and chaotic["completed"], \
+            "the fleet did not complete 100% under chaos"
+        assert disabled["gens"] == local["gens"], \
+            "chaos/quarantine disabled diverged from the PR 19 stack"
+        assert chaotic["gens"] == local["gens"], \
+            "chaos broke greedy byte-parity"
+        assert {f[0] for f in fired} >= {"latency", "partition",
+                                         "corrupt"}, \
+            f"schedule under-fired: {sorted({f[0] for f in fired})}"
+        frames_corrupt = int(snap.get("rpc_frames_corrupt", 0))
+        assert frames_corrupt >= 1 and corrupt_fired >= 1, \
+            "the corrupt burst never produced a CRC refusal"
+        fatal = sum(1 for e in restarts if e["replica"] == h2.replica_id)
+        assert fatal == 0, \
+            "frame corruption killed a connection — refusal must be benign"
+        return {
+            "replicas": 3, "n_requests": int(n_req),
+            "prompt_len": int(plen), "max_new": int(max_new),
+            "completed_under_chaos": round(
+                chaotic["finished"] / n_req, 4),
+            "recovery_time_s": round(r1_heals[-1]["recovery_s"], 3),
+            "quarantines_journaled": 1, "readmits_journaled": 1,
+            "frames_corrupt": frames_corrupt,
+            "frames_corrupt_fatal": int(fatal),
+            "faults_injected": int(len(fired)),
+            "parity": bool(chaotic["gens"] == local["gens"]),
+            "disabled_parity": bool(disabled["gens"] == local["gens"]),
+        }
+
     def run_fleet_obs_phase():
         """Fleet-wide observability phase (docs/OBSERVABILITY.md "Fleet
         observability"): the SAME 2-subprocess-replica fleet run with
@@ -3541,6 +3799,13 @@ def bench_serving(on_tpu: bool):
     # live /metrics + /health + fleetctl checks, overhead vs the noise
     # floor, and observability-disabled byte-parity asserted
     result["fleet_obs"] = runner.run("fleet_obs", run_fleet_obs_phase)
+    # fleet chaos engineering (docs/SERVING.md "Fleet chaos
+    # engineering"): a seeded fault schedule (gray-slow link → quarantine
+    # + probe re-admission, mid-burst partition → failover + supervised
+    # heal, corrupt-frame burst → benign CRC refusals) against 3
+    # subprocess replicas — 100% completion, greedy byte-parity, and
+    # chaos/quarantine-disabled byte-parity all asserted in-phase
+    result["net_chaos"] = runner.run("net_chaos", run_net_chaos_phase)
     result["phase_budget_s"] = runner.budget_s
     result["schema_problems"] = validate_serving_schema(result)
     return result

@@ -489,9 +489,20 @@ class FederationConfig(DSConfigModel):
     # replicas' seat counts) — the capacity-accounting knob that keeps
     # an edge frontend from soaking a peer's whole pool
     peer_max_inflight: int = 0
+    # partition-tolerant seat leases (docs/SERVING.md "Frontend
+    # federation"): an export channel whose adopter has been silent this
+    # long has its lease expired — the exporter cancels that channel's
+    # mirrored requests and the borrowed seats return to local traffic
+    # (the adopter's transport-loss failover already reclaimed the
+    # streams on ITS side of the partition). 0 (the default) disables
+    # the sweep: leases last as long as the TCP connection.
+    lease_timeout_s: float = 0.0
 
     @model_validator(mode="after")
     def _validate(self):
+        if self.lease_timeout_s < 0:
+            raise ValueError(
+                "fabric.federation.lease_timeout_s must be >= 0")
         if self.enabled:
             for addr in self.peers:
                 host, sep, port = str(addr).rpartition(":")
@@ -505,6 +516,60 @@ class FederationConfig(DSConfigModel):
             if self.peer_max_inflight < 0:
                 raise ValueError(
                     "fabric.federation.peer_max_inflight must be >= 0")
+        return self
+
+
+class QuarantineConfig(DSConfigModel):
+    """``fabric.quarantine: {...}`` block (docs/CONFIG.md,
+    docs/SERVING.md "Fleet fault tolerance"): gray-failure quarantine
+    for remote replicas. A handle whose rolling RPC window shows too
+    many slow calls or deadline misses leaves the routable set
+    (QUARANTINED — in-flight streams continue, no fresh work) and probe
+    RPCs on exponential backoff re-admit it once latency recovers;
+    repeated quarantines inside ``escalate_window_s`` escalate to the
+    ordinary DEAD/failover path. Disabled (the default) never scores:
+    byte-for-byte the liveness-only health model."""
+
+    enabled: bool = False
+    # an RPC slower than this is a bad sample (deadline misses always
+    # are)
+    rpc_slow_s: float = 1.0
+    # rolling sample window (count) and how many samples must exist
+    # before a verdict
+    window: int = 32
+    min_samples: int = 8
+    # fraction of the window that must be bad to quarantine
+    slow_fraction: float = 0.5
+    # probe cadence while quarantined: exponential from probe_backoff_s
+    # up to probe_backoff_max_s; a probe answered under rpc_slow_s
+    # re-admits
+    probe_backoff_s: float = 0.5
+    probe_backoff_max_s: float = 8.0
+    # escalation: this many quarantines inside the window = the replica
+    # is not gray, it is failing — take the DEAD/failover path
+    escalate_quarantines: int = 3
+    escalate_window_s: float = 120.0
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.enabled:
+            if self.rpc_slow_s <= 0:
+                raise ValueError("fabric.quarantine.rpc_slow_s must be > 0")
+            if self.window < 1 or self.min_samples < 1:
+                raise ValueError("fabric.quarantine.window and "
+                                 "min_samples must be >= 1")
+            if not 0.0 < self.slow_fraction <= 1.0:
+                raise ValueError("fabric.quarantine.slow_fraction must be "
+                                 "in (0, 1]")
+            if self.probe_backoff_s <= 0 \
+                    or self.probe_backoff_max_s < self.probe_backoff_s:
+                raise ValueError(
+                    "fabric.quarantine.probe_backoff_s must be > 0 and "
+                    "<= probe_backoff_max_s")
+            if self.escalate_quarantines < 1 or self.escalate_window_s <= 0:
+                raise ValueError(
+                    "fabric.quarantine.escalate_quarantines must be >= 1 "
+                    "and escalate_window_s > 0")
         return self
 
 
@@ -541,6 +606,17 @@ class FabricConfig(DSConfigModel):
     # hard bound on one wire frame; an oversized KV payload degrades to
     # the re-prefill fallback (typed FrameTooLarge, never a crash)
     max_frame_bytes: int = 64 * 1024 * 1024
+    # CRC32 frame sealing (codec v2): advertise ``crc_frames`` in every
+    # hello; when BOTH ends advertise, each wire frame carries a CRC32
+    # trailer and bit damage becomes a typed single-frame refusal
+    # (rpc_frames_corrupt) instead of a connection-killing decode
+    # error. False pins the historical v1 wire shape byte for byte
+    # (old peers get it either way — sealing is negotiated, never
+    # assumed).
+    frame_crc: bool = True
+    # gray-failure quarantine for remote replicas (docs/SERVING.md
+    # "Fleet fault tolerance"). Disabled = liveness-only health.
+    quarantine: QuarantineConfig = Field(default_factory=QuarantineConfig)
     # frontend federation (docs/SERVING.md "Frontend federation"):
     # export local replicas on ``listen`` / adopt peer frontends'
     # exports. Disabled = the single-frontend fabric, byte for byte.
@@ -639,6 +715,40 @@ class FaultsConfig(DSConfigModel):
         from .faults import FaultInjector
 
         return FaultInjector(self.schedule, seed=self.seed)
+
+
+class ChaosConfig(DSConfigModel):
+    """``chaos: {...}`` TEST-ONLY deterministic NETWORK fault injection
+    (docs/CONFIG.md, serving/fabric/chaos.py) — the wire-level sibling
+    of ``faults:``: a seeded schedule of per-link latency, bandwidth
+    throttle, connection drops, blackholes, partitions, duplicate/
+    reordered deliveries and frame bit-corruption, interposed between
+    the fabric transport and its socket. Drives the net_chaos bench
+    phase and the transport edge-case suite. Disabled = the injector is
+    never installed: zero interposition, byte-for-byte the
+    uninstrumented transport (asserted in tests)."""
+
+    enabled: bool = False
+    seed: int = 0
+    # entries: {"kind": "latency"|"throttle"|"drop_conn"|"blackhole"|
+    #                   "partition"|"duplicate"|"reorder"|"corrupt",
+    #           "link": fnmatch pattern over connection names
+    #                   (e.g. "fabric-r0", "federation-peer-*"),
+    #           "dir": "tx"|"rx"|"both" (per-kind default),
+    #           "at_frame": k | "at_frame_range": [lo, hi] (seeded),
+    #           "duration_s": t, "count": c (0 = every match),
+    #           "delay_s"/"jitter_s", "bytes_per_s", "partial_bytes",
+    #           "where": "header"|"payload", "flip_bits": n}
+    schedule: List[Dict[str, Any]] = Field(default_factory=list)
+
+    def build_injector(self):
+        """The configured :class:`~deepspeed_tpu.serving.fabric.chaos.
+        NetworkFaultInjector`, or ``None`` when disabled."""
+        if not self.enabled:
+            return None
+        from .fabric.chaos import NetworkFaultInjector
+
+        return NetworkFaultInjector(self.schedule, seed=self.seed)
 
 
 class ModelSpec(DSConfigModel):
@@ -872,3 +982,7 @@ class ServingConfig(DSConfigModel):
     # test-only deterministic fault injection (chaos suite / bench chaos
     # phase); disabled = no injection hooks anywhere on the hot path
     faults: FaultsConfig = Field(default_factory=FaultsConfig)
+    # test-only deterministic NETWORK fault injection (net_chaos bench
+    # phase / transport edge-case suite); disabled = the injector is
+    # never installed — zero transport interposition
+    chaos: ChaosConfig = Field(default_factory=ChaosConfig)

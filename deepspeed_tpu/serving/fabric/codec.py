@@ -29,13 +29,27 @@ from __future__ import annotations
 
 import json
 import struct
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-#: bump when the frame layout or placeholder scheme changes; both ends
-#: of a connection verify it in the hello exchange AND per frame
-CODEC_VERSION = 1
+#: the newest frame generation this process SPEAKS; carried in the hello
+#: exchange. v2 adds an optional CRC32 trailer (sealed frames) — layout
+#: v1 is unchanged, and encode emits it by default, so old peers still
+#: decode everything a new process sends until BOTH ends negotiated
+#: sealing in the hello (``crc_frames``).
+CODEC_VERSION = 2
+
+#: hello-acceptable peer generations: v1 peers speak the base layout
+#: (never sealed — they did not advertise), v2 peers may seal
+COMPAT_CODEC_VERSIONS = frozenset({1, 2})
+
+#: per-frame layout versions. _BASE is byte-for-byte the historical
+#: frame; _SEALED appends ``u32 crc32(frame)`` and is only ever sent to
+#: a peer that advertised ``crc_frames`` in the hello.
+_BASE_FRAME_V = 1
+_SEALED_FRAME_V = 2
 
 _HEADER_FMT = ">I"
 _HEADER_LEN = struct.calcsize(_HEADER_FMT)
@@ -74,6 +88,19 @@ class FrameTooLarge(CodecError):
         self.size, self.limit = int(size), int(limit)
         super().__init__(f"fabric frame of {size} bytes exceeds the "
                          f"{limit}-byte max_frame_bytes bound")
+
+
+class FrameCorrupt(CodecError):
+    """A sealed (v2) frame failed its CRC32 trailer check: the payload
+    was damaged in flight. Deliberately a SINGLE-FRAME refusal — the
+    transport drops the frame and keeps the connection (the caller's
+    timeout/failover machinery owns the lost frame), where every other
+    CodecError still kills the link (framing itself is suspect)."""
+
+    def __init__(self, want: int, got: int):
+        self.want, self.got = int(want), int(got)
+        super().__init__(f"fabric frame CRC mismatch: trailer "
+                         f"{want:#010x}, payload {got:#010x}")
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -139,23 +166,33 @@ def _decode_tree(obj: Any, arrays: List[np.ndarray]) -> Any:
     return obj
 
 
-def encode_frame(obj: Any, max_frame_bytes: int = 0) -> bytes:
+def encode_frame(obj: Any, max_frame_bytes: int = 0,
+                 crc: bool = False) -> bytes:
     """One self-describing frame for ``obj`` (raises the typed errors
-    above; ``max_frame_bytes`` 0 = unbounded)."""
+    above; ``max_frame_bytes`` 0 = unbounded). ``crc=False`` (the
+    default) emits the v1 layout byte-for-byte; ``crc=True`` emits a
+    SEALED v2 frame — same layout plus a ``u32 crc32`` trailer — and is
+    only valid against peers that advertised ``crc_frames`` in the
+    hello. The trailer counts toward the frame bound."""
     bufs: List[np.ndarray] = []
     meta = _encode_tree(obj, bufs)
     descs = [[a.dtype.name, list(a.shape), int(a.nbytes)] for a in bufs]
     try:
-        header = json.dumps({"v": CODEC_VERSION, "meta": meta,
+        header = json.dumps({"v": _SEALED_FRAME_V if crc else _BASE_FRAME_V,
+                             "meta": meta,
                              "bufs": descs}).encode("utf-8")
     except (TypeError, ValueError) as e:
         raise CodecError(f"fabric frame header not JSON-serializable: {e}")
-    total = _HEADER_LEN + len(header) + sum(d[2] for d in descs)
+    total = _HEADER_LEN + len(header) + sum(d[2] for d in descs) \
+        + (4 if crc else 0)
     if max_frame_bytes and total > max_frame_bytes:
         raise FrameTooLarge(total, max_frame_bytes)
     parts = [struct.pack(_HEADER_FMT, len(header)), header]
     parts.extend(a.tobytes() for a in bufs)
-    return b"".join(parts)
+    out = b"".join(parts)
+    if crc:
+        out += struct.pack(">I", zlib.crc32(out) & 0xFFFFFFFF)
+    return out
 
 
 def decode_frame(data: bytes, max_frame_bytes: int = 0) -> Any:
@@ -176,8 +213,22 @@ def decode_frame(data: bytes, max_frame_bytes: int = 0) -> Any:
         raise CodecError(f"fabric frame header unparsable: {e}")
     if not isinstance(header, dict):
         raise CodecError("fabric frame header is not an object")
-    if header.get("v") != CODEC_VERSION:
-        raise VersionMismatch(header.get("v"))
+    v = header.get("v")
+    if v not in (_BASE_FRAME_V, _SEALED_FRAME_V):
+        raise VersionMismatch(v)
+    limit = len(data)
+    if v == _SEALED_FRAME_V:
+        # sealed frame: verify-then-strip the CRC32 trailer BEFORE
+        # trusting the buffer descriptors — damage anywhere past the
+        # (already-parsed) header surfaces as the typed single-frame
+        # FrameCorrupt refusal, not as garbage KV bytes
+        if len(data) < _HEADER_LEN + hlen + 4:
+            raise CodecError("fabric frame truncated inside its trailer")
+        (want,) = struct.unpack(">I", data[-4:])
+        got = zlib.crc32(data[:-4]) & 0xFFFFFFFF
+        if want != got:
+            raise FrameCorrupt(want, got)
+        limit = len(data) - 4
     arrays: List[np.ndarray] = []
     off = _HEADER_LEN + hlen
     for desc in header.get("bufs", ()):
@@ -187,7 +238,7 @@ def decode_frame(data: bytes, max_frame_bytes: int = 0) -> Any:
             raise CodecError(f"malformed buffer descriptor {desc!r}")
         dtype = _np_dtype(name)
         try:
-            if off + nbytes > len(data):
+            if off + nbytes > limit:
                 raise CodecError("fabric frame truncated inside a buffer")
             arr = np.frombuffer(data, dtype=dtype,
                                 count=nbytes // dtype.itemsize,

@@ -49,12 +49,13 @@ from ...utils.locks import RankedLock
 from ...utils.logging import logger
 from ..replica import ReplicaState
 from ..request import DoneEvent, FinishReason, RequestState
-from .codec import (CODEC_VERSION, FrameTooLarge, payload_chunks,
-                    payload_from_chunks, request_from_wire)
+from .codec import (CODEC_VERSION, COMPAT_CODEC_VERSIONS, FrameTooLarge,
+                    payload_chunks, payload_from_chunks, request_from_wire)
 from .remote import RemoteHandle
 from .server import (JOURNAL_EVENTS_PER_STATUS, STATUS_INTERVAL_S,
                      DigestStream)
-from .transport import Connection, FabricError, dial, parse_address
+from .transport import (STALE_FLOOR_S, STALE_HEARTBEATS, Connection,
+                        FabricError, dial, parse_address)
 
 #: typed hello-refusal markers a retry can never fix — the connect
 #: backoff re-raises instead of burning its breaker on them
@@ -229,6 +230,7 @@ class FederationPeer:
                 "role": "frontend",
                 "frontend_id": self.frontend_id,
                 "epoch": self.epoch,
+                "crc_frames": bool(getattr(fab, "frame_crc", True)),
                 "max_frame_bytes": int(fab.max_frame_bytes)},
                 timeout_s=fab.rpc_timeout_s)
         except FabricError as e:
@@ -237,6 +239,9 @@ class FederationPeer:
                     or "version_mismatch:" in str(e):
                 raise FederationRefused(str(e)) from e
             raise
+        if info.get("crc_frames") and getattr(fab, "frame_crc", True):
+            conn.crc_tx = True
+            conn.crc_rx = True
         self._conn = conn
         self.peer_id = info.get("frontend_id")
         self.peer_epoch = info.get("epoch")
@@ -285,6 +290,9 @@ class _Channel:
         # starts at 0 so a fresh channel replays the exporter's ring —
         # the adopter's FleetJournal dedupes by per-source seq
         self.journal_fwd_seq = 0
+        # partition edge-detector (status thread only): peer_partition
+        # is journaled once per silence episode, not once per sweep tick
+        self.partition_journaled = False
 
 
 class FederationServer:
@@ -445,6 +453,7 @@ class FederationServer:
         try:
             handler = {"hello": self._rpc_hello,
                        "assign": self._rpc_assign,
+                       "probe": self._rpc_probe,
                        "evacuate": self._rpc_evacuate}.get(method)
             if handler is None:
                 conn.respond(call_id, error=f"unknown method {method!r}")
@@ -495,8 +504,15 @@ class FederationServer:
                 return h
         return None
 
+    def _rpc_probe(self, p: dict, ch: _Channel) -> dict:
+        """Quarantine liveness/latency probe on an adopted export: the
+        caller measures the round-trip; answer immediately."""
+        rep = self._local_handle(ch.export_rid)
+        return {"replica_id": ch.export_rid,
+                "state": rep.state.value if rep is not None else None}
+
     def _rpc_hello(self, p: dict, ch: _Channel) -> dict:
-        if int(p.get("codec_version", -1)) != CODEC_VERSION:
+        if int(p.get("codec_version", -1)) not in COMPAT_CODEC_VERSIONS:
             raise ValueError(
                 f"version_mismatch: server codec v{CODEC_VERSION}, "
                 f"client v{p.get('codec_version')!r}")
@@ -531,6 +547,11 @@ class FederationServer:
             ch.conn.send_max_bytes = (
                 min(self.max_frame_bytes, client_bound)
                 if self.max_frame_bytes else client_bound)
+        # CRC sealing, client-driven like the replica-server hello
+        crc = bool(p.get("crc_frames", False))
+        if crc:
+            ch.conn.crc_tx = True
+            ch.conn.crc_rx = True
         ch.peer_id = fid
         ch.epoch = epoch
         ch.deltas = bool(p.get("digest_deltas", False))
@@ -546,6 +567,7 @@ class FederationServer:
                 pass
             return {"frontend_id": self.frontend_id, "epoch": self.epoch,
                     "codec_version": CODEC_VERSION, "pid": os.getpid(),
+                    "crc_frames": crc,
                     "max_frame_bytes": int(self.max_frame_bytes),
                     "exports": self._exports()}
         rid = int(p["export"])
@@ -564,6 +586,7 @@ class FederationServer:
         return {"replica_id": rid, "role": getattr(h, "role", "mixed"),
                 "codec_version": CODEC_VERSION, "pid": os.getpid(),
                 "model_id": getattr(h, "model_id", "default"),
+                "crc_frames": crc,
                 "max_frame_bytes": int(self.max_frame_bytes),
                 "max_seq_len": int(eng.model.cfg.max_seq_len),
                 "max_seats": int(eng.config.max_ragged_sequence_count),
@@ -709,6 +732,57 @@ class FederationServer:
                            "reason": req.finish_reason,
                            "state": req.state.value})
 
+    # --------------------------------------------------------------- leases
+    def _sweep_leases(self, exports: List[_Channel],
+                      boots: List[_Channel]) -> None:
+        """Partition-tolerant seat leases (docs/SERVING.md "Frontend
+        federation"): borrowed capacity must come HOME when the adopter
+        can no longer be reached — its mirrors are already failing over
+        on its side of the partition, so seats it holds here serve
+        nobody. An export channel silent past ``lease_timeout_s``
+        (chaos-discarded frames never count as received) expires: the
+        close cancels this channel's mirrors, their KV frees, and local
+        traffic gets the seats back. Heal = the adopter re-adopts over
+        fresh channels under its epoch; the per-source journal seq keeps
+        the fleet's event view exactly-once across the replay."""
+        lease_s = float(getattr(self._fed, "lease_timeout_s", 0.0) or 0.0)
+        stale_s = (max(STALE_FLOOR_S, STALE_HEARTBEATS * self.heartbeat_s)
+                   if self.heartbeat_s > 0 else 0.0)
+        for ch in boots:
+            conn = ch.conn
+            if conn is None or stale_s <= 0:
+                continue
+            idle = conn.rx_idle_s
+            if idle > stale_s and not ch.partition_journaled:
+                ch.partition_journaled = True
+                try:
+                    self.journal.emit("peer_partition", peer=ch.peer_id,
+                                      idle_s=round(idle, 3))
+                except Exception:   # journal must never kill serving
+                    pass
+            elif idle <= stale_s:
+                ch.partition_journaled = False
+        if lease_s <= 0:
+            return
+        for ch in exports:
+            conn = ch.conn
+            if conn is None or conn.rx_idle_s <= lease_s:
+                continue
+            try:
+                self.journal.emit("lease_expired", peer=ch.peer_id,
+                                  replica=ch.export_rid,
+                                  idle_s=round(conn.rx_idle_s, 3))
+            except Exception:
+                pass
+            m = getattr(self.frontend, "metrics", None)
+            if m is not None:
+                m.counter("federation_leases_expired").inc()
+            logger.warning(
+                f"federation server {self.frontend_id}: seat lease on "
+                f"replica {ch.export_rid} to peer {ch.peer_id!r} expired "
+                f"after {conn.rx_idle_s:.1f}s of silence")
+            conn.close("federation lease expired")
+
     # -------------------------------------------------------------- status
     def _status_loop(self) -> None:
         while not self._stop.is_set():
@@ -716,6 +790,8 @@ class FederationServer:
             with self._lock:
                 exports = [c for c in self._channels
                            if c.kind == "export"]
+                boots = [c for c in self._channels if c.kind == "boot"]
+            self._sweep_leases(exports, boots)
             for ch in exports:
                 conn = ch.conn
                 if conn is None or not conn.alive:

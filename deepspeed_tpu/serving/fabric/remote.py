@@ -30,7 +30,9 @@ carry the transport's health (docs/OBSERVABILITY.md).
 from __future__ import annotations
 
 import random
+import threading
 import time
+from collections import deque
 from typing import Callable, Dict, Optional
 
 from ...telemetry.fleet import ingest_remote_spans, source_id_offset
@@ -41,7 +43,7 @@ from ..replica import ReplicaState
 from ..request import FinishReason, RequestState, ServingRequest
 from .codec import CODEC_VERSION, FrameTooLarge, ModelMismatch, \
     payload_chunks, payload_from_chunks, request_to_wire
-from .transport import ConnectionLost, FabricError, dial
+from .transport import ConnectionLost, FabricError, RPCTimeout, dial
 
 #: default byte bound for the ``dump`` RPC response (well under the
 #: 64 MiB frame ceiling; callers may lower it per pull)
@@ -124,6 +126,8 @@ class RemoteHandle:
         "_out_decode": "_lock",
         "_failed_uids": "_lock",
         "_active": "_lock",
+        "_q_samples": "_lock",
+        "_q_history": "_lock",
     }
 
     #: autoscaler/frontend probe: remote capacity is owned by its server
@@ -153,6 +157,10 @@ class RemoteHandle:
         "kv_tier_blocks_dropped",
         "sequences_preempted", "sequences_resumed",
         "handoffs_completed", "handoff_fallbacks",
+        # corrupt frames the SERVER refused on this pair's connection —
+        # the client-side refusals land in the frontend registry
+        # directly via the transport's on_corrupt hook
+        "rpc_frames_corrupt",
     )
 
     def __init__(self, replica_id: int, address: str, fabric_config, *,
@@ -231,7 +239,22 @@ class RemoteHandle:
         self._restart = RestartPolicy(
             backoff_s=0.05, backoff_max_s=1.0, jitter=0.2,
             max_failures_in_window=6, window_s=60.0,
-            rng=random.Random(1000 + replica_id))
+            rng=random.Random(1000 + replica_id), full_jitter=True)
+        # gray-failure quarantine (docs/SERVING.md "Fleet fault
+        # tolerance"): rolling slow/deadline-miss scoring over the
+        # rpc_call_s samples already taken in _call. None/disabled =
+        # zero overhead beyond one attribute test per RPC.
+        self._qcfg = getattr(fabric_config, "quarantine", None)
+        if self._qcfg is not None and not getattr(self._qcfg, "enabled",
+                                                  False):
+            self._qcfg = None
+        win = int(getattr(self._qcfg, "window", 32) or 32)
+        self._q_samples: "deque[int]" = deque(maxlen=max(1, win))
+        self._q_history: "deque[float]" = deque()
+        self._q_since = 0.0                 # entered QUARANTINED at
+        self._q_probe_next = 0.0
+        self._q_probe_backoff = 0.0
+        self._q_probing = False             # one probe thread at a time
         self.thread = _ThreadFacade(self)
         self.engine = None                  # _EngineFacade after connect
 
@@ -255,6 +278,11 @@ class RemoteHandle:
             # servers ignore the flag, a non-tracing frontend never
             # sets it — the byte-parity story stays intact
             "telemetry": bool(self.tracer.enabled),
+            # CRC frame sealing (codec v2): advertise decode capability;
+            # a server that also speaks v2 echoes the flag back and both
+            # directions seal. Old servers ignore it, and frame_crc
+            # False pins the PR 19 byte-for-byte wire shape.
+            "crc_frames": bool(getattr(self.fabric, "frame_crc", True)),
             "reset": bool(reset)}
 
     def connect(self, reset: bool = False) -> None:
@@ -272,6 +300,7 @@ class RemoteHandle:
                     max_frame_bytes=self.fabric.max_frame_bytes,
                     heartbeat_s=self.fabric.heartbeat_s,
                     on_event=self._on_event,
+                    on_corrupt=self._on_frame_corrupt,
                     name=f"fabric-r{self.replica_id}")
                 info = self._call("hello", self._hello_payload(reset))
                 # model identity check (docs/SERVING.md "Multi-model &
@@ -296,6 +325,15 @@ class RemoteHandle:
                     mine = int(self.fabric.max_frame_bytes)
                     self._conn.send_max_bytes = (min(mine, srv_bound)
                                                  if mine else srv_bound)
+                # CRC negotiation: the server echoes ``crc_frames`` only
+                # when BOTH ends advertised — from here every frame each
+                # way carries the v2 trailer, and bit damage on this
+                # link is a typed single-frame refusal, not a
+                # connection-killing CodecError
+                if info.get("crc_frames") and getattr(
+                        self.fabric, "frame_crc", True):
+                    self._conn.crc_tx = True
+                    self._conn.crc_rx = True
                 break
             except (OSError, FabricError) as e:
                 last_err = e
@@ -359,10 +397,14 @@ class RemoteHandle:
         t0 = time.monotonic()
         if self.metrics is not None:
             self.metrics.gauge("rpc_inflight").inc()
+        miss = False
         try:
             return conn.call(method, payload,
                              timeout_s=(timeout_s if timeout_s is not None
                                         else self.fabric.rpc_timeout_s))
+        except RPCTimeout:
+            miss = True                     # deadline miss = slow sample
+            raise
         finally:
             dt = time.monotonic() - t0
             self._rpc_calls += 1
@@ -370,6 +412,8 @@ class RemoteHandle:
             if self.metrics is not None:
                 self.metrics.gauge("rpc_inflight").dec()
                 self.metrics.histogram("rpc_call_s").observe(dt)
+            if self._qcfg is not None:
+                self._q_observe(dt, miss)
 
     def _notify(self, msg: dict) -> bool:
         conn = self._conn
@@ -380,6 +424,132 @@ class RemoteHandle:
             return True
         except FabricError:
             return False
+
+    def _on_frame_corrupt(self) -> None:
+        """Transport reader hook: one sealed frame failed its CRC and
+        was refused (connection intact)."""
+        if self.metrics is not None:
+            self.metrics.counter("rpc_frames_corrupt").inc()
+
+    # --------------------------------------------------------- quarantine
+    # Gray failure: a replica that ANSWERS — so the liveness machinery
+    # sees nothing — but too slowly to be worth routing to. The scoring
+    # rides the rpc_call_s samples _call already takes: a sample is bad
+    # when it exceeded ``rpc_slow_s`` or missed its deadline outright,
+    # and when ``slow_fraction`` of the last ``window`` samples are bad
+    # the handle leaves the routable set (QUARANTINED: accepting False,
+    # in-flight streams keep running). Probe RPCs on exponential backoff
+    # re-admit it; re-quarantining ``escalate_quarantines`` times inside
+    # ``escalate_window_s`` stops giving benefit of the doubt and takes
+    # the ordinary DEAD/failover path.
+
+    def _q_observe(self, dt: float, miss: bool) -> None:
+        q = self._qcfg
+        if q is None:
+            return
+        fire = False
+        n = 0
+        with self._lock:
+            self._q_samples.append(
+                1 if (miss or dt >= q.rpc_slow_s) else 0)
+            n = len(self._q_samples)
+            if (self.state == ReplicaState.HEALTHY
+                    and n >= max(1, q.min_samples)):
+                frac = sum(self._q_samples) / n
+                fire = frac >= q.slow_fraction
+        if fire:
+            self._quarantine(f"slow RPCs: >= {q.slow_fraction:.0%} of "
+                             f"last {n} calls over {q.rpc_slow_s}s")
+
+    def _quarantine(self, reason: str) -> None:
+        q = self._qcfg
+        now = time.monotonic()
+        with self._lock:
+            if self.state != ReplicaState.HEALTHY:
+                return
+            self._q_history.append(now)
+            while self._q_history and \
+                    now - self._q_history[0] > q.escalate_window_s:
+                self._q_history.popleft()
+            n_hist = len(self._q_history)
+            escalate = n_hist >= max(1, q.escalate_quarantines)
+            if not escalate:
+                self.state = ReplicaState.QUARANTINED
+                self._q_since = now
+                self._q_probe_backoff = q.probe_backoff_s
+                self._q_probe_next = now + self._q_probe_backoff
+                self._q_samples.clear()
+        if escalate:
+            # benefit of the doubt exhausted: repeated gray failure is
+            # failure — DEAD fails the mirrored streams over (PR 5 path)
+            # and the supervisor owns recovery
+            self._mark_dead(f"quarantine escalation "
+                            f"({n_hist} quarantines in "
+                            f"{q.escalate_window_s}s): {reason}")
+            return
+        logger.warning(f"fabric replica {self.replica_id} QUARANTINED: "
+                       f"{reason}")
+        if self.journal is not None:
+            try:
+                self.journal.emit("replica_quarantined",
+                                  replica=self.replica_id, reason=reason)
+            except Exception:       # journal must never kill serving
+                pass
+
+    def _maybe_probe(self, now: float) -> None:
+        """check_health tick while QUARANTINED: launch at most one probe
+        RPC at a time, off-thread (the health sweep must never block on
+        a slow peer — that is the failure being probed)."""
+        with self._lock:
+            if (self.state != ReplicaState.QUARANTINED
+                    or self._q_probing or now < self._q_probe_next):
+                return
+            self._q_probing = True
+        threading.Thread(target=self._probe_once, daemon=True,
+                         name=f"fabric-r{self.replica_id}-probe").start()
+
+    def _probe_once(self) -> None:
+        q = self._qcfg
+        t0 = time.monotonic()
+        try:
+            try:
+                self._call("probe", {}, timeout_s=max(q.rpc_slow_s, 0.05))
+                ok = True
+            except (RPCTimeout, ConnectionLost):
+                ok = False
+            except FabricError:
+                # an ERROR RESPONSE is still a fast round-trip — a peer
+                # that predates the probe method refuses quickly, and
+                # latency is what is on trial here, not the method table
+                ok = time.monotonic() - t0 < q.rpc_slow_s
+            if ok:
+                self._readmit()
+            else:
+                with self._lock:
+                    self._q_probe_backoff = min(
+                        self._q_probe_backoff * 2.0, q.probe_backoff_max_s)
+                    self._q_probe_next = time.monotonic() \
+                        + self._q_probe_backoff
+        finally:
+            with self._lock:
+                self._q_probing = False
+
+    def _readmit(self) -> None:
+        with self._lock:
+            if self.state != ReplicaState.QUARANTINED:
+                return
+            self.state = ReplicaState.HEALTHY
+            held_s = time.monotonic() - self._q_since
+            self._q_samples.clear()
+        logger.info(f"fabric replica {self.replica_id} re-admitted after "
+                    f"{held_s:.2f}s in quarantine")
+        if self.journal is not None:
+            try:
+                self.journal.emit("replica_readmitted",
+                                  replica=self.replica_id,
+                                  quarantined_s=round(held_s, 3))
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ routing
     @property
@@ -720,7 +890,8 @@ class RemoteHandle:
         # the handle's whole life under evacuation/restart churn.
         with self._lock:
             if self._failed_uids and self.state in (
-                    ReplicaState.HEALTHY, ReplicaState.DRAINING):
+                    ReplicaState.HEALTHY, ReplicaState.DRAINING,
+                    ReplicaState.QUARANTINED):
                 self._failed_uids.clear()
         self._server_thread_alive = bool(msg.get("thread_alive", True))
         self._last_occupancy = msg.get("occupancy") or {}
@@ -878,6 +1049,11 @@ class RemoteHandle:
         if conn is None or not conn.alive:
             self._mark_dead(conn.close_reason if conn is not None
                             and conn.close_reason else "transport lost")
+        elif self.state == ReplicaState.QUARANTINED:
+            # a quarantined replica is still CONNECTED (that's what makes
+            # the failure gray) — the health sweep is where its probe
+            # clock ticks
+            self._maybe_probe(time.monotonic() if now is None else now)
         return self.state
 
     # -------------------------------------------------------- observability

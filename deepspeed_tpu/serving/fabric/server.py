@@ -45,8 +45,8 @@ from ...utils.logging import logger
 from ..metrics import serving_metrics
 from ..replica import Replica, ReplicaState
 from ..request import FinishReason, RequestState, DoneEvent
-from .codec import (CODEC_VERSION, FrameTooLarge, payload_chunks,
-                    payload_from_chunks, request_from_wire)
+from .codec import (CODEC_VERSION, COMPAT_CODEC_VERSIONS, FrameTooLarge,
+                    payload_chunks, payload_from_chunks, request_from_wire)
 from .remote import DUMP_MAX_BYTES, RemoteHandle
 from .transport import Connection, FabricError, parse_address
 
@@ -225,12 +225,19 @@ class ReplicaServer:
                 heartbeat_s=self.heartbeat_s,
                 on_event=lambda msg: self._on_msg(msg, holder["conn"]),
                 on_close=self._on_conn_close,
+                on_corrupt=self._on_frame_corrupt,
                 name=f"fabric-server-{self.replica_id}")
             holder["conn"] = conn
             self._conn = conn
             conn.start()
             logger.info(f"fabric replica server {self.replica_id}: "
                         f"frontend connected from {addr}")
+
+    def _on_frame_corrupt(self) -> None:
+        """Transport reader hook: a sealed frame failed its CRC and was
+        refused. Counts server-side; the frontend mirrors it via the
+        forwarded-counter stream (``rpc_frames_corrupt``)."""
+        self.registry.counter("rpc_frames_corrupt").inc()
 
     def _on_conn_close(self, reason: str) -> None:
         """Frontend gone: cancel in-flight work so its KV frees (the
@@ -360,6 +367,7 @@ class ReplicaServer:
             handler = {"hello": self._rpc_hello,
                        "assign": self._rpc_assign,
                        "evacuate": self._rpc_evacuate,
+                       "probe": self._rpc_probe,
                        "dump": self._rpc_dump}.get(method)
             if handler is None:
                 conn.respond(call_id, error=f"unknown method {method!r}")
@@ -375,10 +383,20 @@ class ReplicaServer:
             except FabricError:
                 pass
 
+    def _rpc_probe(self, p: dict, conn: Connection) -> dict:
+        """Quarantine liveness/latency probe: answer as cheaply as
+        possible — the CALLER measures the round-trip; all this end owes
+        it is an immediate reply."""
+        return {"replica_id": self.replica_id,
+                "state": (self.replica.state.value
+                          if self.replica is not None else None)}
+
     def _rpc_hello(self, p: dict, conn: Connection) -> dict:
-        if int(p.get("codec_version", -1)) != CODEC_VERSION:
+        if int(p.get("codec_version", -1)) not in COMPAT_CODEC_VERSIONS:
             # typed refusal, matched by RemoteHandle.connect: a peer from
-            # a different codec generation must never be half-spoken to
+            # an incompatible codec generation must never be half-spoken
+            # to (v1 and v2 interoperate: v2 only seals after BOTH ends
+            # advertise, so a v1 peer simply never sees a trailer)
             raise ValueError(
                 f"version_mismatch: server codec v{CODEC_VERSION}, "
                 f"client v{p.get('codec_version')!r}")
@@ -392,6 +410,14 @@ class ReplicaServer:
             conn.send_max_bytes = (min(self.max_frame_bytes, client_bound)
                                    if self.max_frame_bytes
                                    else client_bound)
+        # CRC sealing is client-driven: a client that advertised
+        # ``crc_frames`` gets sealed frames both ways (the reply echoes
+        # the flag so it flips its own direction on); one that didn't —
+        # every pre-v2 peer — keeps the historical wire shape
+        crc = bool(p.get("crc_frames", False))
+        if crc:
+            conn.crc_tx = True
+            conn.crc_rx = True
         # digest deltas are OPT-IN per connection: a client that never
         # advertised keeps getting the full-snapshot wire shape
         self._digest_deltas = bool(p.get("digest_deltas", False))
@@ -423,6 +449,7 @@ class ReplicaServer:
                 "codec_version": CODEC_VERSION, "pid": os.getpid(),
                 "model_id": self.model_id, "source": self.source,
                 "telemetry": self.tracer.enabled,
+                "crc_frames": crc,
                 "max_frame_bytes": int(self.max_frame_bytes),
                 "max_seq_len": int(eng.model.cfg.max_seq_len),
                 "max_seats": int(eng.config.max_ragged_sequence_count),

@@ -38,8 +38,10 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 from ...utils.locks import RankedLock
 from ...utils.logging import logger
-from .codec import (CodecError, FrameTooLarge, decode_frame,  # noqa: F401
-                    encode_frame)
+from . import chaos as _chaos
+from .chaos import ChaosKill
+from .codec import (CodecError, FrameCorrupt, FrameTooLarge,  # noqa: F401
+                    decode_frame, encode_frame)
 
 _LEN_FMT = ">I"
 _LEN_SIZE = struct.calcsize(_LEN_FMT)
@@ -154,6 +156,7 @@ class Connection:
                  heartbeat_s: float = 0.0,
                  on_event: Optional[Callable[[dict], None]] = None,
                  on_close: Optional[Callable[[str], None]] = None,
+                 on_corrupt: Optional[Callable[[], None]] = None,
                  name: str = "fabric"):
         self.name = name
         self.max_frame_bytes = int(max_frame_bytes)
@@ -164,10 +167,26 @@ class Connection:
         # whole connection, which after negotiation only a
         # non-conforming peer can trigger.
         self.send_max_bytes = 0
+        # CRC frame sealing (docs/SERVING.md "Fleet fault tolerance"),
+        # hello-negotiated per direction: crc_tx seals outgoing frames
+        # (codec v2 trailer), crc_rx records that the PEER seals — which
+        # widens undecodable-frame handling on this link from
+        # connection-death to the single-frame corrupt refusal (framing
+        # survives bit damage because the trailer proves it). Both stay
+        # False against old peers: the PR 19 wire shape byte for byte.
+        self.crc_tx = False
+        self.crc_rx = False
+        #: frames refused by the corrupt-frame path (reader-confined)
+        self.frames_corrupt = 0
         self.heartbeat_s = float(heartbeat_s)
         self._sock = sock
         self._on_event = on_event
         self._on_close = on_close
+        self._on_corrupt = on_corrupt
+        # network chaos shim (fabric/chaos.py): None unless an installed
+        # injector schedule matches this connection's name — the
+        # historical branch-free path when chaos is off (asserted)
+        self._chaos = _chaos.attach(name)
         self._lock = RankedLock("serving.fabric.transport")
         self._pending: Dict[int, dict] = {}
         self._next_id = 0
@@ -213,6 +232,13 @@ class Connection:
         return True
 
     @property
+    def rx_idle_s(self) -> float:
+        """Seconds since a frame ACTUALLY arrived (chaos-discarded
+        frames never refresh this) — the federation seat-lease sweep's
+        staleness input."""
+        return time.monotonic() - self._last_rx
+
+    @property
     def close_reason(self) -> str:
         return self._close_reason
 
@@ -241,7 +267,8 @@ class Connection:
         if self._dead:
             raise ConnectionLost(self._close_reason or "connection closed")
         self._outbox.put(encode_frame(
-            msg, self.send_max_bytes or self.max_frame_bytes))
+            msg, self.send_max_bytes or self.max_frame_bytes,
+            crc=self.crc_tx))
 
     def call(self, method: str, payload: Optional[dict] = None,
              timeout_s: float = 30.0) -> Any:
@@ -291,7 +318,13 @@ class Connection:
             if body is None:
                 return
             try:
-                send_frame(self._sock, body)
+                if self._chaos is not None:
+                    self._chaos.send(self._sock, body)
+                else:
+                    send_frame(self._sock, body)
+            except ChaosKill as e:
+                self._die(f"chaos: {e}")
+                return
             except OSError as e:
                 self._die(f"send failed: {e!r}")
                 return
@@ -306,26 +339,72 @@ class Connection:
             if body is None:
                 self._die("peer closed")
                 return
+            if self._chaos is not None:
+                try:
+                    bodies = self._chaos.recv(body)
+                except ChaosKill as e:
+                    self._die(f"chaos: {e}")
+                    return
+                if not bodies:
+                    # blackholed/partitioned frame: as far as this
+                    # endpoint knows it never arrived — liveness is NOT
+                    # refreshed, so the staleness detector sees the
+                    # half-open link exactly like a silent peer
+                    continue
+            else:
+                bodies = (body,)
             self._last_rx = time.monotonic()
+            for body in bodies:
+                if not self._handle_body(body):
+                    return
+
+    def _handle_body(self, body: bytes) -> bool:
+        """Decode and dispatch one frame body; False when the connection
+        died (the reader loop must exit)."""
+        try:
+            msg = decode_frame(body)
+            if not isinstance(msg, dict):
+                raise CodecError(f"fabric message is a "
+                                 f"{type(msg).__name__}, not an "
+                                 "object")
+        except FrameCorrupt as e:
+            self._refuse_corrupt(repr(e))
+            return True
+        except CodecError as e:
+            if self.crc_rx:
+                # the peer seals every frame on this link, so an
+                # unparsable one is bit damage (a flip inside the header
+                # JSON breaks parsing before the trailer check can vouch
+                # for it) — same single-frame refusal, connection intact
+                self._refuse_corrupt(repr(e))
+                return True
+            # a frame this end cannot parse means the two sides no
+            # longer speak the same protocol — kill the connection
+            # (typed, logged), never limp on with garbage
+            self._die(f"undecodable frame: {e!r}")
+            return False
+        except Exception as e:  # pragma: no cover - last resort
+            # the codec's contract is typed errors only, but a
+            # surprise here must still take the dead-connection
+            # transition, never silently lose the reader thread
+            self._die(f"frame decode crashed: {e!r}")
+            return False
+        self._handle(msg)
+        return True
+
+    def _refuse_corrupt(self, detail: str) -> None:
+        """Partition-tolerant refusal (docs/SERVING.md "Fleet fault
+        tolerance"): drop ONE damaged frame — typed, counted — and keep
+        the connection. The lost frame is owned by its higher layer
+        (call timeout, next status tick, failover); killing the link
+        would fail every in-flight stream on it."""
+        self.frames_corrupt += 1
+        logger.warning(f"{self.name}: corrupt frame refused ({detail})")
+        if self._on_corrupt is not None:
             try:
-                msg = decode_frame(body)
-                if not isinstance(msg, dict):
-                    raise CodecError(f"fabric message is a "
-                                     f"{type(msg).__name__}, not an "
-                                     "object")
-            except CodecError as e:
-                # a frame this end cannot parse means the two sides no
-                # longer speak the same protocol — kill the connection
-                # (typed, logged), never limp on with garbage
-                self._die(f"undecodable frame: {e!r}")
-                return
-            except Exception as e:  # pragma: no cover - last resort
-                # the codec's contract is typed errors only, but a
-                # surprise here must still take the dead-connection
-                # transition, never silently lose the reader thread
-                self._die(f"frame decode crashed: {e!r}")
-                return
-            self._handle(msg)
+                self._on_corrupt()
+            except Exception:   # pragma: no cover - defensive
+                pass
 
     def _handle(self, msg: dict) -> None:
         kind = msg.get("t")
@@ -398,6 +477,14 @@ class Connection:
             slot["done"].set()
         self._outbox.put(None)              # writer exits
         try:
+            # shutdown, not just close: close() defers the real fd close
+            # while our own reader is blocked in recv on it, so the peer
+            # would never see FIN — a self-initiated death must be
+            # promptly visible on the other end
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -412,11 +499,32 @@ class Connection:
         self._die(reason)
 
 
+#: per-process cap on CONCURRENT dial() connect attempts — the other
+#: half of reconnect-storm protection (full-jitter backoff spreads the
+#: attempts in time, this bounds them in flight): a frontend holding
+#: many handles to one restarted peer queues its re-dials here instead
+#: of stampeding the listener's accept backlog.
+DIAL_MAX_CONCURRENT = 8
+_dial_gate = threading.BoundedSemaphore(DIAL_MAX_CONCURRENT)
+
+
+def set_dial_concurrency(n: int) -> None:
+    """Resize the process-wide dial gate (ops tuning / tests). Attempts
+    already waiting on the old gate finish under it."""
+    global DIAL_MAX_CONCURRENT, _dial_gate
+    DIAL_MAX_CONCURRENT = max(1, int(n))
+    _dial_gate = threading.BoundedSemaphore(DIAL_MAX_CONCURRENT)
+
+
 def dial(address: str, *, timeout_s: float = 5.0,
          **conn_kwargs) -> Connection:
-    """Connect to a replica server and start the connection threads."""
+    """Connect to a replica server and start the connection threads.
+    The TCP connect itself runs under the process-wide dial gate
+    (``DIAL_MAX_CONCURRENT``); the connection, once up, is not."""
     host, port = parse_address(address)
-    sock = socket.create_connection((host, port), timeout=timeout_s)
+    gate = _dial_gate
+    with gate:
+        sock = socket.create_connection((host, port), timeout=timeout_s)
     sock.settimeout(None)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     conn = Connection(sock, **conn_kwargs)

@@ -346,6 +346,16 @@ class ServingFrontend:
         # deterministic fault injection (test-only; serving/faults.py) —
         # None when the ``faults:`` block is off: no hooks, no proxies
         self.injector = self.config.faults.build_injector()
+        # deterministic NETWORK fault injection (test-only;
+        # serving/fabric/chaos.py) — installed process-wide so every
+        # connection dialed or accepted from here on interposes its
+        # matching schedule; None when the ``chaos:`` block is off: the
+        # transport never sees a shim (byte-for-byte, asserted)
+        self.net_chaos = self.config.chaos.build_injector()
+        if self.net_chaos is not None:
+            from .fabric import chaos as _net_chaos
+
+            _net_chaos.install(self.net_chaos)
         # disaggregated prefill/decode serving (docs/SERVING.md
         # "Disaggregated serving"): role-split replicas + host-RAM KV
         # handoff staging. None when disabled — no role enforcement, no
@@ -393,12 +403,14 @@ class ServingFrontend:
 
             # with fabric peers, the supervisor's engine source resolves
             # peer slots to _PeerRef sentinels (restart = fresh handle +
-            # server-side engine reset) and local slots to the caller's
-            # factory
+            # server-side engine reset), federated slots to their
+            # _ExportRef (restart = re-adoption over the same export),
+            # and local slots to the caller's factory
             self.supervisor = ReplicaSupervisor(
                 self.router, self._build_replica,
                 (self._engine_source
-                 if (self._peer_addrs or self._model_factories)
+                 if (self._peer_addrs or self._model_factories
+                     or self._federated_refs)
                  else engine_factory),
                 config=ft, metrics=self.metrics, tracer=self.tracer,
                 recorder=self.recorder, journal=self.journal)
@@ -1864,3 +1876,11 @@ class ServingFrontend:
             self._federation_server.stop()
         for peer in self._federation_peers:
             peer.close()
+        if self.net_chaos is not None:
+            # uninstall only OUR injector: a test running two frontends
+            # must not have the survivor's schedule torn down by the
+            # first shutdown
+            from .fabric import chaos as _net_chaos
+
+            if _net_chaos.installed() is self.net_chaos:
+                _net_chaos.uninstall()

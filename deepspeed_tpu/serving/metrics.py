@@ -421,6 +421,13 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # servers; disconnects = transport losses that turned a
               # remote handle DEAD (each one fires the failover path)
               "rpc_retries", "handle_disconnects",
+              # fleet fault tolerance (docs/SERVING.md "Fleet fault
+              # tolerance"): sealed (CRC v2) frames refused for bit
+              # damage — each one is a single-frame drop, never a
+              # connection loss; federation seat leases the exporter
+              # expired because the adopter went silent past
+              # lease_timeout_s (the borrowed seats returned home)
+              "rpc_frames_corrupt", "federation_leases_expired",
               # fleet KV locality (docs/SERVING.md "Fleet KV locality"):
               # hits = picks the affinity credit steered to a warm
               # replica; misses = hashable prompts no replica (or only
@@ -455,6 +462,10 @@ def serving_metrics(classes: Sequence[str] = STOCK_CLASSES,
               # brownout_active: 1 while the admission queue is shedding
               # lowest-urgency work under degraded capacity
               "replicas_parked", "capacity_alarm", "brownout_active",
+              # gray-failure quarantine (docs/SERVING.md "Fleet fault
+              # tolerance"): remote replicas currently QUARANTINED —
+              # connected but too slow to route to; probes re-admit
+              "replicas_quarantined",
               # SLO burn-rate alerting (docs/OBSERVABILITY.md "SLOs and
               # burn-rate alerts"): number of alert rules currently
               # firing; per-rule alert_firing_<rule> gauges are declared

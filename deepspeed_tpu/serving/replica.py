@@ -42,6 +42,11 @@ from .request import FinishReason, RequestState, ServingRequest
 class ReplicaState(enum.Enum):
     HEALTHY = "healthy"
     DRAINING = "draining"
+    # Gray failure: the replica answers RPCs but too slowly (or misses
+    # deadlines) — the router stops handing it fresh work while in-flight
+    # streams run to completion, and probe RPCs on backoff re-admit it.
+    # Only remote handles enter this state; local replicas never do.
+    QUARANTINED = "quarantined"
     DEAD = "dead"
     STOPPED = "stopped"
 

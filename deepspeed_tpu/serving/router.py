@@ -127,6 +127,9 @@ class ReplicaRouter:
                     if r.state not in (ReplicaState.DEAD,
                                        ReplicaState.STOPPED)]
             self.metrics.gauge("replicas_healthy").set(len(out))
+            self.metrics.gauge("replicas_quarantined").set(
+                sum(1 for r in reps
+                    if r.state == ReplicaState.QUARANTINED))
             self.metrics.gauge("outstanding_tokens").set(
                 sum(r.outstanding_tokens for r in live))
             self.metrics.gauge("outstanding_prefill_tokens").set(
@@ -250,6 +253,16 @@ class ReplicaRouter:
         if self.disaggregation is None or not self._needs_decode_role(req):
             return any(r.accepting for r in pool)
         return any(r.accepting and r.role in DECODE_CAPABLE for r in pool)
+
+    def _any_quarantined_for(self, req) -> bool:
+        """Gray-failure hold signal: quarantined capacity is EXPECTED
+        back (probe re-admission on backoff, docs/SERVING.md "Fleet
+        fault tolerance") — a fleet whose only capacity for this request
+        is quarantined should hold the request like a supervised
+        restart, not bounce it with "no_replicas"."""
+        return any(r.state == ReplicaState.QUARANTINED
+                   and self._model_of(r) == req.model_id
+                   for r in self.replicas)
 
     def _dispatchable_filter(self):
         """Pop-time predicate for the admission queue (None for the
@@ -379,15 +392,18 @@ class ReplicaRouter:
         while not self._stop.is_set():
             if not self._any_accepting_for(req):
                 sup = self.supervisor
-                if sup is None or not sup.recovery_pending():
+                if (sup is None or not sup.recovery_pending()) \
+                        and not self._any_quarantined_for(req):
                     logger.warning(f"serving request {req.uid}: no healthy "
                                    "replica; failing fast")
                     if self.metrics is not None:
                         self.metrics.counter("requests_failed").inc()
                     req.finish(RequestState.FAILED, FinishReason.NO_REPLICAS)
                     return
-                # supervised restart in flight: capacity is coming back
-                # — hold the request (deadline still enforced below)
+                # supervised restart (or probe re-admission of a
+                # quarantined replica) in flight: capacity is coming
+                # back — hold the request (deadline still enforced
+                # below)
             if req.expired():
                 if self.metrics is not None:
                     self.metrics.counter("requests_expired").inc()
@@ -418,7 +434,9 @@ class ReplicaRouter:
         "no_replicas" instead of waiting out its deadline. Unsupervised
         fleets keep the legacy behavior (work waits; deadlines sweep)."""
         sup = self.supervisor
-        if sup is None or self._any_accepting() or sup.recovery_pending():
+        if sup is None or self._any_accepting() or sup.recovery_pending() \
+                or any(r.state == ReplicaState.QUARANTINED
+                       for r in self.replicas):
             return
         while True:
             req = self.admission.pop(timeout=0)

@@ -96,6 +96,12 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     # its replica server (supervisor restart or reconnect)
     "replica_disconnected": frozenset({"replica", "reason"}),
     "replica_reconnected": frozenset({"replica"}),
+    # gray-failure quarantine (docs/SERVING.md "Fleet fault tolerance"):
+    # a remote replica left the routable set for slow RPCs / deadline
+    # misses (in-flight streams continue) / a probe RPC re-admitted it
+    # after this long in quarantine
+    "replica_quarantined": frozenset({"replica", "reason"}),
+    "replica_readmitted": frozenset({"replica", "quarantined_s"}),
     # frontend federation (docs/SERVING.md "Frontend federation"): a
     # peer frontend's hello was accepted / a peer connection died (its
     # federated in-flight work fails over on the ADOPTING side) / one
@@ -103,6 +109,13 @@ EVENT_SCHEMAS: Dict[str, frozenset] = {
     "peer_connected": frozenset({"peer", "epoch"}),
     "peer_lost": frozenset({"peer", "reason"}),
     "replica_exported": frozenset({"replica", "peer"}),
+    # partition tolerance (docs/SERVING.md "Frontend federation"): a
+    # peer's bootstrap channel went silent past the staleness window
+    # (once per silence episode) / an export channel's seat lease
+    # expired — the exporter cancelled its mirrors and took the
+    # borrowed seats back
+    "peer_partition": frozenset({"peer", "idle_s"}),
+    "lease_expired": frozenset({"peer", "replica", "idle_s"}),
     # fleet observability (docs/OBSERVABILITY.md "Fleet observability"):
     # the frontend's scrape endpoint came up (where operators should
     # point fleetctl/Prometheus), and a fleet-wide debug dump completed

@@ -56,6 +56,7 @@ LOCK_RANKS = {
     "serving.fabric.federation": 73,   # federation-server peer/export tables
     "serving.fabric.server": 74,   # replica-server request table
     "serving.fabric.transport": 76,    # RPC pending-call table
+    "serving.fabric.chaos": 78,    # network fault-injection fired ledger
     "serving.handoff": 80,         # KV staging budget
     "serving.faults": 90,          # serving fault-injection schedule
     "serving.request.seq": 100,    # uid allocation

@@ -27,13 +27,21 @@ class RestartPolicy:
 
     def __init__(self, backoff_s: float, backoff_max_s: float,
                  jitter: float, max_failures_in_window: int,
-                 window_s: float, rng: random.Random):
+                 window_s: float, rng: random.Random,
+                 full_jitter: bool = False):
         self.backoff_s = float(backoff_s)
         self.backoff_max_s = float(backoff_max_s)
         self.jitter = float(jitter)
         self.max_failures_in_window = int(max_failures_in_window)
         self.window_s = float(window_s)
         self.rng = rng
+        # full_jitter: backoff = uniform(0, capped_exponential) — the
+        # AWS "full jitter" scheme. Proportional jitter (the default)
+        # only perturbs the backoff by ±jitter; N peers that failed at
+        # the same instant still re-dial in a tight band and hammer a
+        # restarted frontend in lockstep. Full jitter spreads them over
+        # the WHOLE interval — reconnect storms become a trickle.
+        self.full_jitter = bool(full_jitter)
         self.failure_times: "deque[float]" = deque()
 
     def record_failure(self, now: float) -> Tuple[int, Optional[float]]:
@@ -47,10 +55,11 @@ class RestartPolicy:
         n = len(self.failure_times)
         if n >= max(1, self.max_failures_in_window):
             return n, None
-        backoff = min(self.backoff_s * (2 ** (n - 1)), self.backoff_max_s)
+        raw = min(self.backoff_s * (2 ** (n - 1)), self.backoff_max_s)
         # rng.random() is drawn even at jitter 0 so the seeded stream is
         # identical whether or not jitter is configured
-        backoff *= 1.0 + self.jitter * self.rng.random()
+        u = self.rng.random()
+        backoff = raw * u if self.full_jitter else raw * (1.0 + self.jitter * u)
         return n, backoff
 
     def count(self) -> int:

@@ -91,6 +91,11 @@ _LOWER_IS_BETTER = (
     # (schema-invalid) — any rise means a producer drifted from
     # EVENT_SCHEMAS
     "events_dropped",
+    # net_chaos phase: corrupt frames that escalated past the typed
+    # single-frame CRC refusal and killed a connection — zero on a
+    # healthy run (bare frames_corrupt is informational: it counts the
+    # schedule, not a defect)
+    "frames_corrupt_fatal",
 )
 _HIGHER_IS_BETTER = (
     "tokens_per_sec", "tokens_per_forward", "samples_per_sec", "mfu",
@@ -123,6 +128,9 @@ _HIGHER_IS_BETTER = (
     # frontend's export — zero means the shared pool collapsed to
     # local-only and the phase's parity went vacuous
     "requests_federated",
+    # net_chaos phase: fraction of the burst that finished under the
+    # fault schedule — anything below 1.0 means chaos cost completions
+    "completed_under_chaos",
 )
 
 

@@ -83,7 +83,17 @@
 #                  /metrics + /health + fleetctl status against the
 #                  observability endpoint, telemetry overhead < 2% vs
 #                  the noise floor, and observability-disabled
-#                  byte-parity asserted) — wires
+#                  byte-parity asserted,
+#                  or TIER1_PHASE=net_chaos for the fleet chaos phase —
+#                  3 subprocess replicas under a seeded network-fault
+#                  schedule: a gray-slow link fires quarantine and a
+#                  probe re-admits it (journaled exactly once), a
+#                  mid-burst partition fails work over and the
+#                  supervisor heals the link (recovery time stamped),
+#                  and a corrupt-frame burst is refused benignly (zero
+#                  connections lost), with 100% completion, greedy
+#                  byte-parity, and chaos/quarantine-disabled
+#                  byte-parity all asserted) — wires
 #                  bench.py's phase-resumable runner (BENCH_PHASES +
 #                  BENCH_SERVING_ONLY); prints the bench JSON line.
 #                  Compare two rounds' bench JSONs with per-metric

@@ -22,6 +22,7 @@ import socket
 import struct
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -1297,3 +1298,225 @@ class TestSubprocessMesh:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+# ================================================= transport chaos edges
+class TestTransportChaosEdges:
+    """ISSUE 20: the wire's nastiest edges, driven deterministically by
+    the fabric chaos injector (serving/fabric/chaos.py) instead of
+    hand-rolled socket torture. Every failure must stay TYPED — the
+    transport's existing guarantees are exercised, never widened."""
+
+    @staticmethod
+    def _pair(name_a="chaos-a", name_b="chaos-b", **kw):
+        sa, sb = socket.socketpair()
+        a = ftransport.Connection(sa, name=name_a, **kw)
+        b = ftransport.Connection(sb, name=name_b, **kw)
+        a.start()
+        b.start()
+        return a, b
+
+    def test_chaos_disabled_is_zero_interposition(self):
+        from deepspeed_tpu.serving.fabric import chaos as fchaos
+
+        assert fchaos.installed() is None
+        a, b = self._pair()
+        try:
+            # no injector → the historical branch-free path
+            assert a._chaos is None and b._chaos is None
+        finally:
+            a.close()
+            b.close()
+        # installed but non-matching schedule → still zero interposition
+        inj = fchaos.install(fchaos.NetworkFaultInjector(
+            [{"kind": "latency", "link": "some-other-link",
+              "delay_s": 1.0}]))
+        try:
+            a, b = self._pair()
+            try:
+                assert a._chaos is None and b._chaos is None
+            finally:
+                a.close()
+                b.close()
+            assert inj.fired() == []
+        finally:
+            fchaos.uninstall()
+        # and the default encode is the v1 wire, byte for byte: sealing
+        # a frame elsewhere must not perturb the unsealed path
+        obj = {"t": "ev", "x": 1, "a": np.arange(8, dtype=np.int32)}
+        plain = fcodec.encode_frame(obj)
+        sealed = fcodec.encode_frame(obj, crc=True)
+        assert fcodec.encode_frame(obj) == plain
+        assert sealed != plain and len(sealed) > len(plain)
+
+    def test_half_open_blackhole_hits_staleness_not_socket(self,
+                                                           monkeypatch):
+        """The classic gray failure: rx silently discarded, tx fine,
+        socket open. Only the staleness detector may call it — and the
+        OTHER side (whose rx still flows) must stay alive."""
+        from deepspeed_tpu.serving.fabric import chaos as fchaos
+
+        monkeypatch.setattr(ftransport, "STALE_FLOOR_S", 0.6)
+        fchaos.install(fchaos.NetworkFaultInjector(
+            [{"kind": "blackhole", "link": "half-open-a", "dir": "rx"}]))
+        try:
+            a, b = self._pair("half-open-a", "half-open-b",
+                              heartbeat_s=0.1)
+            try:
+                deadline = time.monotonic() + 10
+                while a.alive and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert not a.alive, \
+                    "blackholed rx never tripped the staleness detector"
+                # half-open: a's SOCKET never died — staleness, not EOF
+                assert not a._dead
+                # asymmetric: b still receives a's pings → b stays alive
+                assert b.alive
+                assert fchaos.installed().fired("blackhole",
+                                                "half-open-a")
+            finally:
+                a.close()
+                b.close()
+        finally:
+            fchaos.uninstall()
+
+    def test_partial_frame_at_connection_death_is_typed(self):
+        """drop_conn with partial_bytes leaves the peer a length prefix
+        promising more bytes than ever arrive: its reader must die with
+        the typed mid-frame ConnectionLost, never hang or misparse."""
+        from deepspeed_tpu.serving.fabric import chaos as fchaos
+
+        fchaos.install(fchaos.NetworkFaultInjector(
+            [{"kind": "drop_conn", "link": "partial-a", "at_frame": 1,
+              "partial_bytes": 3, "count": 1}]))
+        try:
+            got = []
+            done = threading.Event()
+            sa, sb = socket.socketpair()
+            a = ftransport.Connection(sa, name="partial-a")
+
+            def on_ev(m):
+                got.append(m.get("k"))
+
+            b = ftransport.Connection(
+                sb, name="partial-b", on_event=on_ev,
+                on_close=lambda reason: done.set())
+            a.start()
+            b.start()
+            try:
+                a.send({"t": "ev", "k": 0})     # frame 0 flows
+                a.send({"t": "ev", "k": 1})     # frame 1: partial + kill
+                assert done.wait(10), "peer reader never died"
+                assert "EOF inside a fabric frame" in b.close_reason
+                assert "chaos" in a.close_reason
+                assert got == [0]
+            finally:
+                a.close()
+                b.close()
+        finally:
+            fchaos.uninstall()
+
+    def test_oversized_and_garbage_header_mid_stream(self):
+        """A garbage length prefix over the bound is refused BEFORE
+        allocation (FrameTooLarge kills the connection); an in-bound
+        but undecodable body on an UNSEALED link is protocol divergence
+        (typed death, never limping on)."""
+        # oversized announced length
+        sa, sb = socket.socketpair()
+        dead = threading.Event()
+        b = ftransport.Connection(sb, name="garbage-b",
+                                  max_frame_bytes=4096,
+                                  on_close=lambda r: dead.set())
+        b.start()
+        try:
+            sa.sendall(ftransport.struct.pack(">I", 1 << 30))
+            assert dead.wait(10)
+            assert "FrameTooLarge" in b.close_reason
+        finally:
+            b.close()
+            sa.close()
+        # garbage body after a GOOD frame (mid-stream, not a bad dial)
+        sa, sb = socket.socketpair()
+        got = []
+        dead = threading.Event()
+        b = ftransport.Connection(sb, name="garbage-c",
+                                  max_frame_bytes=4096,
+                                  on_event=lambda m: got.append(m["k"]),
+                                  on_close=lambda r: dead.set())
+        b.start()
+        try:
+            good = fcodec.encode_frame({"t": "ev", "k": 7})
+            sa.sendall(ftransport.struct.pack(">I", len(good)) + good)
+            junk = b"\x00\x00\x00\x08not-json"
+            sa.sendall(junk)
+            assert dead.wait(10)
+            assert "undecodable frame" in b.close_reason
+            assert got == [7]
+        finally:
+            b.close()
+            sa.close()
+
+    def test_heartbeat_survives_throttled_link(self, monkeypatch):
+        """A thin pipe is not a dead pipe: with the drip rate still
+        letting ~heartbeat-sized frames through under the staleness
+        window, both ends must stay alive for the whole throttle."""
+        from deepspeed_tpu.serving.fabric import chaos as fchaos
+
+        monkeypatch.setattr(ftransport, "STALE_FLOOR_S", 1.0)
+        fchaos.install(fchaos.NetworkFaultInjector(
+            [{"kind": "throttle", "link": "thin-*", "dir": "tx",
+              "bytes_per_s": 2048.0}]))
+        try:
+            a, b = self._pair("thin-a", "thin-b", heartbeat_s=0.2)
+            try:
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    assert a.alive and b.alive, \
+                        "throttled-but-flowing link read as dead"
+                    time.sleep(0.1)
+                assert fchaos.installed().fired("throttle")
+            finally:
+                a.close()
+                b.close()
+        finally:
+            fchaos.uninstall()
+
+    def test_crc_corrupt_frame_is_single_refusal_not_death(self):
+        """Partition tolerance on a sealed link: one flipped bit =
+        one refused frame (typed, counted, on_corrupt fires) — the
+        connection and every other frame on it survive."""
+        from deepspeed_tpu.serving.fabric import chaos as fchaos
+
+        fchaos.install(fchaos.NetworkFaultInjector(
+            [{"kind": "corrupt", "link": "crc-a", "dir": "tx",
+              "at_frame": 1, "count": 1, "where": "payload"}]))
+        try:
+            got = []
+            corrupt_cb = []
+            sa, sb = socket.socketpair()
+            a = ftransport.Connection(sa, name="crc-a")
+            b = ftransport.Connection(
+                sb, name="crc-b",
+                on_event=lambda m: got.append(m.get("k")),
+                on_corrupt=lambda: corrupt_cb.append(1))
+            a.crc_tx = True
+            b.crc_rx = True
+            a.start()
+            b.start()
+            try:
+                payload = np.arange(64, dtype=np.int32)
+                for k in range(3):
+                    a.send({"t": "ev", "k": k, "buf": payload})
+                deadline = time.monotonic() + 10
+                while len(got) < 2 and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert got == [0, 2], got
+                assert b.frames_corrupt == 1
+                assert corrupt_cb == [1]
+                assert b.alive and not b._dead, \
+                    "a single corrupt frame killed a sealed connection"
+            finally:
+                a.close()
+                b.close()
+        finally:
+            fchaos.uninstall()

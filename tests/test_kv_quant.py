@@ -656,6 +656,17 @@ def test_bench_schema_validator():
                          "fleetctl_ok": True, "parity": True,
                          "disabled_parity": True, "zero_wedges": True,
                          "kv_occupancy": dict(occ)}
+    good["net_chaos"] = {"replicas": 3, "n_requests": 9,
+                         "prompt_len": 24, "max_new": 6,
+                         "completed_under_chaos": 1.0,
+                         "recovery_time_s": 1.666,
+                         "quarantines_journaled": 1,
+                         "readmits_journaled": 1,
+                         "frames_corrupt": 3,
+                         "frames_corrupt_fatal": 0,
+                         "faults_injected": 40,
+                         "parity": True, "disabled_parity": True,
+                         "kv_occupancy": dict(occ)}
     assert bench.validate_serving_schema(good) == []
     # multitenant typed checks: bool-for-int rejected, missing named
     bad_mt = dict(good)
